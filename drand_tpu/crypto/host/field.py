@@ -1,0 +1,333 @@
+"""Host-side BLS12-381 field tower: Fp, Fp2, Fp6, Fp12.
+
+Pure-Python big-int arithmetic.  This module is (a) the golden reference the
+JAX/Pallas kernels are tested against, and (b) the host latency path (signing
+a single partial, DKG share math) where a device round-trip isn't worth it.
+
+Representation (functional, no classes — keeps big-int ops dominant):
+  Fp   : int in [0, p)
+  Fp2  : (c0, c1)           c0 + c1*u,          u^2 = -1
+  Fp6  : (a, b, c) of Fp2   a + b*v + c*v^2,    v^3 = xi = 1 + u
+  Fp12 : (a, b)   of Fp6    a + b*w,            w^2 = v
+
+Tower layout mirrors the standard BLS12-381 tower (same as the reference's
+kyber-bls12381 dependency; see SURVEY.md §2.9).
+"""
+
+from .params import P
+
+# ---------------------------------------------------------------------------
+# Fp
+# ---------------------------------------------------------------------------
+
+def fp_add(a, b):
+    c = a + b
+    return c - P if c >= P else c
+
+
+def fp_sub(a, b):
+    c = a - b
+    return c + P if c < 0 else c
+
+
+def fp_mul(a, b):
+    return a * b % P
+
+
+def fp_neg(a):
+    return P - a if a else 0
+
+
+def fp_inv(a):
+    return pow(a, P - 2, P)
+
+
+def fp_sqrt(a):
+    """Square root for p = 3 mod 4; returns None if a is not a QR."""
+    s = pow(a, (P + 1) // 4, P)
+    return s if s * s % P == a else None
+
+
+def fp_is_square(a):
+    return a == 0 or pow(a, (P - 1) // 2, P) == 1
+
+
+def fp_sgn0(a):
+    return a & 1
+
+
+# ---------------------------------------------------------------------------
+# Fp2 = Fp[u]/(u^2+1)
+# ---------------------------------------------------------------------------
+
+FP2_ZERO = (0, 0)
+FP2_ONE = (1, 0)
+
+
+def fp2_add(a, b):
+    return (fp_add(a[0], b[0]), fp_add(a[1], b[1]))
+
+
+def fp2_sub(a, b):
+    return (fp_sub(a[0], b[0]), fp_sub(a[1], b[1]))
+
+
+def fp2_neg(a):
+    return (fp_neg(a[0]), fp_neg(a[1]))
+
+
+def fp2_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = a0 * b0
+    t1 = a1 * b1
+    # Karatsuba: (a0+a1)(b0+b1) - t0 - t1 = a0b1 + a1b0
+    t2 = (a0 + a1) * (b0 + b1) - t0 - t1
+    return ((t0 - t1) % P, t2 % P)
+
+
+def fp2_sqr(a):
+    a0, a1 = a
+    # (a0 + a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u
+    return ((a0 + a1) * (a0 - a1) % P, 2 * a0 * a1 % P)
+
+
+def fp2_scalar(a, k):
+    return (a[0] * k % P, a[1] * k % P)
+
+
+def fp2_conj(a):
+    return (a[0], fp_neg(a[1]))
+
+
+def fp2_inv(a):
+    a0, a1 = a
+    norm = (a0 * a0 + a1 * a1) % P
+    ninv = fp_inv(norm)
+    return (a0 * ninv % P, (P - a1) * ninv % P if a1 else 0)
+
+
+def fp2_mul_fp(a, k):
+    return (a[0] * k % P, a[1] * k % P)
+
+
+def fp2_is_zero(a):
+    return a[0] == 0 and a[1] == 0
+
+
+def fp2_eq(a, b):
+    return a[0] == b[0] and a[1] == b[1]
+
+
+def fp2_pow(a, e):
+    out = FP2_ONE
+    base = a
+    while e:
+        if e & 1:
+            out = fp2_mul(out, base)
+        base = fp2_sqr(base)
+        e >>= 1
+    return out
+
+
+def fp2_is_square(a):
+    """a is a QR in Fp2 iff its norm is a QR in Fp."""
+    norm = (a[0] * a[0] + a[1] * a[1]) % P
+    return fp_is_square(norm)
+
+
+def fp2_sqrt(a):
+    """Square root in Fp2 for p = 3 mod 4 via norm trick; None if non-square."""
+    a0, a1 = a
+    if a1 == 0:
+        s = fp_sqrt(a0)
+        if s is not None:
+            return (s, 0)
+        # sqrt of non-residue a0: a0 = -n^2 * 1 => sqrt = n*u since u^2=-1
+        s = fp_sqrt(fp_neg(a0))
+        if s is None:
+            return None
+        return (0, s)
+    norm = (a0 * a0 + a1 * a1) % P
+    d = fp_sqrt(norm)
+    if d is None:
+        return None
+    # want x,y with (x + y u)^2 = a:  x^2 - y^2 = a0, 2xy = a1
+    # x^2 = (a0 + d)/2 (or with -d)
+    inv2 = (P + 1) // 2
+    x2 = (a0 + d) * inv2 % P
+    x = fp_sqrt(x2)
+    if x is None:
+        x2 = (a0 - d) * inv2 % P
+        x = fp_sqrt(x2)
+        if x is None:
+            return None
+    y = a1 * fp_inv(2 * x % P) % P
+    return (x, y)
+
+
+def fp2_sgn0(a):
+    """RFC 9380 sgn0 for m=2 (little-endian lexicographic parity)."""
+    sign_0 = a[0] & 1
+    zero_0 = a[0] == 0
+    sign_1 = a[1] & 1
+    return sign_0 | (int(zero_0) & sign_1)
+
+
+# ---------------------------------------------------------------------------
+# Fp6 = Fp2[v]/(v^3 - xi), xi = 1 + u
+# ---------------------------------------------------------------------------
+
+XI = (1, 1)
+FP6_ZERO = (FP2_ZERO, FP2_ZERO, FP2_ZERO)
+FP6_ONE = (FP2_ONE, FP2_ZERO, FP2_ZERO)
+
+
+def fp2_mul_xi(a):
+    """(c0 + c1 u)(1 + u) = (c0 - c1) + (c0 + c1) u."""
+    return (fp_sub(a[0], a[1]), fp_add(a[0], a[1]))
+
+
+def fp6_add(a, b):
+    return (fp2_add(a[0], b[0]), fp2_add(a[1], b[1]), fp2_add(a[2], b[2]))
+
+
+def fp6_sub(a, b):
+    return (fp2_sub(a[0], b[0]), fp2_sub(a[1], b[1]), fp2_sub(a[2], b[2]))
+
+
+def fp6_neg(a):
+    return (fp2_neg(a[0]), fp2_neg(a[1]), fp2_neg(a[2]))
+
+
+def fp6_mul(a, b):
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t0 = fp2_mul(a0, b0)
+    t1 = fp2_mul(a1, b1)
+    t2 = fp2_mul(a2, b2)
+    # c0 = t0 + xi*((a1+a2)(b1+b2) - t1 - t2)
+    c0 = fp2_add(t0, fp2_mul_xi(fp2_sub(fp2_sub(fp2_mul(fp2_add(a1, a2), fp2_add(b1, b2)), t1), t2)))
+    # c1 = (a0+a1)(b0+b1) - t0 - t1 + xi*t2
+    c1 = fp2_add(fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a1), fp2_add(b0, b1)), t0), t1), fp2_mul_xi(t2))
+    # c2 = (a0+a2)(b0+b2) - t0 - t2 + t1
+    c2 = fp2_add(fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a2), fp2_add(b0, b2)), t0), t2), t1)
+    return (c0, c1, c2)
+
+
+def fp6_sqr(a):
+    return fp6_mul(a, a)
+
+
+def fp6_mul_by_v(a):
+    """a * v: (a0 + a1 v + a2 v^2) v = xi*a2 + a0 v + a1 v^2."""
+    return (fp2_mul_xi(a[2]), a[0], a[1])
+
+
+def fp6_inv(a):
+    a0, a1, a2 = a
+    c0 = fp2_sub(fp2_sqr(a0), fp2_mul_xi(fp2_mul(a1, a2)))
+    c1 = fp2_sub(fp2_mul_xi(fp2_sqr(a2)), fp2_mul(a0, a1))
+    c2 = fp2_sub(fp2_sqr(a1), fp2_mul(a0, a2))
+    t = fp2_add(fp2_mul_xi(fp2_add(fp2_mul(a1, c2), fp2_mul(a2, c1))), fp2_mul(a0, c0))
+    tinv = fp2_inv(t)
+    return (fp2_mul(c0, tinv), fp2_mul(c1, tinv), fp2_mul(c2, tinv))
+
+
+def fp6_is_zero(a):
+    return all(fp2_is_zero(c) for c in a)
+
+
+# ---------------------------------------------------------------------------
+# Fp12 = Fp6[w]/(w^2 - v)
+# ---------------------------------------------------------------------------
+
+FP12_ONE = (FP6_ONE, FP6_ZERO)
+
+
+def fp12_add(a, b):
+    return (fp6_add(a[0], b[0]), fp6_add(a[1], b[1]))
+
+
+def fp12_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = fp6_mul(a0, b0)
+    t1 = fp6_mul(a1, b1)
+    c0 = fp6_add(t0, fp6_mul_by_v(t1))
+    c1 = fp6_sub(fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1)), t0), t1)
+    return (c0, c1)
+
+
+def fp12_sqr(a):
+    a0, a1 = a
+    t = fp6_mul(a0, a1)
+    c0 = fp6_mul(fp6_add(a0, a1), fp6_add(a0, fp6_mul_by_v(a1)))
+    c0 = fp6_sub(fp6_sub(c0, t), fp6_mul_by_v(t))
+    return (c0, fp6_add(t, t))
+
+
+def fp12_conj(a):
+    """Conjugation = raising to p^6: (a0, a1) -> (a0, -a1)."""
+    return (a[0], fp6_neg(a[1]))
+
+
+def fp12_inv(a):
+    a0, a1 = a
+    t = fp6_sub(fp6_sqr(a0), fp6_mul_by_v(fp6_sqr(a1)))
+    tinv = fp6_inv(t)
+    return (fp6_mul(a0, tinv), fp6_neg(fp6_mul(a1, tinv)))
+
+
+def fp12_pow(a, e):
+    if e < 0:
+        return fp12_pow(fp12_inv(a), -e)
+    out = FP12_ONE
+    base = a
+    while e:
+        if e & 1:
+            out = fp12_mul(out, base)
+        base = fp12_sqr(base)
+        e >>= 1
+    return out
+
+
+def fp12_eq(a, b):
+    return a == b
+
+
+def fp12_is_one(a):
+    return a == FP12_ONE
+
+
+# ---------------------------------------------------------------------------
+# Frobenius maps (computed constants)
+# ---------------------------------------------------------------------------
+
+def _compute_frob_coeffs():
+    """gamma_{j,i} = xi^(i*(p^j-1)/6) for the w-coefficient twists."""
+    coeffs = {}
+    for j in (1, 2, 3):
+        pj = P**j
+        coeffs[j] = [fp2_pow(XI, i * (pj - 1) // 6) for i in range(6)]
+    return coeffs
+
+_FROB = _compute_frob_coeffs()
+
+
+def _fp2_frob(a, j):
+    """a^(p^j) in Fp2: conjugate iff j odd."""
+    return fp2_conj(a) if j & 1 else a
+
+
+def fp12_frobenius(a, j=1):
+    """a^(p^j) for j in {1,2,3} using precomputed gamma coefficients.
+
+    Write a = sum_{i=0..5} c_i * w^i with c_i in Fp2 (w^2=v, v^3=xi).
+    Then a^(p^j) = sum c_i^(p^j) * gamma_{j,i} * w^i.
+    """
+    g = _FROB[j]
+    (c0, c2, c4), (c1, c3, c5) = a  # a0 = c0 + c2 v + c4 v^2 ; a1 = c1 + c3 v + c5 v^2
+    cs = [c0, c1, c2, c3, c4, c5]
+    out = [fp2_mul(_fp2_frob(c, j), g[i]) for i, c in enumerate(cs)]
+    return ((out[0], out[2], out[4]), (out[1], out[3], out[5]))

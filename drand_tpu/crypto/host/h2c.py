@@ -1,0 +1,263 @@
+"""RFC 9380 hash-to-curve for BLS12-381 G1 and G2 (host reference).
+
+Suites:
+  BLS12381G1_XMD:SHA-256_SSWU_RO_   (sigs of the short-sig scheme)
+  BLS12381G2_XMD:SHA-256_SSWU_RO_   (sigs of the default schemes)
+
+The simplified SWU map targets isogenous curves E1' (11-isogeny) and
+E2' (3-isogeny); the isogeny maps land on E1/E2 and the cofactor is cleared.
+The reference consumes this through kyber-bls12381's hash-to-curve during
+tbls sign/verify (SURVEY.md §2.9).
+"""
+
+import hashlib
+
+from . import field as F
+from .params import P, HTF_L, ISO_A1, ISO_B1, ISO_A2, ISO_B2, Z1, Z2
+from .curve import G1, G2, g1_clear_cofactor, g2_clear_cofactor
+
+# ---------------------------------------------------------------------------
+# expand_message_xmd (SHA-256)
+# ---------------------------------------------------------------------------
+
+def expand_message_xmd(msg: bytes, dst: bytes, len_in_bytes: int) -> bytes:
+    ell = (len_in_bytes + 31) // 32
+    assert ell <= 255 and len(dst) <= 255
+    dst_prime = dst + bytes([len(dst)])
+    z_pad = b"\x00" * 64
+    l_i_b = len_in_bytes.to_bytes(2, "big")
+    b0 = hashlib.sha256(z_pad + msg + l_i_b + b"\x00" + dst_prime).digest()
+    bi = hashlib.sha256(b0 + b"\x01" + dst_prime).digest()
+    out = [bi]
+    for i in range(2, ell + 1):
+        tmp = bytes(a ^ b for a, b in zip(b0, bi))
+        bi = hashlib.sha256(tmp + bytes([i]) + dst_prime).digest()
+        out.append(bi)
+    return b"".join(out)[:len_in_bytes]
+
+
+def hash_to_field_fp(msg: bytes, dst: bytes, count: int):
+    ub = expand_message_xmd(msg, dst, count * HTF_L)
+    return [int.from_bytes(ub[i * HTF_L:(i + 1) * HTF_L], "big") % P for i in range(count)]
+
+
+def hash_to_field_fp2(msg: bytes, dst: bytes, count: int):
+    ub = expand_message_xmd(msg, dst, count * 2 * HTF_L)
+    out = []
+    for i in range(count):
+        base = i * 2 * HTF_L
+        c0 = int.from_bytes(ub[base:base + HTF_L], "big") % P
+        c1 = int.from_bytes(ub[base + HTF_L:base + 2 * HTF_L], "big") % P
+        out.append((c0, c1))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Simplified SWU (generic over Fp / Fp2)
+# ---------------------------------------------------------------------------
+
+def _sswu_fp(u):
+    """map_to_curve_simple_swu onto E1': y^2 = x^3 + A*x + B, Z = Z1."""
+    A, B, Z = ISO_A1, ISO_B1, Z1
+    u2 = u * u % P
+    tv1 = Z * u2 % P                     # Z u^2
+    tv2 = (tv1 * tv1 + tv1) % P          # Z^2 u^4 + Z u^2
+    if tv2 == 0:
+        x1 = B * F.fp_inv(Z * A % P) % P
+    else:
+        x1 = (P - B) * F.fp_inv(A) % P * ((1 + F.fp_inv(tv2)) % P) % P
+    gx1 = (pow(x1, 3, P) + A * x1 + B) % P
+    x2 = tv1 * x1 % P
+    gx2 = (pow(x2, 3, P) + A * x2 + B) % P
+    if F.fp_is_square(gx1):
+        x, y = x1, F.fp_sqrt(gx1)
+    else:
+        x, y = x2, F.fp_sqrt(gx2)
+    if F.fp_sgn0(u) != F.fp_sgn0(y):
+        y = P - y
+    return (x, y)
+
+
+def _sswu_fp2(u):
+    """map_to_curve_simple_swu onto E2': y^2 = x^3 + A*x + B over Fp2, Z = Z2."""
+    A, B, Z = ISO_A2, ISO_B2, Z2
+    u2 = F.fp2_sqr(u)
+    tv1 = F.fp2_mul(Z, u2)
+    tv2 = F.fp2_add(F.fp2_sqr(tv1), tv1)
+    if F.fp2_is_zero(tv2):
+        x1 = F.fp2_mul(B, F.fp2_inv(F.fp2_mul(Z, A)))
+    else:
+        nb = F.fp2_neg(B)
+        x1 = F.fp2_mul(F.fp2_mul(nb, F.fp2_inv(A)), F.fp2_add(F.FP2_ONE, F.fp2_inv(tv2)))
+    def g(x):
+        return F.fp2_add(F.fp2_add(F.fp2_mul(F.fp2_sqr(x), x), F.fp2_mul(A, x)), B)
+    gx1 = g(x1)
+    x2 = F.fp2_mul(tv1, x1)
+    gx2 = g(x2)
+    if F.fp2_is_square(gx1):
+        x, y = x1, F.fp2_sqrt(gx1)
+    else:
+        x, y = x2, F.fp2_sqrt(gx2)
+    if F.fp2_sgn0(u) != F.fp2_sgn0(y):
+        y = F.fp2_neg(y)
+    return (x, y)
+
+
+# ---------------------------------------------------------------------------
+# Affine addition on the isogenous curves (a != 0)
+# ---------------------------------------------------------------------------
+
+def _affine_add_fp(p, q, A):
+    if p is None:
+        return q
+    if q is None:
+        return p
+    x1, y1 = p
+    x2, y2 = q
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None
+        lam = (3 * x1 * x1 + A) * F.fp_inv(2 * y1 % P) % P
+    else:
+        lam = (y2 - y1) * F.fp_inv((x2 - x1) % P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    y3 = (lam * (x1 - x3) - y1) % P
+    return (x3, y3)
+
+
+def _affine_add_fp2(p, q, A):
+    if p is None:
+        return q
+    if q is None:
+        return p
+    x1, y1 = p
+    x2, y2 = q
+    if F.fp2_eq(x1, x2):
+        if F.fp2_is_zero(F.fp2_add(y1, y2)):
+            return None
+        lam = F.fp2_mul(
+            F.fp2_add(F.fp2_scalar(F.fp2_sqr(x1), 3), A),
+            F.fp2_inv(F.fp2_add(y1, y1)),
+        )
+    else:
+        lam = F.fp2_mul(F.fp2_sub(y2, y1), F.fp2_inv(F.fp2_sub(x2, x1)))
+    x3 = F.fp2_sub(F.fp2_sub(F.fp2_sqr(lam), x1), x2)
+    y3 = F.fp2_sub(F.fp2_mul(lam, F.fp2_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+# ---------------------------------------------------------------------------
+# 3-isogeny map E2' -> E2  (RFC 9380 Appendix E.3 constants)
+# ---------------------------------------------------------------------------
+
+_K1 = [  # x numerator
+    (0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+     0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6),
+    (0,
+     0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71A),
+    (0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71E,
+     0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38D),
+    (0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1,
+     0),
+]
+_K2 = [  # x denominator (monic degree 2)
+    (0,
+     0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA63),
+    (0xC,
+     0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA9F),
+    (1, 0),
+]
+_K3 = [  # y numerator
+    (0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+     0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706),
+    (0,
+     0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97BE),
+    (0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71C,
+     0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38F),
+    (0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10,
+     0),
+]
+_K4 = [  # y denominator (monic degree 3)
+    (0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+     0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB),
+    (0,
+     0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA9D3),
+    (0x12,
+     0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA99),
+    (1, 0),
+]
+
+
+def _horner_fp2(coeffs, x):
+    acc = F.FP2_ZERO
+    for c in reversed(coeffs):
+        acc = F.fp2_add(F.fp2_mul(acc, x), c)
+    return acc
+
+
+def iso_map_g2(p):
+    """Map a point on E2' to E2 via the 3-isogeny."""
+    if p is None:
+        return None
+    x, y = p
+    xn = _horner_fp2(_K1, x)
+    xd = _horner_fp2(_K2, x)
+    yn = _horner_fp2(_K3, x)
+    yd = _horner_fp2(_K4, x)
+    xo = F.fp2_mul(xn, F.fp2_inv(xd))
+    yo = F.fp2_mul(y, F.fp2_mul(yn, F.fp2_inv(yd)))
+    return (xo, yo)
+
+
+def hash_to_curve_g2(msg: bytes, dst: bytes):
+    u0, u1 = hash_to_field_fp2(msg, dst, 2)
+    q0 = _sswu_fp2(u0)
+    q1 = _sswu_fp2(u1)
+    r = _affine_add_fp2(q0, q1, ISO_A2)
+    p = iso_map_g2(r)
+    out = g2_clear_cofactor(p)
+    assert G2.is_on_curve(out)
+    return out
+
+
+# G1 iso map coefficients are generated by tools/derive_isogeny.py into
+# _iso_g1.py (11-isogeny, ~50 coefficients; derived from the curve parameters
+# and pinned by the mainnet known-answer vectors).
+try:
+    from ._iso_g1 import XNUM as _G1XN, XDEN as _G1XD, YNUM as _G1YN, YDEN as _G1YD
+    _HAS_G1_ISO = True
+except ImportError:  # pragma: no cover - before generation
+    _HAS_G1_ISO = False
+
+
+def _horner_fp(coeffs, x):
+    acc = 0
+    for c in reversed(coeffs):
+        acc = (acc * x + c) % P
+    return acc
+
+
+def iso_map_g1(p):
+    if p is None:
+        return None
+    if not _HAS_G1_ISO:
+        raise NotImplementedError("G1 isogeny coefficients not generated yet")
+    x, y = p
+    xn = _horner_fp(_G1XN, x)
+    xd = _horner_fp(_G1XD, x)
+    yn = _horner_fp(_G1YN, x)
+    yd = _horner_fp(_G1YD, x)
+    xo = xn * F.fp_inv(xd) % P
+    yo = y * yn % P * F.fp_inv(yd) % P
+    return (xo, yo)
+
+
+def hash_to_curve_g1(msg: bytes, dst: bytes):
+    u0, u1 = hash_to_field_fp(msg, dst, 2)
+    q0 = _sswu_fp(u0)
+    q1 = _sswu_fp(u1)
+    r = _affine_add_fp(q0, q1, ISO_A1)
+    p = iso_map_g1(r)
+    out = g1_clear_cofactor(p)
+    assert G1.is_on_curve(out)
+    return out
