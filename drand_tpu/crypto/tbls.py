@@ -1,0 +1,155 @@
+"""Threshold BLS (host side): Shamir shares, partial signatures, recovery.
+
+Wire format parity with kyber/sign/tbls (SURVEY.md §2.9): a partial signature
+is `be16(share_index) || bls_signature`.  Share index i corresponds to
+polynomial evaluation at x = i + 1.
+
+The batched device equivalents (vmapped partial verification, Lagrange
+recovery in the exponent) live in drand_tpu.crypto.jax.tbls.
+"""
+
+import secrets
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from .host.params import R
+from .schemes import Scheme
+
+
+@dataclass(frozen=True)
+class PriShare:
+    index: int
+    value: int  # scalar mod R
+
+
+@dataclass
+class PriPoly:
+    """Secret-sharing polynomial of degree t-1; coeffs[0] is the secret."""
+    coeffs: List[int]
+
+    @classmethod
+    def random(cls, threshold: int, secret: Optional[int] = None):
+        coeffs = [secret if secret is not None else secrets.randbelow(R)]
+        coeffs += [secrets.randbelow(R) for _ in range(threshold - 1)]
+        return cls(coeffs)
+
+    def eval(self, index: int) -> PriShare:
+        x = index + 1
+        acc = 0
+        for c in reversed(self.coeffs):
+            acc = (acc * x + c) % R
+        return PriShare(index, acc)
+
+    def shares(self, n: int) -> List[PriShare]:
+        return [self.eval(i) for i in range(n)]
+
+    def secret(self) -> int:
+        return self.coeffs[0]
+
+    def commit(self, group) -> "PubPoly":
+        g = group.curve
+        return PubPoly(group, [g.mul(g.gen, c) for c in self.coeffs])
+
+
+@dataclass
+class PubPoly:
+    """Commitments to a PriPoly on a group; commits[0] is the public key."""
+    group: object
+    commits: List[object]
+
+    @property
+    def threshold(self) -> int:
+        return len(self.commits)
+
+    def public_key(self):
+        return self.commits[0]
+
+    def eval(self, index: int):
+        """Public counterpart of share index: sum_j commits[j] * (i+1)^j."""
+        x = index + 1
+        g = self.group.curve
+        acc = None
+        xp = 1
+        for c in self.commits:
+            acc = g.add(acc, g.mul(c, xp))
+            xp = xp * x % R
+        return acc
+
+    def to_bytes(self) -> bytes:
+        return b"".join(self.group.to_bytes(c) for c in self.commits)
+
+    @classmethod
+    def from_bytes(cls, group, data: bytes) -> "PubPoly":
+        n = group.point_len
+        assert len(data) % n == 0
+        return cls(group, [group.from_bytes(data[i:i + n]) for i in range(0, len(data), n)])
+
+
+# ---------------------------------------------------------------------------
+# Partial signatures
+# ---------------------------------------------------------------------------
+
+def sign_partial(scheme: Scheme, share: PriShare, msg: bytes) -> bytes:
+    """tbls.Sign: be16(index) || BLS_sign(share.value, msg)."""
+    sig = scheme.sign(share.value, msg)
+    return share.index.to_bytes(2, "big") + sig
+
+
+def index_of(partial: bytes) -> int:
+    """tbls.IndexOf — recover the signer index from a partial sig."""
+    return int.from_bytes(partial[:2], "big")
+
+
+def verify_partial(scheme: Scheme, pub_poly: PubPoly, msg: bytes, partial: bytes) -> bool:
+    """tbls.VerifyPartial: check against the index's public share."""
+    idx = index_of(partial)
+    if idx >= 1 << 15:
+        return False
+    pub_i = pub_poly.eval(idx)
+    return scheme.verify(pub_i, msg, partial[2:])
+
+
+def _lagrange_coeff(indices: Sequence[int], i: int) -> int:
+    """lambda_i for interpolation at 0 over points x_j = index_j + 1."""
+    num, den = 1, 1
+    xi = i + 1
+    for j in indices:
+        if j == i:
+            continue
+        xj = j + 1
+        num = num * xj % R
+        den = den * ((xj - xi) % R) % R
+    return num * pow(den, R - 2, R) % R
+
+
+def recover(scheme: Scheme, pub_poly: PubPoly, msg: bytes,
+            partials: Sequence[bytes], threshold: int, n: int,
+            verify_each: bool = True) -> bytes:
+    """tbls.Recover: Lagrange interpolation in the exponent of t valid partials.
+
+    Returns the unique full BLS signature (what the collective secret key would
+    have produced).  Reference call site: chain/beacon/chainstore.go:202.
+    """
+    good = []
+    for p in partials:
+        if verify_each and not verify_partial(scheme, pub_poly, msg, p):
+            continue
+        good.append(p)
+        if len(good) == threshold:
+            break
+    if len(good) < threshold:
+        raise ValueError(f"not enough valid partials: {len(good)} < {threshold}")
+    indices = [index_of(p) for p in good]
+    g = scheme.sig_group.curve
+    acc = None
+    for p in good:
+        i = index_of(p)
+        pt = scheme.sig_group.from_bytes(p[2:])
+        lam = _lagrange_coeff(indices, i)
+        acc = g.add(acc, g.mul(pt, lam))
+    return scheme.sig_group.to_bytes(acc)
+
+
+def verify_recovered(scheme: Scheme, public_key, msg: bytes, sig: bytes) -> bool:
+    """tbls.VerifyRecovered == plain BLS verify against the collective key."""
+    return scheme.verify(public_key, msg, sig)

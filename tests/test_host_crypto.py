@@ -1,0 +1,143 @@
+"""Host crypto known-answer + roundtrip tests.
+
+Mainnet vectors are the public League-of-Entropy beacons the reference pins in
+crypto/schemes_test.go:81-130 (rounds 2634945 & 3361396 chained, 7601003
+unchained, 3 on the G1 scheme).
+"""
+
+import hashlib
+
+import pytest
+
+from drand_tpu.crypto.host import params
+from drand_tpu.crypto.host import field as F
+from drand_tpu.crypto.host.curve import G1, G2
+from drand_tpu.crypto.host.pairing import pairing, pairing_check
+from drand_tpu.crypto.host.serialize import (
+    g1_from_bytes, g1_to_bytes, g2_from_bytes, g2_to_bytes,
+)
+from drand_tpu.crypto import tbls
+from drand_tpu.crypto.schemes import (
+    scheme_from_name, list_schemes, randomness_from_signature,
+    get_scheme_by_id_with_default, DEFAULT_SCHEME_ID,
+)
+
+MAINNET_BEACONS = [
+    # (scheme, round, pubkey, sig, prev_sig)
+    ("pedersen-bls-chained", 2634945,
+     "868f005eb8e6e4ca0a47c8a77ceaa5309a47978a7c71bc5cce96366b5d7a569937c529eeda66c7293784a9402801af31",
+     "814778ed1e480406beb43b74af71ce2f0373e0ea1bfdfea8f9ed62c876c20fcbc7f0163860e3da42ed2148756015f4551451898ffe06d384b4d002245025571b6b7a752f7158b40ad92b13b6d703ad31922a617f2c7f6d960b84d56cf1d79eef",
+     "8bd96294383b4d1e04e736360bd7a487f9f409f1e7bd800b720656a310d577b3bdb1e1631af6c5782a1d8979c502f395036181eff4058960fc40bb7034cdae1991d3eda518ab204a077d2f7e724974cf87b407e549bd815cf0b8e5a3832f675d"),
+    ("pedersen-bls-chained", 3361396,
+     "922a2e93828ff83345bae533f5172669a26c02dc76d6bf59c80892e12ab1455c229211886f35bb56af6d5bea981024df",
+     "9904b4ec42e82cb42ad53f171cf0510a5eedff8b5e02e2db5a187489f7875307746998b9a6cf82130d291126d4b83cea1048c9b3f07a067e632c20391dc059d22d6a8e835f3980c8bd0183fb6df00a8fbbe6b8c9f61e888dfa76e12af4d4e355",
+     "a2377f4e0403f0fd05f709a3292be1b2b59fe990a673ad7b7561b5bd5982b882a2378d36e39befb6ea3bb7aac113c50a18fb07aa4f9a59f95f1aaa7826dafbfcdbf22347c29996c294286fd11b402ad83edd83fa21fe6735fccb65785edbed47"),
+    ("pedersen-bls-unchained", 7601003,
+     "8200fc249deb0148eb918d6e213980c5d01acd7fc251900d9260136da3b54836ce125172399ddc69c4e3e11429b62c11",
+     "af7eac5897b72401c0f248a26b612c5ef68e0ff830b4d78927988c89b5db3e997bfcdb7c24cb19f549830cd02cb854a1143fd53a1d4e0713ded471260869439060d170a77187eb6371742840e43eccfa225657c4cc2d9619f7c3d680470c9743",
+     None),
+    ("bls-unchained-on-g1", 3,
+     "876f6fa8073736e22f6ff4badaab35c637503718f7a452d178ce69c45d2d8129a54ad2f988ab10c9666f87ab603c59bf013409a5b500555da31720f8eec294d9809b8796f40d5372c71a44ca61226f1eb978310392f98074a608747f77e66c5a",
+     "ac7c3ca14bc88bd014260f22dc016b4fe586f9313c3a549c83d195811a99a5d2d4999d4df6daec73ff51fafadd6d5bb5",
+     None),
+]
+
+
+def test_params_validate():
+    params.validate()
+    # final-exp hard-part identity used by pairing.py
+    x, p, r = params.X, params.P, params.R
+    assert ((x - 1) ** 2 * (x + p) * (x ** 2 + p ** 2 - 1) + 3) == 3 * ((p ** 4 - p ** 2 + 1) // r)
+
+
+def test_generator_orders():
+    assert G1.mul(G1.gen, params.R) is None
+    assert G2.mul(G2.gen, params.R) is None
+
+
+def test_pairing_bilinearity():
+    a, b = 987654321, 123456789
+    e_ab = pairing(G1.mul(G1.gen, a), G2.mul(G2.gen, b))
+    e_ba = pairing(G1.mul(G1.gen, b), G2.mul(G2.gen, a))
+    assert e_ab == e_ba
+    assert e_ab == F.fp12_pow(pairing(G1.gen, G2.gen), a * b % params.R)
+    assert e_ab != F.FP12_ONE
+
+
+@pytest.mark.parametrize("scheme_id,round_,pub,sig,prev", MAINNET_BEACONS,
+                         ids=[f"{b[0]}-r{b[1]}" for b in MAINNET_BEACONS])
+def test_mainnet_vectors(scheme_id, round_, pub, sig, prev):
+    sch = scheme_from_name(scheme_id)
+    prev_b = bytes.fromhex(prev) if prev else None
+    assert sch.verify_beacon(bytes.fromhex(pub), round_, prev_b, bytes.fromhex(sig))
+    # tampered round must fail
+    assert not sch.verify_beacon(bytes.fromhex(pub), round_ + 1, prev_b, bytes.fromhex(sig))
+
+
+def test_serialization_roundtrip():
+    for k in (1, 7, 12345, params.R - 2):
+        p1 = G1.mul(G1.gen, k)
+        assert g1_from_bytes(g1_to_bytes(p1)) == p1
+        p2 = G2.mul(G2.gen, k)
+        assert g2_from_bytes(g2_to_bytes(p2)) == p2
+    assert g1_from_bytes(g1_to_bytes(None)) is None
+    assert g2_from_bytes(g2_to_bytes(None)) is None
+
+
+def test_serialization_rejects_bad_points():
+    # x not on curve
+    bad = bytearray(g1_to_bytes(G1.gen))
+    bad[47] ^= 1
+    with pytest.raises(ValueError):
+        g1_from_bytes(bytes(bad))
+
+
+@pytest.mark.parametrize("scheme_id", list_schemes())
+def test_sign_verify_roundtrip(scheme_id):
+    sch = scheme_from_name(scheme_id)
+    sk, pk = sch.keypair(seed=b"unit-test-seed")
+    msg = sch.digest_beacon(42, b"prev-sig-bytes" if sch.chained else None)
+    sig = sch.sign(sk, msg)
+    assert len(sig) == sch.sig_group.point_len
+    assert sch.verify(pk, msg, sig)
+    assert not sch.verify(pk, msg + b"x", sig)
+    # pub roundtrip through bytes
+    assert sch.verify_beacon(sch.public_bytes(pk), 42,
+                             b"prev-sig-bytes" if sch.chained else None, sig)
+
+
+def test_randomness_from_signature():
+    sig = b"\x01" * 96
+    assert randomness_from_signature(sig) == hashlib.sha256(sig).digest()
+
+
+def test_default_scheme():
+    assert get_scheme_by_id_with_default("").id == DEFAULT_SCHEME_ID
+
+
+@pytest.mark.parametrize("scheme_id", list_schemes())
+def test_tbls_roundtrip(scheme_id):
+    sch = scheme_from_name(scheme_id)
+    t, n = 3, 5
+    poly = tbls.PriPoly.random(t, secret=123456789)
+    shares = poly.shares(n)
+    pub_poly = poly.commit(sch.key_group)
+    msg = sch.digest_beacon(7, None)
+
+    partials = [tbls.sign_partial(sch, s, msg) for s in shares]
+    for p in partials:
+        assert tbls.verify_partial(sch, pub_poly, msg, p)
+    # corrupt partial fails
+    bad = bytearray(partials[0])
+    bad[0] ^= 1  # wrong index
+    assert not tbls.verify_partial(sch, pub_poly, msg, bytes(bad))
+
+    # recovery from any t partials gives the same signature as the secret key
+    expected = sch.sign(poly.secret(), msg)
+    for subset in ([0, 1, 2], [2, 3, 4], [4, 0, 2]):
+        sig = tbls.recover(sch, pub_poly, msg, [partials[i] for i in subset], t, n)
+        assert sig == expected
+    assert tbls.verify_recovered(sch, pub_poly.public_key(), msg, expected)
+
+    with pytest.raises(ValueError):
+        tbls.recover(sch, pub_poly, msg, partials[:t - 1], t, n)

@@ -1,0 +1,563 @@
+"""Derive the BLS12-381 G1 11-isogeny map (RFC 9380 appendix E.2 equivalent).
+
+With no network access and no local copy of the RFC constants, we *derive* the
+isogeny from first principles:
+
+  1. Build the 11-division polynomial of E1': y^2 = x^3 + A*x + B
+     (A = ISO_A1, B = ISO_B1 from params).
+  2. Factor out the degree-5 kernel polynomial(s) (x-coords of the order-11
+     subgroups) via distinct/equal-degree factorization.
+  3. Apply Velu/Kohel's formulas to get the normalized isogeny x-map
+     N(x)/h(x)^2 and y-map y*(N'h - 2Nh')/h^3, and the codomain curve.
+  4. Post-compose with the isomorphism (x,y) -> (c^2 x, c^3 y) landing on
+     E1: y^2 = x^3 + 4, enumerating all 6th roots c (automorphism ambiguity).
+  5. Disambiguate the candidate maps end-to-end against the public drand
+     mainnet G1-scheme beacon (crypto/schemes_test.go round-3 vector): only
+     the RFC 9380 map makes the real-world signature verify.
+
+Writes drand_tpu/crypto/host/_iso_g1.py.  Run once: python tools/derive_isogeny.py
+"""
+
+import sys, os, random, time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from drand_tpu.crypto.host.params import P, ISO_A1, ISO_B1
+random.seed(1138)
+
+# ---------------------------------------------------------------------------
+# Dense polynomial arithmetic over Fp (lists, constant term first)
+# ---------------------------------------------------------------------------
+
+def pnorm(a):
+    while a and a[-1] == 0:
+        a.pop()
+    return a
+
+def padd(a, b):
+    n = max(len(a), len(b))
+    return pnorm([((a[i] if i < len(a) else 0) + (b[i] if i < len(b) else 0)) % P for i in range(n)])
+
+def psub(a, b):
+    n = max(len(a), len(b))
+    return pnorm([((a[i] if i < len(a) else 0) - (b[i] if i < len(b) else 0)) % P for i in range(n)])
+
+def pmul(a, b):
+    if not a or not b:
+        return []
+    out = [0] * (len(a) + len(b) - 1)
+    for i, ai in enumerate(a):
+        if ai:
+            for j, bj in enumerate(b):
+                out[i + j] = (out[i + j] + ai * bj) % P
+    return pnorm(out)
+
+def pscale(a, k):
+    k %= P
+    return pnorm([ai * k % P for ai in a])
+
+def pdivmod(a, b):
+    """quotient, remainder; b nonzero."""
+    a = a[:]
+    db, da = len(b) - 1, len(a) - 1
+    if da < db:
+        return [], pnorm(a)
+    binv = pow(b[-1], P - 2, P)
+    q = [0] * (da - db + 1)
+    for k in range(da - db, -1, -1):
+        if len(a) - 1 < db + k:
+            continue
+        c = a[db + k] * binv % P
+        if c == 0:
+            continue
+        q[k] = c
+        for j in range(db + 1):
+            a[k + j] = (a[k + j] - c * b[j]) % P
+        pnorm(a)
+    return pnorm(q), pnorm(a)
+
+def pmod(a, b):
+    return pdivmod(a, b)[1]
+
+def pgcd(a, b):
+    while b:
+        a, b = b, pmod(a, b)
+    if a:
+        a = pscale(a, pow(a[-1], P - 2, P))  # monic
+    return a
+
+def pmulmod(a, b, m):
+    return pmod(pmul(a, b), m)
+
+def ppowmod(a, e, m):
+    out = [1]
+    base = pmod(a, m)
+    while e:
+        if e & 1:
+            out = pmulmod(out, base, m)
+        base = pmulmod(base, base, m)
+        e >>= 1
+    return out
+
+def pderiv(a):
+    return pnorm([a[i] * i % P for i in range(1, len(a))])
+
+def peval(a, x):
+    acc = 0
+    for c in reversed(a):
+        acc = (acc * x + c) % P
+    return acc
+
+# ---------------------------------------------------------------------------
+# Division polynomial psi_11 for y^2 = x^3 + Ax + B
+# Representation: (poly, e) meaning poly(x) * (2y)^e, e in {0,1}; (2y)^2 = 4F.
+# ---------------------------------------------------------------------------
+
+A, B = ISO_A1, ISO_B1
+Fpoly = [B % P, A % P, 0, 1]  # x^3 + Ax + B
+F4 = pscale(Fpoly, 4)
+
+def ymul(a, b):
+    pa, ea = a
+    pb, eb = b
+    e = ea + eb
+    out = pmul(pa, pb)
+    while e >= 2:
+        out = pmul(out, F4)
+        e -= 2
+    return (out, e)
+
+def ysub(a, b):
+    assert a[1] == b[1], "parity mismatch"
+    return (psub(a[0], b[0]), a[1])
+
+def ypow(a, k):
+    out = ([1], 0)
+    for _ in range(k):
+        out = ymul(out, a)
+    return out
+
+def division_poly_11():
+    psi = {1: ([1], 0), 2: ([1], 1)}
+    psi[3] = (pnorm([(-A * A) % P, 12 * B % P, 6 * A % P, 0, 3]), 0)
+    g4 = pnorm([(-8 * B * B - A**3) % P, (-4 * A * B) % P, (-5 * A * A) % P,
+                20 * B % P, 5 * A % P, 0, 1])
+    psi[4] = (pscale(g4, 2), 1)  # psi4 = 2*(2y)*g4 = 4y*g4
+    # psi5 = psi4*psi2^3 - psi1*psi3^3
+    psi[5] = ysub(ymul(psi[4], ypow(psi[2], 3)), ypow(psi[3], 3))
+    # psi6 = psi3*(psi5*psi2^2 - psi1*psi4^2)/psi2  -> compute via generic rule:
+    # psi_{2m} = psi_m*(psi_{m+2}*psi_{m-1}^2 - psi_{m-2}*psi_{m+1}^2)/(2y)
+    def even(m):
+        num = ysub(ymul(psi[m + 2], ypow(psi[m - 1], 2)), ymul(psi[m - 2], ypow(psi[m + 1], 2)))
+        prod = ymul(psi[m], num)
+        pp, e = prod
+        assert e == 1, f"even psi_{2*m} parity {e}"
+        return (pp, 1)  # dividing by (2y) then multiplying by... keep as is
+    # careful: psi_{2m} = psi_m * num / (2y).  prod = psi_m*num has e==1 meaning
+    # poly*(2y); dividing by (2y) leaves a pure polynomial -> but psi_even must
+    # carry a 2y factor.  Resolve parities explicitly below instead.
+    # m=3: psi6 = psi3*num/(2y); num = psi5*psi2^2 - psi1*psi4^2
+    num = ysub(ymul(psi[5], ypow(psi[2], 2)), ypow(psi[4], 2))
+    # num has e=0 (both terms even powers of 2y)
+    assert num[1] == 0
+    # psi3*num is pure; dividing by 2y... psi6 = (2y)*g6 requires num divisible by 4F
+    q, r = pdivmod(pmul(psi[3][0], num[0]), F4)
+    assert not r, "psi6: expected divisibility by 4F"
+    psi[6] = (q, 1)  # psi6 = psi3*num/(2y) = (2y)*[psi3*num/4F]
+    # psi7 = psi5*psi3^3 - psi2*psi4^3   (m=3)
+    psi[7] = ysub(ymul(psi[5], ypow(psi[3], 3)), ymul(psi[2], ypow(psi[4], 3)))
+    # psi11 = psi7*psi5^3 - psi4*psi6^3  (m=5)
+    p11 = ysub(ymul(psi[7], ypow(psi[5], 3)), ymul(psi[4], ypow(psi[6], 3)))
+    assert p11[1] == 0
+    return p11[0]
+
+# ---------------------------------------------------------------------------
+# Factorization helpers
+# ---------------------------------------------------------------------------
+
+def frobenius_powers(m):
+    """x^(p^k) mod m for k = 1..5 via modular composition."""
+    xp = ppowmod([0, 1], P, m)
+    frob = [None, xp]
+    for k in range(2, 6):
+        frob.append(pcompose(frob[k - 1], xp, m))
+    return frob
+
+def pcompose(f, g, m):
+    """f(g(x)) mod m via Horner."""
+    acc = []
+    for c in reversed(f):
+        acc = padd(pmulmod(acc, g, m), [c])
+    return pmod(acc, m)
+
+def equal_degree_split(f, d):
+    """Cantor-Zassenhaus: f = product of irreducibles of degree d; return factors."""
+    n = len(f) - 1
+    if n == d:
+        return [f]
+    while True:
+        g = [random.randrange(P) for _ in range(n)]
+        g = pnorm(g)
+        e = (pow(P, d) - 1) // 2
+        h = ppowmod(g, e, f)
+        h = psub(h, [1])
+        c = pgcd(h, f)
+        if c and 0 < len(c) - 1 < n:
+            q, r = pdivmod(f, c)
+            assert not r
+            return equal_degree_split(c, d) + equal_degree_split(pscale(q, pow(q[-1], P-2, P)), d)
+
+# ---------------------------------------------------------------------------
+# Velu/Kohel isogeny from kernel polynomial
+# ---------------------------------------------------------------------------
+
+def newton_power_sums(h, upto):
+    """p1..p_upto for monic h of degree d (roots with multiplicity)."""
+    d = len(h) - 1
+    # h = x^d + c_{d-1} x^{d-1} + ... ; e_k = (-1)^k * c_{d-k}
+    e = [0] * (d + 1)
+    e[0] = 1
+    for k in range(1, d + 1):
+        e[k] = (-1) ** k * h[d - k] % P
+    ps = [0] * (upto + 1)
+    for k in range(1, upto + 1):
+        s = 0
+        for i in range(1, min(k, d)):
+            s += (-1) ** (i - 1) * e[i] * ps[k - i]
+        if k <= d:
+            s += (-1) ** (k - 1) * k * e[k]
+        ps[k] = s % P
+    return ps
+
+def _sum_over_kernel_roots(num, den, h, psums):
+    """sum over roots alpha of h of num(alpha)/den(alpha), via reduction mod h
+    and power sums of h's roots."""
+    dinv = pinvmod(den, h)
+    c = pmulmod(num, dinv, h)
+    # sum_j c_j * p_j  (p_0 = deg h)
+    total = 0
+    d = len(h) - 1
+    for j, cj in enumerate(c):
+        total += cj * (d if j == 0 else psums[j])
+    return total % P
+
+
+def pinvmod(a, m):
+    """inverse of a mod m (extended euclid over Fp[x])."""
+    r0, r1 = m[:], pmod(a, m)
+    s0, s1 = [], [1]
+    while r1:
+        q, r2 = pdivmod(r0, r1)
+        r0, r1 = r1, r2
+        s0, s1 = s1, psub(s0, pmul(q, s1))
+    # r0 = gcd (degree 0 expected)
+    assert len(r0) == 1, "not invertible mod h"
+    return pscale(s0, pow(r0[0], P - 2, P))
+
+
+def lagrange_interp(pts):
+    """Polynomial through points [(x_i, y_i)] mod p (O(n^2))."""
+    n = len(pts)
+    poly = []
+    for i, (xi, yi) in enumerate(pts):
+        # basis poly prod_{j!=i} (x - x_j)/(x_i - x_j)
+        num = [1]
+        denom = 1
+        for j, (xj, _) in enumerate(pts):
+            if j == i:
+                continue
+            num = pmul(num, [(-xj) % P, 1])
+            denom = denom * (xi - xj) % P
+        poly = padd(poly, pscale(num, yi * pow(denom, P - 2, P) % P))
+    return poly
+
+
+def velu_from_kernel(h):
+    """Normalized Velu isogeny with kernel poly h, built numerically from
+    phi(x) = x + sum_{Q != O} (x_{P+Q} - x_Q).  Returns (Nx, Dx, b_codomain)."""
+    d = len(h) - 1
+    psums = newton_power_sums(h, d + 3)
+    h2 = pmul(h, h)
+
+    def phi_at(x0):
+        f0 = peval(Fpoly, x0)
+        # per +-pair of kernel points with x-coord alpha:
+        #   (x_{P+Q} - alpha) + (x_{P-Q} - alpha)
+        #     = 2(F(x0)+F(alpha))/(x0-alpha)^2 - 2*x0 - 4*alpha
+        # Sum over roots alpha of h.
+        num = padd([2 * f0 % P], pscale(Fpoly, 2))            # 2F(x0) + 2F(alpha)
+        den = pmul([(-x0) % P, 1], [(-x0) % P, 1])            # (alpha - x0)^2
+        s = _sum_over_kernel_roots(num, den, h, psums)
+        s = (s - 2 * x0 * d - 4 * psums[1]) % P
+        return (x0 + s) % P
+
+    # interpolate N(x) = phi(x) * h(x)^2, degree 2d+1
+    pts = []
+    x0 = 7
+    while len(pts) < 2 * d + 2 + 3:
+        if peval(h, x0) != 0:
+            pts.append((x0, phi_at(x0) * peval(h2, x0) % P))
+        x0 += 1
+    Nx = lagrange_interp(pts[: 2 * d + 2])
+    for xv, yv in pts[2 * d + 2:]:
+        assert peval(Nx, xv) == yv, "interpolation inconsistent"
+    assert len(Nx) - 1 == 2 * d + 1, f"unexpected deg Nx = {len(Nx)-1}"
+
+    # codomain b from a sample image point: y-map = y * phi'(x)
+    hp = pderiv(h)
+    My = psub(pmul(pderiv(Nx), h), pscale(pmul(Nx, hp), 2))   # (N'h - 2Nh')
+    Ky = pmul(h2, h)
+    while True:
+        xs, ys = sample_point_Eprime()
+        if peval(h, xs) == 0:
+            continue
+        xo = peval(Nx, xs) * pow(peval(h2, xs), P - 2, P) % P
+        yo = ys * peval(My, xs) % P * pow(peval(Ky, xs), P - 2, P) % P
+        b_cod = (yo * yo - pow(xo, 3, P)) % P
+        # sanity on a second point
+        xs2, ys2 = sample_point_Eprime()
+        if peval(h, xs2) == 0:
+            continue
+        xo2 = peval(Nx, xs2) * pow(peval(h2, xs2), P - 2, P) % P
+        yo2 = ys2 * peval(My, xs2) % P * pow(peval(Ky, xs2), P - 2, P) % P
+        assert (yo2 * yo2 - pow(xo2, 3, P)) % P == b_cod, "codomain has a != 0?"
+        return (Nx, h2, b_cod)
+
+def sample_point_Eprime():
+    while True:
+        x = random.randrange(P)
+        fy = peval(Fpoly, x)
+        y = pow(fy, (P + 1) // 4, P)
+        if y * y % P == fy:
+            return (x, y)
+
+def main():
+    t0 = time.time()
+    print("building psi_11 ...")
+    psi11 = division_poly_11()
+    print(f"  deg = {len(psi11)-1}  ({time.time()-t0:.1f}s)")
+    assert len(psi11) - 1 == 60
+    psi11 = pscale(psi11, pow(psi11[-1], P - 2, P))
+
+    print("computing Frobenius powers mod psi_11 ...")
+    frob = frobenius_powers(psi11)
+    print(f"  done ({time.time()-t0:.1f}s)")
+
+    kernels = []
+    g1 = pgcd(psub(frob[1], [0, 1]), psi11)
+    print(f"deg of rational-root part: {len(g1)-1 if g1 else 0}")
+    if g1 and len(g1) - 1 == 5:
+        # exactly one kernel's worth of rational x-coords: g1 IS the kernel poly
+        kernels.append(g1)
+    else:
+        if g1:
+            raise NotImplementedError(f"unexpected rational-root degree {len(g1)-1}")
+        # degree-5 orbits: x-coords fixed by frob^5
+        g5 = pgcd(psub(frob[5], [0, 1]), psi11)
+        print(f"deg fixed by frob^5: {len(g5)-1 if g5 else 0}")
+        if g5 and (len(g5) - 1) % 5 == 0 and len(g5) > 1:
+            kernels.extend(equal_degree_split(g5, 5))
+    print(f"candidate kernel polys: {len(kernels)}  ({time.time()-t0:.1f}s)")
+
+    results = []
+    for h in kernels:
+        out = velu_from_kernel(h)
+        if out is None:
+            print("  kernel rejected (codomain not j=0)")
+            continue
+        Nx, Dx, b_cod = out
+        results.append((h, Nx, Dx, b_cod))
+        print(f"  kernel ok: codomain b = {hex(b_cod)[:20]}...")
+
+    candidates = []
+    for h, Nx, Dx, b_cod in results:
+        # isomorphism (x,y)->(c^2 x, c^3 y) sends y^2=x^3+b to y^2=x^3+c^6*b,
+        # so land on b=4 with c^6 = 4 / b_cod
+        target = 4 * pow(b_cod, P - 2, P) % P
+        # find all 6th roots of target in Fp
+        roots = nth_roots(target, 6)
+        print(f"  {len(roots)} sixth-roots of b_cod/4")
+        hp = pderiv(h)
+        # y-map numerator/denominator: y * (Nx' h - 2 Nx h') / h^3
+        My = psub(pmul(pderiv(Nx), h), pscale(pmul(Nx, hp), 2))
+        Ky = pmul(pmul(h, h), h)
+        for c in roots:
+            c2, c3 = c * c % P, pow(c, 3, P)
+            cand = (pscale(Nx, c2), Dx, pscale(My, c3), Ky)
+            # sanity: maps E' points onto E
+            ok = True
+            for _ in range(4):
+                x, y = sample_point_Eprime()
+                xo = peval(cand[0], x) * pow(peval(cand[1], x), P - 2, P) % P
+                yo = y * peval(cand[2], x) % P * pow(peval(cand[3], x), P - 2, P) % P
+                if (yo * yo - xo**3 - 4) % P:
+                    ok = False
+                    break
+            if ok:
+                candidates.append(cand)
+        print(f"  validated candidates so far: {len(candidates)}")
+
+    print(f"total on-curve candidate maps: {len(candidates)} ({time.time()-t0:.1f}s)")
+    disambiguate(candidates)
+
+def nth_roots(a, n):
+    """All n-th roots of a in Fp (p-1 divisible by 6)."""
+    if a == 0:
+        return [0]
+    # check a is an n-th power: a^((p-1)/g) == 1 with g = gcd(n, p-1)
+    from math import gcd
+    g = gcd(n, P - 1)
+    if pow(a, (P - 1) // g, P) != 1:
+        return []
+    # find one root by Tonelli-ish: n | p-1 here (p = 1 mod 6)
+    # use the fact p = 3 mod 4 and p = 1 mod 3: 6th root = sqrt(cbrt)
+    def cbrt(v):
+        # p = 1 mod 3: cube roots exist iff v^((p-1)/3)==1; find via exponent
+        if v == 0:
+            return 0
+        e = pow(v, (P - 1) // 3, P)
+        if e != 1:
+            return None
+        # write p = 3k+1; x^3 = v; if gcd(3,(p-1)/3): use Adleman-Manders-Miller lite:
+        # try exponent inv(3) mod (p-1)/3^s ... do simple search via random
+        # structure: let m = (p-1)//3; solutions are v^t where 3t = 1 mod m if gcd(3,m)=1
+        m = (P - 1) // 3
+        if m % 3 != 0:
+            t = pow(3, -1, m)
+            r = pow(v, t, P)
+            if pow(r, 3, P) == v:
+                return r
+        # fallback: AMM general
+        return amm_root(v, 3)
+    def sqrtp(v):
+        s = pow(v, (P + 1) // 4, P)
+        return s if s * s % P == v else None
+    c = cbrt(a)
+    if c is None:
+        return []
+    s = sqrtp(c)
+    if s is None:
+        # try other cube roots: multiply by primitive cube root of unity
+        w3 = find_root_of_unity(3)
+        found = None
+        for k in (1, 2):
+            cc = c * pow(w3, k, P) % P
+            s = sqrtp(cc)
+            if s is not None:
+                found = s
+                break
+        if found is None:
+            return []
+        s = found
+    w6 = find_root_of_unity(6)
+    roots = sorted({s * pow(w6, k, P) % P for k in range(6) if pow(s * pow(w6, k, P) % P, 6, P) == a})
+    return roots
+
+_rou_cache = {}
+def find_root_of_unity(n):
+    if n in _rou_cache:
+        return _rou_cache[n]
+    while True:
+        g = random.randrange(2, P)
+        r = pow(g, (P - 1) // n, P)
+        if all(pow(r, n // q, P) != 1 for q in {2, 3} if n % q == 0):
+            _rou_cache[n] = r
+            return r
+
+def amm_root(v, ell):
+    """Adleman-Manders-Miller ell-th root for ell | p-1 (returns one root or None)."""
+    t, s = P - 1, 0
+    while t % ell == 0:
+        t //= ell
+        s += 1
+    if pow(v, (P - 1) // ell, P) != 1:
+        return None
+    while True:
+        rho = random.randrange(2, P)
+        if pow(rho, (P - 1) // ell, P) != 1:
+            break
+    g = pow(rho, t, P)  # generator of the ell-Sylow subgroup (order ell^s)
+    alpha = pow(ell, -1, t)
+    x = pow(v, alpha, P)
+    c = pow(x, ell, P) * pow(v, P - 2, P) % P  # in Sylow subgroup
+    # discrete log of c base g (order ell^s), digit by digit
+    k = 0
+    gamma = pow(g, ell ** (s - 1), P)  # order ell
+    for i in range(s):
+        e = pow(c * pow(g, (-k) % (ell ** s * 1), P) % P, ell ** (s - 1 - i), P)
+        d, acc = 0, 1
+        while acc != e:
+            acc = acc * gamma % P
+            d += 1
+            assert d < ell, "dlog digit not found"
+        k += d * ell ** i
+    if k % ell != 0:
+        return None
+    m = (-(k // ell)) % (ell ** s)
+    y = pow(g, m, P)
+    root = x * y % P
+    assert pow(root, ell, P) == v
+    return root
+
+def disambiguate(candidates):
+    """Test each candidate map end-to-end on the drand G1-scheme mainnet vector."""
+    import hashlib
+    import drand_tpu.crypto.host.h2c as h2c
+    from drand_tpu.crypto.host.serialize import g1_from_bytes, g2_from_bytes
+    from drand_tpu.crypto.host.pairing import pairing_check
+    from drand_tpu.crypto.host.curve import G2 as G2curve, g1_clear_cofactor
+
+    # drand "fastnet" G1-scheme vector: round 3, bls-unchained-on-g1
+    pub = g2_from_bytes(bytes.fromhex(
+        "876f6fa8073736e22f6ff4badaab35c637503718f7a452d178ce69c45d2d8129"
+        "a54ad2f988ab10c9666f87ab603c59bf013409a5b500555da31720f8eec294d9"
+        "809b8796f40d5372c71a44ca61226f1eb978310392f98074a608747f77e66c5a"))
+    sig = g1_from_bytes(bytes.fromhex(
+        "ac7c3ca14bc88bd014260f22dc016b4fe586f9313c3a549c83d195811a99a5d2"
+        "d4999d4df6daec73ff51fafadd6d5bb5"))
+    msg = hashlib.sha256((3).to_bytes(8, "big")).digest()
+
+    dsts = [b"BLS_SIG_BLS12381G1_XMD:SHA-256_SSWU_RO_NUL_",
+            b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_NUL_"]
+    winner = None
+    for ci, cand in enumerate(candidates):
+        XN, XD, YN, YD = cand
+
+        def iso(pt):
+            if pt is None:
+                return None
+            x, y = pt
+            xo = peval(XN, x) * pow(peval(XD, x), P - 2, P) % P
+            yo = y * peval(YN, x) % P * pow(peval(YD, x), P - 2, P) % P
+            return (xo, yo)
+
+        for dst in dsts:
+            u0, u1 = h2c.hash_to_field_fp(msg, dst, 2)
+            q0 = h2c._sswu_fp(u0)
+            q1 = h2c._sswu_fp(u1)
+            r = h2c._affine_add_fp(q0, q1, A)
+            pt = g1_clear_cofactor(iso(r))
+            ok = pairing_check([(pt, pub), (h2c.G1.neg(sig), G2curve.gen)])
+            print(f"  candidate {ci} dst={dst[:24]}...: verify={ok}")
+            if ok:
+                winner = (cand, dst)
+    if winner is None:
+        print("NO CANDIDATE VERIFIED — investigate")
+        sys.exit(1)
+    (XN, XD, YN, YD), dst = winner
+    path = os.path.join(os.path.dirname(__file__), "..", "drand_tpu", "crypto", "host", "_iso_g1.py")
+    with open(path, "w") as f:
+        f.write('"""Generated by tools/derive_isogeny.py — BLS12-381 G1 11-isogeny map.\n\n')
+        f.write("Coefficient lists are constant-term-first.  Derived from the curve\n")
+        f.write("parameters via division-polynomial kernel extraction + Velu's formulas,\n")
+        f.write("pinned by the drand mainnet G1-scheme known-answer vector.\n")
+        f.write(f'Verifying DST: {dst!r}\n"""\n\n')
+        for name, coeffs in (("XNUM", XN), ("XDEN", XD), ("YNUM", YN), ("YDEN", YD)):
+            f.write(f"{name} = [\n")
+            for c in coeffs:
+                f.write(f"    0x{c:096x},\n")
+            f.write("]\n\n")
+    print(f"wrote {path}; verifying DST = {dst!r}")
+
+if __name__ == "__main__":
+    main()
