@@ -19,7 +19,7 @@ drand_tpu.crypto.jax (batch_verify / tbls kernels).
 import hashlib
 import os
 import secrets
-from dataclasses import dataclass, field as dfield
+from dataclasses import dataclass
 from typing import Optional
 
 from .host.params import R, DST_G2
@@ -95,7 +95,10 @@ class Scheme:
     def verify_beacon(self, pub_bytes_or_point, round_: int, prev_sig, sig: bytes) -> bool:
         pub = pub_bytes_or_point
         if isinstance(pub, (bytes, bytearray)):
-            pub = self.key_group.from_bytes(bytes(pub))
+            try:
+                pub = self.key_group.from_bytes(bytes(pub))
+            except (ValueError, AssertionError):
+                return False  # total predicate, like verify() on bad sig bytes
         return self.verify(pub, self.digest_beacon(round_, prev_sig), sig)
 
     # -- keys ---------------------------------------------------------------

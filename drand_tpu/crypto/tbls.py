@@ -131,9 +131,14 @@ def recover(scheme: Scheme, pub_poly: PubPoly, msg: bytes,
     have produced).  Reference call site: chain/beacon/chainstore.go:202.
     """
     good = []
+    seen = set()
     for p in partials:
+        idx = index_of(p)
+        if idx in seen:  # dedupe by signer index, like kyber's processed map
+            continue
         if verify_each and not verify_partial(scheme, pub_poly, msg, p):
             continue
+        seen.add(idx)
         good.append(p)
         if len(good) == threshold:
             break

@@ -1,0 +1,263 @@
+"""384-bit modular arithmetic on TPU: 16-bit limbs in uint32 lanes.
+
+This is the TPU-native replacement for the reference's only native code — the
+x86-64 assembly field backend of `kilic/bls12-381` (SURVEY.md §2.9,
+/root/reference/go.mod:104).  Everything above (tower, curves, pairing, tBLS)
+reduces to the ops in this file.
+
+Design, chosen for XLA/TPU semantics:
+
+* An Fp element is a ``(..., 24)`` uint32 array of base-2^16 limbs,
+  little-endian.  16-bit limbs make every partial product a_i*b_j an *exact*
+  uint32 (< 2^32), and bound every 24-term convolution column by 24·2·(2^16-1)
+  < 2^22, so the whole schoolbook multiply + Montgomery reduction runs in
+  plain uint32 vector lanes — no 64-bit emulation, no data-dependent control
+  flow, fully batchable over leading axes.
+* Montgomery form with R = 2^384.  `mont_mul` = column convolution
+  (`lax.fori_loop` of 24 shifted fused multiply-adds) followed by word-wise
+  Montgomery reduction (another 24-step loop) and a single 24-step carry
+  `lax.scan` + one conditional subtract.  All loop trip counts are static.
+* Batch-first: every function maps over arbitrary leading dims; there is no
+  per-element Python.  The unit of work the MXU/VPU sees is a (batch, 24)
+  lane-parallel op.
+
+Values are canonical (< p, limbs < 2^16) at every function boundary.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto.host.params import P
+
+NLIMB = 24
+LIMB_BITS = 16
+MASK = (1 << LIMB_BITS) - 1
+U32 = jnp.uint32
+
+# Montgomery constants (host big-int, computed once at import).
+R_MONT = (1 << (NLIMB * LIMB_BITS)) % P          # R = 2^384 mod p
+R2_MONT = (R_MONT * R_MONT) % P                  # R^2 mod p (to-Mont factor)
+N0 = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)  # -p^-1 mod 2^16
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Host: python int -> (24,) uint32 limb array (little-endian, base 2^16)."""
+    assert 0 <= x < (1 << (NLIMB * LIMB_BITS))
+    return np.array([(x >> (LIMB_BITS * i)) & MASK for i in range(NLIMB)], dtype=np.uint32)
+
+
+def limbs_to_int(a) -> int:
+    """Host: (24,) limb array -> python int (for tests / serialization)."""
+    a = np.asarray(a)
+    return sum(int(a[i]) << (LIMB_BITS * i) for i in range(NLIMB))
+
+
+P_LIMBS = jnp.asarray(int_to_limbs(P))
+
+
+def _carry_scan(cols):
+    """Normalize (..., n) uint32 columns to canonical limbs; returns (limbs, carry).
+
+    Sequential over the 24-limb axis (a 24-step `lax.scan`), vectorized over
+    all leading batch axes.  Column values may be up to 2^31.
+    """
+    x = jnp.moveaxis(cols, -1, 0)
+    carry0 = jnp.zeros(cols.shape[:-1], U32)
+
+    def step(carry, col):
+        v = col + carry
+        return v >> LIMB_BITS, v & MASK
+
+    carry, limbs = jax.lax.scan(step, carry0, x)
+    return jnp.moveaxis(limbs, 0, -1), carry
+
+
+def sub_raw(a, b):
+    """(a - b) over limbs with borrow scan; returns (diff_limbs, borrow in {0,1})."""
+    xa = jnp.moveaxis(a, -1, 0)
+    xb = jnp.moveaxis(b, -1, 0)
+    borrow0 = jnp.zeros(a.shape[:-1], U32)
+
+    def step(borrow, ab):
+        ai, bi = ab
+        d = ai + U32(1 << LIMB_BITS) - bi - borrow  # in [1, 2^17)
+        return U32(1) - (d >> LIMB_BITS), d & MASK
+
+    borrow, limbs = jax.lax.scan(step, borrow0, (xa, xb))
+    return jnp.moveaxis(limbs, 0, -1), borrow
+
+
+def add_raw(a, b):
+    """(a + b) canonical limbs + carry bit."""
+    return _carry_scan(a + b)
+
+
+def ge(a, b):
+    """a >= b elementwise over the batch; returns (...,) bool."""
+    _, borrow = sub_raw(a, b)
+    return borrow == 0
+
+
+def eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+def is_zero(a):
+    return jnp.all(a == 0, axis=-1)
+
+
+def select(cond, a, b):
+    """Branchless limb select: cond (...,) bool -> a else b."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def _cond_sub_p(limbs, carry):
+    """Given value = carry·2^384 + limbs < 2p, reduce into [0, p)."""
+    diff, borrow = sub_raw(limbs, P_LIMBS)
+    take_diff = (carry == 1) | (borrow == 0)
+    return select(take_diff, diff, limbs)
+
+
+def add_mod(a, b):
+    limbs, carry = add_raw(a, b)  # < 2p since a, b < p
+    return _cond_sub_p(limbs, carry)
+
+
+def sub_mod(a, b):
+    diff, borrow = sub_raw(a, b)
+    fixed, _ = add_raw(diff, jnp.broadcast_to(P_LIMBS, diff.shape))
+    return select(borrow == 1, fixed, diff)
+
+
+def neg_mod(a):
+    diff, _ = sub_raw(jnp.broadcast_to(P_LIMBS, a.shape), a)
+    return select(is_zero(a), a, diff)
+
+
+def _conv_columns(a, b):
+    """Schoolbook product columns: (..., 24) x (..., 24) -> (..., 48) uint32.
+
+    Column k holds sum_{i+j=k} of the 16-bit halves of a_i*b_j; every column
+    is < 2^22 so later accumulation headroom remains.
+    """
+    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    a = jnp.broadcast_to(a, shape + (NLIMB,))
+    b = jnp.broadcast_to(b, shape + (NLIMB,))
+    t = jnp.zeros(shape + (2 * NLIMB,), U32)
+    zero1 = jnp.zeros(shape + (1,), U32)
+
+    def body(i, t):
+        ai = jax.lax.dynamic_slice_in_dim(a, i, 1, axis=-1)      # (..., 1)
+        prod = ai * b                                            # exact uint32
+        lo = jnp.concatenate([prod & MASK, zero1], axis=-1)      # cols i..i+23
+        hi = jnp.concatenate([zero1, prod >> LIMB_BITS], axis=-1)  # cols i+1..i+24
+        seg = jax.lax.dynamic_slice_in_dim(t, i, NLIMB + 1, axis=-1)
+        return jax.lax.dynamic_update_slice_in_dim(t, seg + lo + hi, i, axis=-1)
+
+    return jax.lax.fori_loop(0, NLIMB, body, t)
+
+
+def mont_reduce(t):
+    """Montgomery reduction of (..., 48) columns -> canonical (..., 24) < p.
+
+    Word-by-word REDC: for each of the 24 low limbs compute
+    m = t_i · (-p^-1) mod 2^16, add m·p at offset i (killing limb i mod 2^16),
+    and push the cleared limb's high part into limb i+1.  Column magnitudes
+    stay < 2^23 throughout, so uint32 never overflows.
+    """
+    shape = t.shape[:-1]
+    p_limbs = jnp.broadcast_to(P_LIMBS, shape + (NLIMB,))
+    zero1 = jnp.zeros(shape + (1,), U32)
+
+    def body(i, t):
+        ti = jax.lax.dynamic_slice_in_dim(t, i, 1, axis=-1)       # (..., 1)
+        m = (ti * N0) & MASK
+        prod = m * p_limbs
+        lo = jnp.concatenate([prod & MASK, zero1], axis=-1)
+        hi = jnp.concatenate([zero1, prod >> LIMB_BITS], axis=-1)
+        seg = jax.lax.dynamic_slice_in_dim(t, i, NLIMB + 1, axis=-1)
+        seg = seg + lo + hi
+        # limb i is now ≡ 0 mod 2^16: carry its high part into limb i+1, drop it
+        carry = seg[..., 0:1] >> LIMB_BITS
+        seg = jnp.concatenate([zero1, seg[..., 1:2] + carry, seg[..., 2:]], axis=-1)
+        return jax.lax.dynamic_update_slice_in_dim(t, seg, i, axis=-1)
+
+    t = jax.lax.fori_loop(0, NLIMB, body, t)
+    limbs, carry = _carry_scan(t[..., NLIMB:])
+    return _cond_sub_p(limbs, carry)
+
+
+def mont_mul(a, b):
+    """Montgomery product  a·b·R^-1 mod p  on canonical limb tensors."""
+    return mont_reduce(_conv_columns(a, b))
+
+
+def mont_sqr(a):
+    return mont_mul(a, a)
+
+
+R2_LIMBS = jnp.asarray(int_to_limbs(R2_MONT))
+ONE_M = jnp.asarray(int_to_limbs(R_MONT))        # 1 in Montgomery form
+ZERO = jnp.zeros(NLIMB, U32)
+
+
+def to_mont(a):
+    """Canonical residue limbs -> Montgomery form."""
+    return mont_mul(a, jnp.broadcast_to(R2_LIMBS, a.shape))
+
+
+def from_mont(a):
+    """Montgomery form -> canonical residue limbs (mont-mul by 1)."""
+    one = jnp.zeros_like(a).at[..., 0].set(1)
+    return mont_mul(a, one)
+
+
+def _exp_bits(e: int, nbits: int | None = None) -> np.ndarray:
+    """Host: fixed exponent -> MSB-first bit array for pow scans."""
+    if nbits is None:
+        nbits = max(e.bit_length(), 1)
+    return np.array([(e >> (nbits - 1 - i)) & 1 for i in range(nbits)], dtype=np.uint32)
+
+
+def pow_fixed(a, e: int):
+    """a^e (Montgomery domain) for a *static* exponent, via an MSB-first
+    square-and-multiply `lax.scan`.  ~2·log2(e) mont_muls, no branches."""
+    bits = jnp.asarray(_exp_bits(e))
+    acc0 = jnp.broadcast_to(ONE_M, a.shape)
+
+    def step(acc, bit):
+        acc = mont_mul(acc, acc)
+        acc = select(bit == 1, mont_mul(acc, a), acc)
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, acc0, bits)
+    return acc
+
+
+def inv_mod(a):
+    """a^-1 in Montgomery domain (Fermat); 0 -> 0."""
+    return pow_fixed(a, P - 2)
+
+
+# Host-side convenience: pack python ints into (batched) Montgomery limbs.
+def encode_mont(xs) -> jnp.ndarray:
+    """Host: int or list of ints -> Montgomery limb tensor on device."""
+    if isinstance(xs, int):
+        return jnp.asarray(int_to_limbs(xs * R_MONT % P))
+    arr = np.stack([int_to_limbs(x * R_MONT % P) for x in xs])
+    return jnp.asarray(arr)
+
+
+R_INV = pow(R_MONT, -1, P)
+
+
+def decode_mont(a) -> list:
+    """Host: Montgomery limb tensor -> python ints (pure host math — no device
+    dispatch, so it never triggers an eager recompile)."""
+    c = np.asarray(a)
+    flat = c.reshape(-1, NLIMB)
+    out = [limbs_to_int(row) * R_INV % P for row in flat]
+    return out[0] if c.ndim == 1 else out

@@ -1,0 +1,285 @@
+"""Device-side BLS12-381 field tower: Fp2, Fp6, Fp12 over the limb engine.
+
+Same tower layout as the host golden reference (crypto/host/field.py) and the
+reference's kyber-bls12381 dependency (SURVEY.md §2.9):
+
+  Fp2  : (c0, c1)            c0 + c1·u,          u^2 = -1
+  Fp6  : (a, b, c) of Fp2    a + b·v + c·v^2,    v^3 = xi = 1 + u
+  Fp12 : (a, b)   of Fp6     a + b·w,            w^2 = v
+
+Every Fp leaf is a ``(..., 24)`` uint32 Montgomery limb tensor (see limbs.py);
+elements are plain nested tuples, so they are JAX pytrees and flow through
+`jit` / `vmap` / `lax.scan` unchanged.  All formulas are branch-free.
+"""
+
+import jax.numpy as jnp
+
+from . import limbs as L
+from ..crypto.host.params import P
+
+# ---------------------------------------------------------------------------
+# Fp2
+# ---------------------------------------------------------------------------
+
+
+def fp2(c0, c1):
+    return (c0, c1)
+
+
+def fp2_zeros(shape=()):
+    z = jnp.zeros(shape + (L.NLIMB,), L.U32)
+    return (z, z)
+
+
+def fp2_ones(shape=()):
+    one = jnp.broadcast_to(L.ONE_M, shape + (L.NLIMB,))
+    z = jnp.zeros(shape + (L.NLIMB,), L.U32)
+    return (one, z)
+
+
+def fp2_add(a, b):
+    return (L.add_mod(a[0], b[0]), L.add_mod(a[1], b[1]))
+
+
+def fp2_sub(a, b):
+    return (L.sub_mod(a[0], b[0]), L.sub_mod(a[1], b[1]))
+
+
+def fp2_neg(a):
+    return (L.neg_mod(a[0]), L.neg_mod(a[1]))
+
+
+def fp2_mul(a, b):
+    t0 = L.mont_mul(a[0], b[0])
+    t1 = L.mont_mul(a[1], b[1])
+    t2 = L.mont_mul(L.add_mod(a[0], a[1]), L.add_mod(b[0], b[1]))
+    return (L.sub_mod(t0, t1), L.sub_mod(L.sub_mod(t2, t0), t1))
+
+
+def fp2_sqr(a):
+    # (a0+a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u
+    c0 = L.mont_mul(L.add_mod(a[0], a[1]), L.sub_mod(a[0], a[1]))
+    t = L.mont_mul(a[0], a[1])
+    return (c0, L.add_mod(t, t))
+
+
+def fp2_mul_fp(a, k):
+    """Multiply by an Fp element (Montgomery limbs)."""
+    return (L.mont_mul(a[0], k), L.mont_mul(a[1], k))
+
+
+def fp2_conj(a):
+    return (a[0], L.neg_mod(a[1]))
+
+
+def fp2_mul_xi(a):
+    """Multiply by xi = 1 + u:  (c0 - c1) + (c0 + c1) u."""
+    return (L.sub_mod(a[0], a[1]), L.add_mod(a[0], a[1]))
+
+
+def fp2_inv(a):
+    norm = L.add_mod(L.mont_sqr(a[0]), L.mont_sqr(a[1]))
+    ninv = L.inv_mod(norm)
+    return (L.mont_mul(a[0], ninv), L.neg_mod(L.mont_mul(a[1], ninv)))
+
+
+def fp2_is_zero(a):
+    return L.is_zero(a[0]) & L.is_zero(a[1])
+
+
+def fp2_eq(a, b):
+    return L.eq(a[0], b[0]) & L.eq(a[1], b[1])
+
+
+def fp2_select(cond, a, b):
+    return (L.select(cond, a[0], b[0]), L.select(cond, a[1], b[1]))
+
+
+def fp2_double(a):
+    return fp2_add(a, a)
+
+
+def fp2_triple(a):
+    return fp2_add(fp2_add(a, a), a)
+
+
+def fp2_half(a):
+    """Divide by 2 (multiply by the Fp constant (p+1)/2 in Montgomery form)."""
+    return fp2_mul_fp(a, _HALF)
+
+
+_HALF = L.encode_mont((P + 1) // 2)
+
+
+# ---------------------------------------------------------------------------
+# Fp6 = Fp2[v]/(v^3 - xi)
+# ---------------------------------------------------------------------------
+
+
+def fp6_zeros(shape=()):
+    z = fp2_zeros(shape)
+    return (z, z, z)
+
+
+def fp6_ones(shape=()):
+    return (fp2_ones(shape), fp2_zeros(shape), fp2_zeros(shape))
+
+
+def fp6_add(a, b):
+    return tuple(fp2_add(x, y) for x, y in zip(a, b))
+
+
+def fp6_sub(a, b):
+    return tuple(fp2_sub(x, y) for x, y in zip(a, b))
+
+
+def fp6_neg(a):
+    return tuple(fp2_neg(x) for x in a)
+
+
+def fp6_mul(a, b):
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t0 = fp2_mul(a0, b0)
+    t1 = fp2_mul(a1, b1)
+    t2 = fp2_mul(a2, b2)
+    c0 = fp2_add(t0, fp2_mul_xi(fp2_sub(fp2_sub(fp2_mul(fp2_add(a1, a2), fp2_add(b1, b2)), t1), t2)))
+    c1 = fp2_add(fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a1), fp2_add(b0, b1)), t0), t1), fp2_mul_xi(t2))
+    c2 = fp2_add(fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a2), fp2_add(b0, b2)), t0), t2), t1)
+    return (c0, c1, c2)
+
+
+def fp6_sqr(a):
+    return fp6_mul(a, a)
+
+
+def fp6_mul_by_v(a):
+    return (fp2_mul_xi(a[2]), a[0], a[1])
+
+
+def fp6_inv(a):
+    a0, a1, a2 = a
+    c0 = fp2_sub(fp2_sqr(a0), fp2_mul_xi(fp2_mul(a1, a2)))
+    c1 = fp2_sub(fp2_mul_xi(fp2_sqr(a2)), fp2_mul(a0, a1))
+    c2 = fp2_sub(fp2_sqr(a1), fp2_mul(a0, a2))
+    t = fp2_add(fp2_mul_xi(fp2_add(fp2_mul(a1, c2), fp2_mul(a2, c1))), fp2_mul(a0, c0))
+    tinv = fp2_inv(t)
+    return (fp2_mul(c0, tinv), fp2_mul(c1, tinv), fp2_mul(c2, tinv))
+
+
+def fp6_select(cond, a, b):
+    return tuple(fp2_select(cond, x, y) for x, y in zip(a, b))
+
+
+# ---------------------------------------------------------------------------
+# Fp12 = Fp6[w]/(w^2 - v)
+# ---------------------------------------------------------------------------
+
+
+def fp12_ones(shape=()):
+    return (fp6_ones(shape), fp6_zeros(shape))
+
+
+def fp12_add(a, b):
+    return (fp6_add(a[0], b[0]), fp6_add(a[1], b[1]))
+
+
+def fp12_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = fp6_mul(a0, b0)
+    t1 = fp6_mul(a1, b1)
+    c0 = fp6_add(t0, fp6_mul_by_v(t1))
+    c1 = fp6_sub(fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1)), t0), t1)
+    return (c0, c1)
+
+
+def fp12_sqr(a):
+    a0, a1 = a
+    t = fp6_mul(a0, a1)
+    c0 = fp6_mul(fp6_add(a0, a1), fp6_add(a0, fp6_mul_by_v(a1)))
+    c0 = fp6_sub(fp6_sub(c0, t), fp6_mul_by_v(t))
+    return (c0, fp6_add(t, t))
+
+
+def fp12_conj(a):
+    return (a[0], fp6_neg(a[1]))
+
+
+def fp12_inv(a):
+    a0, a1 = a
+    t = fp6_sub(fp6_sqr(a0), fp6_mul_by_v(fp6_sqr(a1)))
+    tinv = fp6_inv(t)
+    return (fp6_mul(a0, tinv), fp6_neg(fp6_mul(a1, tinv)))
+
+
+def fp12_select(cond, a, b):
+    return (fp6_select(cond, a[0], b[0]), fp6_select(cond, a[1], b[1]))
+
+
+def fp12_is_one(a):
+    one = fp12_ones(a[0][0][0].shape[:-1])
+    flat_a = _fp12_leaves(a)
+    flat_1 = _fp12_leaves(one)
+    ok = None
+    for x, y in zip(flat_a, flat_1):
+        e = L.eq(x, y)
+        ok = e if ok is None else ok & e
+    return ok
+
+
+def _fp12_leaves(a):
+    (x0, x1, x2), (y0, y1, y2) = a
+    return [c for fp2c in (x0, x1, x2, y0, y1, y2) for c in fp2c]
+
+
+# ---------------------------------------------------------------------------
+# Frobenius (device constants precomputed on host via the golden field code)
+# ---------------------------------------------------------------------------
+
+from ..crypto.host import field as HF  # host golden code for constants only
+
+
+def _enc_fp2(c):
+    return (L.encode_mont(c[0]), L.encode_mont(c[1]))
+
+
+_FROB_DEV = {j: [_enc_fp2(c) for c in HF._FROB[j]] for j in (1, 2, 3)}
+
+
+def fp12_frobenius(a, j=1):
+    """a^(p^j), j in {1,2,3}; mirrors the host fp12_frobenius."""
+    g = _FROB_DEV[j]
+    (c0, c2, c4), (c1, c3, c5) = a
+    cs = [c0, c1, c2, c3, c4, c5]
+    out = []
+    for i, c in enumerate(cs):
+        cc = fp2_conj(c) if j & 1 else c
+        out.append(fp2_mul(cc, g[i]))
+    return ((out[0], out[2], out[4]), (out[1], out[3], out[5]))
+
+
+# Host <-> device conversion helpers (tests, serialization).
+
+def encode_fp2(c):
+    return _enc_fp2(c)
+
+
+def decode_fp2(a):
+    return (L.decode_mont(a[0]), L.decode_mont(a[1]))
+
+
+def encode_fp12(f):
+    (a0, a1, a2), (b0, b1, b2) = f
+    return (
+        (_enc_fp2(a0), _enc_fp2(a1), _enc_fp2(a2)),
+        (_enc_fp2(b0), _enc_fp2(b1), _enc_fp2(b2)),
+    )
+
+
+def decode_fp12(f):
+    (a0, a1, a2), (b0, b1, b2) = f
+    return (
+        (decode_fp2(a0), decode_fp2(a1), decode_fp2(a2)),
+        (decode_fp2(b0), decode_fp2(b1), decode_fp2(b2)),
+    )

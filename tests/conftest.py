@@ -1,0 +1,14 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Tests must be deterministic and runnable without TPU hardware; multi-chip
+sharding tests use the 8 virtual CPU devices.  The real-chip path is exercised
+by bench.py / __graft_entry__.py instead.
+"""
+
+import os
+
+# Must run before the first `import jax` anywhere in the test session.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)

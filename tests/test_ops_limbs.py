@@ -1,0 +1,121 @@
+"""Device limb engine + field tower vs the host golden reference.
+
+Everything is exercised under `jax.jit` — the only supported usage mode (the
+loop bodies close over operand tensors, so eager calls would recompile per
+call; production code jits whole pipelines).
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from drand_tpu.crypto.host import field as HF
+from drand_tpu.crypto.host.params import P
+from drand_tpu.ops import limbs as L
+from drand_tpu.ops import tower as T
+
+random.seed(1234)
+
+
+def rint():
+    return random.randrange(P)
+
+
+def rfp2():
+    return (rint(), rint())
+
+
+def rfp12():
+    return (tuple(rfp2() for _ in range(3)), tuple(rfp2() for _ in range(3)))
+
+
+# -- limb engine -------------------------------------------------------------
+
+mont_mul_j = jax.jit(L.mont_mul)
+add_mod_j = jax.jit(L.add_mod)
+sub_mod_j = jax.jit(L.sub_mod)
+neg_mod_j = jax.jit(L.neg_mod)
+inv_mod_j = jax.jit(L.inv_mod)
+
+
+class TestLimbs:
+    def test_roundtrip(self):
+        xs = [0, 1, P - 1, rint(), rint()]
+        for x in xs:
+            assert L.limbs_to_int(L.int_to_limbs(x)) == x
+
+    def test_mont_mul_batch(self):
+        xs = [rint() for _ in range(16)] + [0, 1, P - 1]
+        ys = [rint() for _ in range(16)] + [P - 1, 1, P - 1]
+        a, b = L.encode_mont(xs), L.encode_mont(ys)
+        got = L.decode_mont(mont_mul_j(a, b))
+        assert got == [x * y % P for x, y in zip(xs, ys)]
+
+    def test_add_sub_neg(self):
+        xs = [rint() for _ in range(8)] + [0, P - 1]
+        ys = [rint() for _ in range(8)] + [0, P - 1]
+        a, b = L.encode_mont(xs), L.encode_mont(ys)
+        assert L.decode_mont(add_mod_j(a, b)) == [(x + y) % P for x, y in zip(xs, ys)]
+        assert L.decode_mont(sub_mod_j(a, b)) == [(x - y) % P for x, y in zip(xs, ys)]
+        assert L.decode_mont(neg_mod_j(a)) == [(P - x) % P for x in xs]
+
+    def test_inv(self):
+        xs = [rint() for _ in range(4)] + [1, P - 1]
+        a = L.encode_mont(xs)
+        assert L.decode_mont(inv_mod_j(a)) == [pow(x, P - 2, P) for x in xs]
+
+    def test_inv_zero_is_zero(self):
+        assert L.decode_mont(inv_mod_j(L.encode_mont(0))) == 0
+
+    def test_pow_fixed(self):
+        e = 0xD201000000010000
+        xs = [rint() for _ in range(4)]
+        a = L.encode_mont(xs)
+        got = L.decode_mont(jax.jit(lambda v: L.pow_fixed(v, e))(a))
+        assert got == [pow(x, e, P) for x in xs]
+
+
+# -- tower -------------------------------------------------------------------
+
+fp2_mul_j = jax.jit(T.fp2_mul)
+fp2_sqr_j = jax.jit(T.fp2_sqr)
+fp2_inv_j = jax.jit(T.fp2_inv)
+fp12_mul_j = jax.jit(T.fp12_mul)
+fp12_sqr_j = jax.jit(T.fp12_sqr)
+fp12_inv_j = jax.jit(T.fp12_inv)
+frob_j = jax.jit(T.fp12_frobenius, static_argnums=1)
+
+
+class TestTower:
+    def test_fp2(self):
+        for _ in range(3):
+            x, y = rfp2(), rfp2()
+            a, b = T.encode_fp2(x), T.encode_fp2(y)
+            assert T.decode_fp2(fp2_mul_j(a, b)) == HF.fp2_mul(x, y)
+            assert T.decode_fp2(fp2_sqr_j(a)) == HF.fp2_sqr(x)
+            assert T.decode_fp2(fp2_inv_j(a)) == HF.fp2_inv(x)
+
+    def test_fp2_xi_conj(self):
+        x = rfp2()
+        a = T.encode_fp2(x)
+        assert T.decode_fp2(jax.jit(T.fp2_mul_xi)(a)) == HF.fp2_mul_xi(x)
+        assert T.decode_fp2(jax.jit(T.fp2_conj)(a)) == HF.fp2_conj(x)
+
+    def test_fp12(self):
+        x, y = rfp12(), rfp12()
+        a, b = T.encode_fp12(x), T.encode_fp12(y)
+        assert T.decode_fp12(fp12_mul_j(a, b)) == HF.fp12_mul(x, y)
+        assert T.decode_fp12(fp12_sqr_j(a)) == HF.fp12_sqr(x)
+        assert T.decode_fp12(fp12_inv_j(a)) == HF.fp12_inv(x)
+
+    def test_frobenius(self):
+        x = rfp12()
+        a = T.encode_fp12(x)
+        for j in (1, 2, 3):
+            assert T.decode_fp12(frob_j(a, j)) == HF.fp12_frobenius(x, j)
+
+    def test_is_one(self):
+        assert bool(jax.jit(T.fp12_is_one)(T.fp12_ones()))
+        assert not bool(jax.jit(T.fp12_is_one)(T.encode_fp12(rfp12())))

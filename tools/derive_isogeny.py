@@ -222,7 +222,7 @@ def newton_power_sums(h, upto):
     ps = [0] * (upto + 1)
     for k in range(1, upto + 1):
         s = 0
-        for i in range(1, min(k, d)):
+        for i in range(1, min(k, d + 1)):  # k>d: full Newton sum i=1..d
             s += (-1) ** (i - 1) * e[i] * ps[k - i]
         if k <= d:
             s += (-1) ** (k - 1) * k * e[k]
