@@ -1,0 +1,370 @@
+"""Batched beacon verification / signing / tBLS recovery on TPU.
+
+This is the framework's first-class new op (SURVEY.md §7 stage 2): the
+reference verifies beacons one CPU pairing at a time
+(client/verify.go:139-160 chain catch-up; chain/beacon/sync_manager.go:406
+sync streams; chainstore.go:202-207 partial recovery) — here whole batches
+run as one XLA program, and N verification equations are collapsed to a
+single 2-pairing check via a random linear combination:
+
+    forall i:  e(-g1, S_i) · e(pk, H_i) == 1
+    ==>  e(-g1, sum r_i·S_i) · e(pk, sum r_i·H_i) == 1      (r_i random)
+
+which is sound except with probability ~2^-SECURITY_BITS, because pk is the
+same point for every round of a chain.  On RLC failure we fall back to exact
+per-round pairing checks to locate the bad rounds.
+
+Host/device split: SHA-256 digests, point (de)compression and Lagrange/RLC
+scalar arithmetic mod r stay on host; all curve/pairing algebra runs on
+device.  Batch sizes are padded to powers of two to bound recompiles.
+"""
+
+import secrets
+from functools import lru_cache
+
+import jax
+import numpy as np
+
+from .host import curve as C
+from .host import serialize as S
+from .host.params import P, R, G1_GEN, G2_GEN
+from .schemes import Scheme, GroupG1, GroupG2
+from . import tbls as HT
+from ..ops import curve as DC
+from ..ops import h2c as DH
+from ..ops import limbs as L
+from ..ops import pairing as DP
+
+SECURITY_BITS = 128  # RLC randomizer width
+_MIN_BATCH = 8
+
+_NEG_G1 = C.G1.neg(G1_GEN)
+_NEG_G2 = C.G2.neg(G2_GEN)
+
+
+def _pad_len(n: int) -> int:
+    m = _MIN_BATCH
+    while m < n:
+        m *= 2
+    return m
+
+
+def _rlc_scalars(n: int, pad: int):
+    ks = [secrets.randbits(SECURITY_BITS) for _ in range(n)] + [0] * (pad - n)
+    return DC.scalars_to_bits(ks, nbits=SECURITY_BITS)
+
+
+# ---------------------------------------------------------------------------
+# jitted pipelines (cached per signature-group kind; shapes are polymorphic
+# across calls of the same padded size thanks to jit's shape cache)
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=None)
+def _rlc_pipeline_g2sig():
+    """Scheme family with sigs on G2, keys on G1 (chained/unchained)."""
+
+    def run(sig_jac, u0, u1, bits, pk_aff, neg_g1_aff):
+        sub_ok = DC.g2_in_subgroup(sig_jac)
+        hm = DH.hash_to_g2_jac(u0, u1)
+        # one ladder for both MSMs: stack sigs and H(m)s along the batch axis
+        both = jax.tree.map(lambda a, b: jax.numpy.concatenate([a, b], 0), sig_jac, hm)
+        bits2 = jax.numpy.concatenate([bits, bits], axis=1)
+        mult = DC.G2_DEV.scalar_mul_bits(both, bits2)
+        n = bits.shape[1]
+        A = DC.G2_DEV.sum_points(jax.tree.map(lambda t: t[:n], mult))
+        B = DC.G2_DEV.sum_points(jax.tree.map(lambda t: t[n:], mult))
+        ax, ay, _ = DC.G2_DEV.to_affine(A)
+        bx, by, _ = DC.G2_DEV.to_affine(B)
+        # stack the 2 pairs of the check into one Miller call
+        px = jax.numpy.stack([neg_g1_aff[0], pk_aff[0]])
+        py = jax.numpy.stack([neg_g1_aff[1], pk_aff[1]])
+        qx = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), ax, bx)
+        qy = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), ay, by)
+        ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
+        return sub_ok, ok
+
+    return jax.jit(run)
+
+
+@lru_cache(maxsize=None)
+def _rlc_pipeline_g1sig():
+    """Short-sig scheme: sigs on G1, keys on G2."""
+
+    def run(sig_jac, u0, u1, bits, pk_aff, neg_g2_aff):
+        sub_ok = DC.g1_in_subgroup(sig_jac)
+        hm = DH.hash_to_g1_jac(u0, u1)
+        both = jax.tree.map(lambda a, b: jax.numpy.concatenate([a, b], 0), sig_jac, hm)
+        bits2 = jax.numpy.concatenate([bits, bits], axis=1)
+        mult = DC.G1_DEV.scalar_mul_bits(both, bits2)
+        n = bits.shape[1]
+        A = DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[:n], mult))
+        B = DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[n:], mult))
+        ax, ay, _ = DC.G1_DEV.to_affine(A)
+        bx, by, _ = DC.G1_DEV.to_affine(B)
+        # e(A, -g2) · e(B, pk) == 1
+        px = jax.numpy.stack([ax, bx])
+        py = jax.numpy.stack([ay, by])
+        qx = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), neg_g2_aff[0], pk_aff[0])
+        qy = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), neg_g2_aff[1], pk_aff[1])
+        ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
+        return sub_ok, ok
+
+    return jax.jit(run)
+
+
+@lru_cache(maxsize=None)
+def _exact_pipeline_g2sig():
+    """Per-round exact check (fallback path): e(-g1,S_i)·e(pk,H_i) == 1."""
+
+    def run(sig_jac, u0, u1, pk_aff, neg_g1_aff):
+        sub_ok = DC.g2_in_subgroup(sig_jac)
+        hm = DH.hash_to_g2_jac(u0, u1)
+        sx, sy, s_inf = DC.G2_DEV.to_affine(sig_jac)
+        hx, hy, _ = DC.G2_DEV.to_affine(hm)
+        n = u0[0].shape[0]
+        px = jax.numpy.stack([jax.numpy.broadcast_to(neg_g1_aff[0], (n, L.NLIMB)),
+                              jax.numpy.broadcast_to(pk_aff[0], (n, L.NLIMB))])
+        py = jax.numpy.stack([jax.numpy.broadcast_to(neg_g1_aff[1], (n, L.NLIMB)),
+                              jax.numpy.broadcast_to(pk_aff[1], (n, L.NLIMB))])
+        qx = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), sx, hx)
+        qy = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), sy, hy)
+        ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
+        return sub_ok & ~s_inf & ok
+
+    return jax.jit(run)
+
+
+@lru_cache(maxsize=None)
+def _exact_pipeline_g1sig():
+    def run(sig_jac, u0, u1, pk_aff, neg_g2_aff):
+        sub_ok = DC.g1_in_subgroup(sig_jac)
+        hm = DH.hash_to_g1_jac(u0, u1)
+        sx, sy, s_inf = DC.G1_DEV.to_affine(sig_jac)
+        hx, hy, _ = DC.G1_DEV.to_affine(hm)
+        n = u0.shape[0]
+        # e(S, -g2) · e(H_i, pk) == 1
+        px = jax.numpy.stack([sx, hx])
+        py = jax.numpy.stack([sy, hy])
+        bc = lambda c: jax.numpy.broadcast_to(c, (n, L.NLIMB))
+        qx = jax.tree.map(lambda a, b: jax.numpy.stack([bc(a), bc(b)]),
+                          neg_g2_aff[0], pk_aff[0])
+        qy = jax.tree.map(lambda a, b: jax.numpy.stack([bc(a), bc(b)]),
+                          neg_g2_aff[1], pk_aff[1])
+        ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
+        return sub_ok & ~s_inf & ok
+
+    return jax.jit(run)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+class BatchBeaconVerifier:
+    """TPU-batched verifier for one chain (fixed scheme + collective pubkey).
+
+    The drand-side analogue would be the `BatchVerifyBeacon` extension of
+    crypto.Scheme described in BASELINE.json's north star."""
+
+    def __init__(self, scheme: Scheme, public_key_bytes: bytes):
+        self.scheme = scheme
+        self.g2sig = scheme.sig_group is GroupG2
+        self.pub_point = scheme.key_group.from_bytes(public_key_bytes)
+        if self.g2sig:
+            self.pk_aff = (L.encode_mont(self.pub_point[0]), L.encode_mont(self.pub_point[1]))
+            self.fixed_aff = (L.encode_mont(_NEG_G1[0]), L.encode_mont(_NEG_G1[1]))
+        else:
+            self.pk_aff = ((L.encode_mont(self.pub_point[0][0]), L.encode_mont(self.pub_point[0][1])),
+                           (L.encode_mont(self.pub_point[1][0]), L.encode_mont(self.pub_point[1][1])))
+            self.fixed_aff = ((L.encode_mont(_NEG_G2[0][0]), L.encode_mont(_NEG_G2[0][1])),
+                              (L.encode_mont(_NEG_G2[1][0]), L.encode_mont(_NEG_G2[1][1])))
+
+    # -- host-side packing ---------------------------------------------------
+
+    def _parse_sigs(self, sigs):
+        """Decompress sig bytes (no subgroup check — that's the device's job).
+
+        Returns (host affine points with generator filling malformed slots,
+        malformed mask)."""
+        pts, bad = [], np.zeros(len(sigs), dtype=bool)
+        gen = G2_GEN if self.g2sig else G1_GEN
+        from_bytes = S.g2_from_bytes if self.g2sig else S.g1_from_bytes
+        for i, sb in enumerate(sigs):
+            try:
+                pt = from_bytes(bytes(sb), check_subgroup=False)
+                if pt is None:
+                    raise ValueError("infinity signature")
+            except (ValueError, AssertionError):
+                pt, bad[i] = gen, True
+            pts.append(pt)
+        return pts, bad
+
+    def _messages(self, rounds, prev_sigs):
+        if self.scheme.chained:
+            return [self.scheme.digest_beacon(r, p) for r, p in zip(rounds, prev_sigs)]
+        return [self.scheme.digest_beacon(r, None) for r in rounds]
+
+    def _encode(self, pts, msgs, pad):
+        gen = G2_GEN if self.g2sig else G1_GEN
+        pts = pts + [gen] * (pad - len(pts))
+        msgs = msgs + [b""] * (pad - len(msgs))
+        if self.g2sig:
+            sig_jac = DC.encode_g2_points(pts)
+            u0, u1 = DH.hash_msgs_to_field_g2(msgs, self.scheme.dst)
+        else:
+            sig_jac = DC.encode_g1_points(pts)
+            u0, u1 = DH.hash_msgs_to_field_g1(msgs, self.scheme.dst)
+        return sig_jac, u0, u1
+
+    # -- verification ---------------------------------------------------------
+
+    def verify_batch(self, rounds, sigs, prev_sigs=None) -> np.ndarray:
+        """Verify N beacons; returns a bool validity array of length N.
+
+        Fast path: one RLC check for the whole batch.  On failure, exact
+        per-round checks locate the invalid rounds."""
+        n = len(rounds)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        if prev_sigs is None:
+            prev_sigs = [None] * n
+        pad = _pad_len(n)
+        msgs = self._messages(rounds, prev_sigs)
+        pts, bad = self._parse_sigs(sigs)
+        sig_jac, u0, u1 = self._encode(pts, msgs, pad)
+
+        if not bad.any():
+            bits = _rlc_scalars(n, pad)
+            pipe = _rlc_pipeline_g2sig() if self.g2sig else _rlc_pipeline_g1sig()
+            sub_ok, ok = pipe(sig_jac, u0, u1, bits, self.pk_aff, self.fixed_aff)
+            sub_ok = np.asarray(sub_ok)[:n]
+            if bool(ok) and sub_ok.all():
+                return np.ones(n, dtype=bool)
+
+        # exact fallback: locate bad rounds
+        pipe = _exact_pipeline_g2sig() if self.g2sig else _exact_pipeline_g1sig()
+        valid = np.asarray(pipe(sig_jac, u0, u1, self.pk_aff, self.fixed_aff))[:n]
+        return valid & ~bad
+
+    def verify_chain(self, beacons):
+        """Verify a chained sequence of (round, sig, prev_sig) host-side
+        linkage + batched signature verification (SURVEY.md §5.7: hash
+        chaining is the cheap serial pass; pairings stay batched).
+
+        Returns (all_ok, per-beacon validity array)."""
+        n = len(beacons)
+        link_ok = np.ones(n, dtype=bool)
+        if self.scheme.chained:
+            for i in range(1, n):
+                if beacons[i].previous_sig != beacons[i - 1].signature:
+                    link_ok[i] = False
+        rounds = [b.round for b in beacons]
+        sigs = [b.signature for b in beacons]
+        prevs = [b.previous_sig for b in beacons]
+        sig_ok = self.verify_batch(rounds, sigs, prevs)
+        valid = link_ok & sig_ok
+        return bool(valid.all()), valid
+
+
+# ---------------------------------------------------------------------------
+# Batched signing (mock networks, perf tests, multi-beacon daemons)
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=None)
+def _sign_pipeline(g2sig: bool):
+    def run(u0, u1, bits):
+        if g2sig:
+            hm = DH.hash_to_g2_jac(u0, u1)
+            out = DC.G2_DEV.scalar_mul_bits(hm, bits)
+            return DC.G2_DEV.to_affine(out)
+        hm = DH.hash_to_g1_jac(u0, u1)
+        out = DC.G1_DEV.scalar_mul_bits(hm, bits)
+        return DC.G1_DEV.to_affine(out)
+
+    return jax.jit(run)
+
+
+def sign_batch(scheme: Scheme, secret: int, msgs) -> list:
+    """BLS-sign many messages with one secret on device; returns sig bytes."""
+    n = len(msgs)
+    pad = _pad_len(n)
+    g2sig = scheme.sig_group is GroupG2
+    pmsgs = list(msgs) + [b""] * (pad - n)
+    if g2sig:
+        u0, u1 = DH.hash_msgs_to_field_g2(pmsgs, scheme.dst)
+    else:
+        u0, u1 = DH.hash_msgs_to_field_g1(pmsgs, scheme.dst)
+    bits = DC.scalars_to_bits([secret] * pad, nbits=256)
+    x, y, _ = _sign_pipeline(g2sig)(u0, u1, bits)
+    if g2sig:
+        pts = _affine_g2_to_host(x, y)
+        return [S.g2_to_bytes(pt) for pt in pts[:n]]
+    pts = _affine_g1_to_host(x, y)
+    return [S.g1_to_bytes(pt) for pt in pts[:n]]
+
+
+def _affine_g1_to_host(x, y):
+    xs, ys = L.decode_mont(x), L.decode_mont(y)
+    if isinstance(xs, int):
+        xs, ys = [xs], [ys]
+    return list(zip(xs, ys))
+
+
+def _affine_g2_to_host(x, y):
+    x0, x1 = L.decode_mont(x[0]), L.decode_mont(x[1])
+    y0, y1 = L.decode_mont(y[0]), L.decode_mont(y[1])
+    if isinstance(x0, int):
+        x0, x1, y0, y1 = [x0], [x1], [y0], [y1]
+    return [((a, b), (c, d)) for a, b, c, d in zip(x0, x1, y0, y1)]
+
+
+# ---------------------------------------------------------------------------
+# Batched tBLS recovery: Lagrange interpolation in the exponent as MSM
+# (replaces kyber tbls.Recover at chainstore.go:202 for bulk aggregation)
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=None)
+def _recover_pipeline(g2sig: bool):
+    def run(part_jac, bits):
+        curve = DC.G2_DEV if g2sig else DC.G1_DEV
+        mult = curve.scalar_mul_bits(part_jac, bits)     # (t, rounds) points
+        acc = curve.sum_points(mult)                      # reduce axis 0 -> (rounds,)
+        return curve.to_affine(acc)
+
+    return jax.jit(run)
+
+
+def recover_batch(scheme: Scheme, indices, partial_sigs) -> list:
+    """Recover full signatures for many rounds at once.
+
+    indices: (rounds, t) signer indices; partial_sigs: (rounds, t) raw BLS sig
+    bytes (WITHOUT the 2-byte index prefix).  Assumes partials pre-verified
+    (the aggregator feeds only validated partials, chainstore.go:241).
+    Returns list of full signature bytes."""
+    nr = len(indices)
+    t = len(indices[0])
+    g2sig = scheme.sig_group is GroupG2
+    from_bytes = S.g2_from_bytes if g2sig else S.g1_from_bytes
+    # host: Lagrange coefficients and point decompression
+    lams = np.zeros((t, nr), dtype=object)
+    pts = []
+    for r in range(nr):
+        idxs = indices[r]
+        for j in range(t):
+            lams[j][r] = HT._lagrange_coeff(idxs, idxs[j])
+    for j in range(t):
+        row = [from_bytes(bytes(partial_sigs[r][j]), check_subgroup=False)
+               for r in range(nr)]
+        pts.append(row)
+    enc = DC.encode_g2_points if g2sig else DC.encode_g1_points
+    part_jac = jax.tree.map(
+        lambda *rows: jax.numpy.stack(rows), *[enc(row) for row in pts])
+    flat = [int(lams[j][r]) for j in range(t) for r in range(nr)]
+    bits = DC.scalars_to_bits(flat, nbits=256).reshape(256, t, nr)
+    x, y, _ = _recover_pipeline(g2sig)(part_jac, bits)
+    if g2sig:
+        host_pts = _affine_g2_to_host(x, y)
+        return [S.g2_to_bytes(pt) for pt in host_pts]
+    host_pts = _affine_g1_to_host(x, y)
+    return [S.g1_to_bytes(pt) for pt in host_pts]

@@ -1,0 +1,414 @@
+"""Device-side G1/G2 group law: branchless Jacobian arithmetic, batched.
+
+TPU-native replacement for kyber's Point interface (SURVEY.md §2.9,
+key/keys.go:100-101 and every tbls call site).  Everything is select-based
+(no data-dependent control flow) so point ops vectorize over arbitrary batch
+axes and live inside `lax.scan` ladders:
+
+  point     = (X, Y, Z) Jacobian tuple of field elements; infinity has Z = 0
+  add       = complete via masks (handles inf/inf, P==Q, P==-Q)
+  scalar·P  = MSB-first double-and-add scan over per-element bit tensors
+              (variable scalars: Lagrange coeffs, RLC randomizers) or a
+              Python-unrolled chain for static scalars (cofactors, |x|)
+
+Subgroup membership uses the GLV/untwist endomorphisms, numerically pinned
+against the host golden code (see tests):
+  G2:  Q in G2  <=>  psi(Q) == [x]Q        (Bowe's fast check)
+  G1:  P in G1  <=>  phi(P) == [-x^2]P,    phi(x,y) = (beta*x, y)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import limbs as L
+from . import tower as T
+from ..crypto.host.params import P as FP_P, R as ORDER_R, X as BLS_X, B1, B2
+from ..crypto.host import field as HF
+
+
+class FieldFns:
+    """Vector-field namespace: the ops DevCurve is generic over.
+
+    `mul_many` runs k independent products as one staged wide op (vertical
+    batching, see limbs.py) — the group-law formulas below are written as
+    stages of independent products to exploit it."""
+
+    def __init__(self, add, sub, mul, mul_many, sqr, neg, inv, is_zero, eq,
+                 select, zeros, ones):
+        self.add, self.sub, self.mul, self.mul_many = add, sub, mul, mul_many
+        self.sqr, self.neg = sqr, neg
+        self.inv, self.is_zero, self.eq, self.select = inv, is_zero, eq, select
+        self.zeros, self.ones = zeros, ones
+
+
+FP_FNS = FieldFns(
+    add=L.add_mod, sub=L.sub_mod, mul=L.mont_mul, mul_many=L.mul_many,
+    sqr=L.mont_sqr, neg=L.neg_mod,
+    inv=L.inv_mod, is_zero=L.is_zero, eq=L.eq, select=L.select,
+    zeros=lambda shape=(): jnp.zeros(shape + (L.NLIMB,), L.U32),
+    ones=lambda shape=(): jnp.broadcast_to(L.ONE_M, shape + (L.NLIMB,)),
+)
+
+FP2_FNS = FieldFns(
+    add=T.fp2_add, sub=T.fp2_sub, mul=T.fp2_mul, mul_many=T.fp2_mul_many,
+    sqr=T.fp2_sqr, neg=T.fp2_neg,
+    inv=T.fp2_inv, is_zero=T.fp2_is_zero, eq=T.fp2_eq, select=T.fp2_select,
+    zeros=T.fp2_zeros, ones=T.fp2_ones,
+)
+
+
+def _batch_shape_fp(leaf):
+    return leaf.shape[:-1]
+
+
+class DevCurve:
+    """y^2 = x^3 + b over the field described by `f`, Jacobian coordinates."""
+
+    def __init__(self, f: FieldFns, b_mont, name: str):
+        self.f = f
+        self.b = b_mont
+        self.name = name
+
+    # -- constructors --------------------------------------------------------
+
+    def infinity(self, shape=()):
+        f = self.f
+        return (f.ones(shape), f.ones(shape), f.zeros(shape))
+
+    def from_affine(self, x, y, shape=()):
+        return (x, y, self.f.ones(shape))
+
+    def is_infinity(self, p):
+        return self.f.is_zero(p[2])
+
+    # -- group law (complete via selects) ------------------------------------
+
+    def double(self, p):
+        """Branchless Jacobian doubling; maps infinity to infinity.
+
+        4 staged product groups."""
+        f = self.f
+        X1, Y1, Z1 = p
+        A, B, t = f.mul_many([(X1, X1), (Y1, Y1), (Y1, Z1)])
+        XB = f.add(X1, B)
+        C, U = f.mul_many([(B, B), (XB, XB)])
+        D = f.sub(f.sub(U, A), C)
+        D = f.add(D, D)
+        E = f.add(f.add(A, A), A)
+        (Fv,) = f.mul_many([(E, E)])
+        X3 = f.sub(Fv, f.add(D, D))
+        (Y3a,) = f.mul_many([(E, f.sub(D, X3))])
+        C2 = f.add(C, C)
+        C4 = f.add(C2, C2)
+        Y3 = f.sub(Y3a, f.add(C4, C4))
+        Z3 = f.add(t, t)
+        return (X3, Y3, Z3)
+
+    def add(self, p, q):
+        """Complete Jacobian addition: handles inf operands, P==Q, P==-Q.
+
+        The completeness double shares the 6 staged product groups of the
+        generic addition (its products ride in the same wide ops)."""
+        f = self.f
+        X1, Y1, Z1 = p
+        X2, Y2, Z2 = q
+        # stage 1
+        Z12 = f.add(Z1, Z2)
+        Z1Z1, Z2Z2, ZS, dA, dB, dt = f.mul_many(
+            [(Z1, Z1), (Z2, Z2), (Z12, Z12), (X1, X1), (Y1, Y1), (Y1, Z1)])
+        # stage 2
+        XB = f.add(X1, dB)
+        U1, U2, t1, t2, dC, dU = f.mul_many(
+            [(X1, Z2Z2), (X2, Z1Z1), (Z2, Z2Z2), (Z1, Z1Z1), (dB, dB), (XB, XB)])
+        dD = f.sub(f.sub(dU, dA), dC)
+        dD = f.add(dD, dD)
+        dE = f.add(f.add(dA, dA), dA)
+        # stage 3
+        S1, S2, dFv = f.mul_many([(Y1, t1), (Y2, t2), (dE, dE)])
+        H = f.sub(U2, U1)
+        HH = f.add(H, H)
+        rr = f.sub(S2, S1)
+        rr = f.add(rr, rr)
+        dX3 = f.sub(dFv, f.add(dD, dD))
+        # stage 4
+        I, dY3a = f.mul_many([(HH, HH), (dE, f.sub(dD, dX3))])
+        dC2 = f.add(dC, dC)
+        dC4 = f.add(dC2, dC2)
+        dY3 = f.sub(dY3a, f.add(dC4, dC4))
+        dZ3 = f.add(dt, dt)
+        # stage 5
+        J, V, RR, Z3 = f.mul_many(
+            [(H, I), (U1, I), (rr, rr), (f.sub(f.sub(ZS, Z1Z1), Z2Z2), H)])
+        X3 = f.sub(f.sub(RR, J), f.add(V, V))
+        # stage 6
+        Y3a, S1J = f.mul_many([(rr, f.sub(V, X3)), (S1, J)])
+        Y3 = f.sub(Y3a, f.add(S1J, S1J))
+        out = (X3, Y3, Z3)
+
+        inf1 = self.is_infinity(p)
+        inf2 = self.is_infinity(q)
+        same_x = f.eq(U1, U2) & ~inf1 & ~inf2
+        same_y = f.eq(S1, S2)
+        dbl = (dX3, dY3, dZ3)
+        infp = self.infinity(_batch_shape_fp(self._leaf(X1)))
+        out = self._select(same_x & same_y, dbl, out)
+        out = self._select(same_x & ~same_y, infp, out)
+        out = self._select(inf1, q, out)
+        out = self._select(inf2, p, out)
+        return out
+
+    def neg(self, p):
+        return (p[0], self.f.neg(p[1]), p[2])
+
+    def _select(self, cond, a, b):
+        f = self.f
+        return tuple(f.select(cond, x, y) for x, y in zip(a, b))
+
+    def _leaf(self, x):
+        while isinstance(x, tuple):
+            x = x[0]
+        return x
+
+    # -- affine conversion ---------------------------------------------------
+
+    def to_affine(self, p):
+        """Returns (x, y, is_inf).  Infinity maps to (0, 0, True)."""
+        f = self.f
+        X1, Y1, Z1 = p
+        zi = f.inv(Z1)  # 0 for infinity -> coords come out 0
+        zi2 = f.sqr(zi)
+        return (f.mul(X1, zi2), f.mul(Y1, f.mul(zi2, zi)), self.is_infinity(p))
+
+    def eq_points(self, p, q):
+        """Projective equality (both may be infinity)."""
+        f = self.f
+        X1, Y1, Z1 = p
+        X2, Y2, Z2 = q
+        Z1Z1, Z2Z2 = f.mul_many([(Z1, Z1), (Z2, Z2)])
+        a, b, t1, t2 = f.mul_many(
+            [(X1, Z2Z2), (X2, Z1Z1), (Z2, Z2Z2), (Z1, Z1Z1)])
+        c, d = f.mul_many([(Y1, t1), (Y2, t2)])
+        same = f.eq(a, b) & f.eq(c, d)
+        both_inf = self.is_infinity(p) & self.is_infinity(q)
+        one_inf = self.is_infinity(p) ^ self.is_infinity(q)
+        return (same | both_inf) & ~one_inf
+
+    def on_curve(self, x, y):
+        """Affine on-curve check y^2 == x^3 + b (batch)."""
+        f = self.f
+        lhs = f.sqr(y)
+        rhs = f.add(f.mul(f.sqr(x), x), self.b)
+        return f.eq(lhs, rhs)
+
+    # -- scalar multiplication ----------------------------------------------
+
+    def scalar_mul_bits(self, p, bits):
+        """k·P for per-element scalars given as MSB-first bit tensor.
+
+        p: Jacobian point with batch shape S;  bits: (nbits,) + S uint32.
+        One `lax.scan` of nbits steps; ~1 double + 1 complete add per step.
+        """
+        acc0 = self.infinity(_batch_shape_fp(self._leaf(p[0])))
+
+        def step(acc, bit):
+            acc = self.double(acc)
+            added = self.add(acc, p)
+            acc = self._select(bit == 1, added, acc)
+            return acc, None
+
+        acc, _ = jax.lax.scan(step, acc0, bits)
+        return acc
+
+    def scalar_mul_fixed(self, p, k: int):
+        """k·P for a static python-int scalar (cofactors, |x| chains).
+
+        A `lax.scan` over the static MSB-first bit vector (after the leading
+        1): one compiled double+add body regardless of bit length, so the
+        graph stays small; the select wastes the add on zero bits, which is
+        the right trade on TPU (compile time and code size over ~40% ALU).
+        """
+        if k == 0:
+            return self.infinity(_batch_shape_fp(self._leaf(p[0])))
+        neg = k < 0
+        k = abs(k)
+        tail = bin(k)[3:]
+        acc = p
+        if tail:
+            bits = jnp.asarray(np.array([int(b) for b in tail], dtype=np.uint32))
+
+            def step(acc, bit):
+                acc = self.double(acc)
+                acc = self._select(bit == 1, self.add(acc, p), acc)
+                return acc, None
+
+            acc, _ = jax.lax.scan(step, acc, bits)
+        return self.neg(acc) if neg else acc
+
+    def sum_points(self, p):
+        """Tree-reduce a batched point (leading axis) to a single point.
+
+        log2(n) rounds of halving pairwise adds; odd leftovers carried over."""
+        n = self._leaf(p[0]).shape[0]
+        while n > 1:
+            half = n // 2
+            a = jax.tree.map(lambda t: t[:half], p)
+            b = jax.tree.map(lambda t: t[half:2 * half], p)
+            s = self.add(a, b)
+            if n % 2:
+                rest = jax.tree.map(lambda t: t[2 * half:], p)
+                p = jax.tree.map(lambda x, y: jnp.concatenate([x, y], 0), s, rest)
+            else:
+                p = s
+            n = half + (n % 2)
+        return jax.tree.map(lambda t: t[0], p)
+
+
+G1_DEV = DevCurve(FP_FNS, L.encode_mont(B1), "G1")
+G2_DEV = DevCurve(FP2_FNS, T.encode_fp2(B2), "G2")
+
+
+# ---------------------------------------------------------------------------
+# Scalar encoding (host -> device bit tensors)
+# ---------------------------------------------------------------------------
+
+def scalars_to_bits(ks, nbits: int = 256) -> jnp.ndarray:
+    """Host: list of ints -> (nbits, batch) MSB-first uint32 bit tensor."""
+    arr = np.zeros((nbits, len(ks)), dtype=np.uint32)
+    for j, k in enumerate(ks):
+        k %= ORDER_R
+        for i in range(nbits):
+            arr[i, j] = (k >> (nbits - 1 - i)) & 1
+    return jnp.asarray(arr)
+
+
+# ---------------------------------------------------------------------------
+# Endomorphisms + fast subgroup checks (identities pinned in tests vs host)
+# ---------------------------------------------------------------------------
+
+# psi on the D-twist: psi(x, y) = (c_x * conj(x), c_y * conj(y)); on Jacobian
+# coords psi(X, Y, Z) = (c_x*conj(X), c_y*conj(Y), conj(Z)).
+_PSI_CX_DEV = T.encode_fp2(HF.fp2_inv(HF.fp2_pow(HF.XI, (FP_P - 1) // 3)))
+_PSI_CY_DEV = T.encode_fp2(HF.fp2_inv(HF.fp2_pow(HF.XI, (FP_P - 1) // 2)))
+
+# G1 GLV endomorphism phi(x, y) = (beta*x, y), beta = 2^((p-1)/3).
+_BETA_DEV = L.encode_mont(pow(2, (FP_P - 1) // 3, FP_P))
+
+
+def g2_psi(p):
+    X2, Y2, Z2 = p
+    return (
+        T.fp2_mul(_PSI_CX_DEV, T.fp2_conj(X2)),
+        T.fp2_mul(_PSI_CY_DEV, T.fp2_conj(Y2)),
+        T.fp2_conj(Z2),
+    )
+
+
+def g1_phi(p):
+    X1, Y1, Z1 = p
+    return (L.mont_mul(_BETA_DEV, X1), Y1, Z1)
+
+
+def g2_in_subgroup(p):
+    """Q in G2 <=> psi(Q) == [x]Q (batch).  Infinity counts as member."""
+    lhs = g2_psi(p)
+    rhs = G2_DEV.scalar_mul_fixed(p, BLS_X)
+    return G2_DEV.eq_points(lhs, rhs)
+
+
+def g1_in_subgroup(p):
+    """P in G1 <=> phi(P) == [-x^2]P (batch)."""
+    lhs = g1_phi(p)
+    rhs = G1_DEV.scalar_mul_fixed(p, -(BLS_X * BLS_X))
+    return G1_DEV.eq_points(lhs, rhs)
+
+
+def g2_clear_cofactor(p):
+    """Budroni-Pintore fast clearing: [x^2-x-1]P + [x-1]psi(P) + psi^2(2P).
+
+    Exactly h_eff·P for the RFC 9380 G2 suite (mirrors host g2_clear_cofactor,
+    crypto/host/curve.py:183-196)."""
+    xP = G2_DEV.scalar_mul_fixed(p, BLS_X)
+    x2P = G2_DEV.scalar_mul_fixed(xP, BLS_X)
+    t = G2_DEV.add(x2P, G2_DEV.neg(xP))        # (x^2 - x) P
+    t = G2_DEV.add(t, G2_DEV.neg(p))           # (x^2 - x - 1) P
+    u = g2_psi(G2_DEV.add(xP, G2_DEV.neg(p)))  # psi((x-1) P)
+    t = G2_DEV.add(t, u)
+    v = g2_psi(g2_psi(G2_DEV.double(p)))       # psi^2(2P)
+    return G2_DEV.add(t, v)
+
+
+def g1_clear_cofactor(p):
+    """h_eff = 1 - x (RFC 9380 §8.8.1 fast method)."""
+    return G1_DEV.scalar_mul_fixed(p, 1 - BLS_X)
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device point conversion (tests / (de)serialization boundaries)
+# ---------------------------------------------------------------------------
+
+def encode_g1_points(pts):
+    """Host affine G1 points (or None) -> batched Jacobian device point."""
+    xs, ys, zs = [], [], []
+    for pt in pts:
+        if pt is None:
+            xs.append(1); ys.append(1); zs.append(0)
+        else:
+            xs.append(pt[0]); ys.append(pt[1]); zs.append(1)
+    return (L.encode_mont(xs), L.encode_mont(ys), L.encode_mont(zs))
+
+
+def encode_g2_points(pts):
+    c = {k: [] for k in ("x0", "x1", "y0", "y1", "z0", "z1")}
+    for pt in pts:
+        if pt is None:
+            vals = (1, 0, 1, 0, 0, 0)
+        else:
+            (x0, x1), (y0, y1) = pt
+            vals = (x0, x1, y0, y1, 1, 0)
+        for k, v in zip(("x0", "x1", "y0", "y1", "z0", "z1"), vals):
+            c[k].append(v)
+    return (
+        (L.encode_mont(c["x0"]), L.encode_mont(c["x1"])),
+        (L.encode_mont(c["y0"]), L.encode_mont(c["y1"])),
+        (L.encode_mont(c["z0"]), L.encode_mont(c["z1"])),
+    )
+
+
+def decode_g1_points(p):
+    """Batched Jacobian device point -> host affine list (None = infinity).
+
+    Pure host math (no device dispatch)."""
+    X1 = L.decode_mont(p[0]); Y1 = L.decode_mont(p[1]); Z1 = L.decode_mont(p[2])
+    if isinstance(X1, int):
+        X1, Y1, Z1 = [X1], [Y1], [Z1]
+    out = []
+    for x, y, z in zip(X1, Y1, Z1):
+        if z == 0:
+            out.append(None)
+            continue
+        zi = pow(z, FP_P - 2, FP_P)
+        zi2 = zi * zi % FP_P
+        out.append((x * zi2 % FP_P, y * zi2 * zi % FP_P))
+    return out
+
+
+def decode_g2_points(p):
+    (X0, X1c), (Y0, Y1c), (Z0, Z1c) = p
+    x0, x1 = L.decode_mont(X0), L.decode_mont(X1c)
+    y0, y1 = L.decode_mont(Y0), L.decode_mont(Y1c)
+    z0, z1 = L.decode_mont(Z0), L.decode_mont(Z1c)
+    if isinstance(x0, int):
+        x0, x1, y0, y1, z0, z1 = [x0], [x1], [y0], [y1], [z0], [z1]
+    out = []
+    for a0, a1, b0, b1, c0, c1 in zip(x0, x1, y0, y1, z0, z1):
+        z = (c0, c1)
+        if z == (0, 0):
+            out.append(None)
+            continue
+        zi = HF.fp2_inv(z)
+        zi2 = HF.fp2_sqr(zi)
+        x = HF.fp2_mul((a0, a1), zi2)
+        y = HF.fp2_mul((b0, b1), HF.fp2_mul(zi2, zi))
+        out.append((x, y))
+    return out

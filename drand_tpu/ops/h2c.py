@@ -1,0 +1,264 @@
+"""Device-side RFC 9380 hash-to-curve for G1 and G2 (batched, branchless).
+
+Hybrid split per SURVEY.md §7 hard-part 3: the SHA-256 `expand_message_xmd`
+runs on host (hashlib is native code, microseconds per message), producing
+field elements u0, u1 per message; everything algebraic — the simplified SWU
+map, the isogeny to E1/E2, point addition, cofactor clearing — runs on device
+over the whole batch.
+
+Design notes:
+* All control flow is mask/select; square-detection and square roots are
+  fixed-exponent pow scans (p = 3 mod 4 for Fp; norm-trick for Fp2, mirrored
+  from the host golden `fp2_sqrt` and tested against it).
+* The isogeny evaluation emits Jacobian coordinates directly
+  (X = xn·xd·yd², Y = y·yn·xd³·yd², Z = xd·yd) — no field inversion anywhere
+  in the map.
+* Q0 and Q1 are mapped through the isogeny separately and added on the
+  *target* curve (the isogeny is a group hom), so the a=0 complete addition
+  of ops/curve.py applies; E'-side addition would need a≠0 doubling formulas.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import limbs as L
+from . import tower as T
+from . import curve as DC
+from ..crypto.host.params import (
+    P, HTF_L, ISO_A1, ISO_B1, ISO_A2, ISO_B2, Z1, Z2, DST_G1, DST_G2,
+)
+from ..crypto.host.h2c import (
+    hash_to_field_fp, hash_to_field_fp2,
+    _K1, _K2, _K3, _K4,
+)
+from ..crypto.host._iso_g1 import XNUM as G1XN, XDEN as G1XD, YNUM as G1YN, YDEN as G1YD
+
+# ---------------------------------------------------------------------------
+# Constants (encoded once)
+# ---------------------------------------------------------------------------
+
+_A1 = L.encode_mont(ISO_A1)
+_B1 = L.encode_mont(ISO_B1)
+_Z1 = L.encode_mont(Z1)
+_A2 = T.encode_fp2(ISO_A2)
+_B2 = T.encode_fp2(ISO_B2)
+_Z2 = T.encode_fp2(Z2)
+
+from ..crypto.host import field as HF
+
+# x1 constant for the tv2 == 0 exceptional case:  B / (Z*A)
+_X1_EXC_G1 = L.encode_mont(ISO_B1 * pow(Z1 * ISO_A1 % P, P - 2, P) % P)
+_X1_EXC_G2 = T.encode_fp2(HF.fp2_mul((ISO_B2[0], ISO_B2[1]), HF.fp2_inv(HF.fp2_mul(Z2, ISO_A2))))
+# -B/A precomputed
+_NBA_G1 = L.encode_mont((P - ISO_B1) * pow(ISO_A1, P - 2, P) % P)
+_NBA_G2 = T.encode_fp2(HF.fp2_mul(HF.fp2_neg(ISO_B2), HF.fp2_inv(ISO_A2)))
+
+_SQRT_EXP = (P + 1) // 4
+_QR_EXP = (P - 1) // 2
+
+_G1_ISO = tuple(tuple(L.encode_mont(c) for c in cs) for cs in (G1XN, G1XD, G1YN, G1YD))
+_G2_ISO = tuple(tuple(T.encode_fp2(c) for c in cs) for cs in (_K1, _K2, _K3, _K4))
+
+
+# ---------------------------------------------------------------------------
+# Fp helpers
+# ---------------------------------------------------------------------------
+
+def fp_is_square(a):
+    """Legendre via fixed pow; 0 counts as square."""
+    ls = L.pow_fixed(a, _QR_EXP)
+    return L.is_zero(a) | L.eq(ls, jnp.broadcast_to(L.ONE_M, ls.shape))
+
+
+def fp_sqrt(a):
+    """sqrt for squares (p = 3 mod 4); garbage for non-squares (caller selects)."""
+    return L.pow_fixed(a, _SQRT_EXP)
+
+
+def fp_sgn0(a):
+    """Parity of the canonical representative (Montgomery in)."""
+    return L.from_mont(a)[..., 0] & 1
+
+
+def fp2_sgn0(a):
+    c0 = L.from_mont(a[0])
+    c1 = L.from_mont(a[1])
+    s0 = c0[..., 0] & 1
+    z0 = jnp.all(c0 == 0, axis=-1).astype(L.U32)
+    s1 = c1[..., 0] & 1
+    return s0 | (z0 & s1)
+
+
+def fp2_is_square(a):
+    """a square in Fp2 iff norm(a) square in Fp."""
+    norm = L.add_mod(L.mont_sqr(a[0]), L.mont_sqr(a[1]))
+    return fp_is_square(norm)
+
+
+_HALF_M = L.encode_mont((P + 1) // 2)
+
+
+def fp2_sqrt(a):
+    """Branchless mirror of host fp2_sqrt (norm trick); input must be square.
+
+    2 pow scans total: one for sqrt(norm), one stacked scan for the four
+    same-exponent candidate roots."""
+    a0, a1 = a
+    t = L.mul_many([(a0, a0), (a1, a1)])
+    norm = L.add_mod(t[0], t[1])
+    d = fp_sqrt(norm)
+    half = jnp.broadcast_to(_HALF_M, a0.shape)
+    x2a, x2b = L.mul_many([(L.add_mod(a0, d), half), (L.sub_mod(a0, d), half)])
+    xa, xb, sa, sb = L.pow_many_same_exp([x2a, x2b, a0, L.neg_mod(a0)], _SQRT_EXP)
+    ver = L.mul_many([(xa, xa), (sa, sa)])
+    good_a = L.eq(ver[0], x2a)
+    x = L.select(good_a, xa, xb)
+    y = L.mont_mul(a1, L.inv_mod(L.add_mod(x, x)))
+    # a1 == 0 branch: sqrt(a0) if square else sqrt(-a0)*u
+    a0_sq = L.eq(ver[1], a0)
+    zero = jnp.zeros_like(a0)
+    r0_a1z = L.select(a0_sq, sa, zero)
+    r1_a1z = L.select(a0_sq, zero, sb)
+    a1z = L.is_zero(a1)
+    return (L.select(a1z, r0_a1z, x), L.select(a1z, r1_a1z, y))
+
+
+# ---------------------------------------------------------------------------
+# Simplified SWU (branchless, generic shape over the two fields)
+# ---------------------------------------------------------------------------
+
+def _sswu_g1(u):
+    A, B, Z = (jnp.broadcast_to(_A1, u.shape), jnp.broadcast_to(_B1, u.shape),
+               jnp.broadcast_to(_Z1, u.shape))
+    u2 = L.mont_sqr(u)
+    tv1 = L.mont_mul(Z, u2)
+    tv2 = L.add_mod(L.mont_sqr(tv1), tv1)
+    x1b = L.mont_mul(jnp.broadcast_to(_NBA_G1, u.shape),
+                     L.add_mod(jnp.broadcast_to(L.ONE_M, u.shape), L.inv_mod(tv2)))
+    x1 = L.select(L.is_zero(tv2), jnp.broadcast_to(_X1_EXC_G1, u.shape), x1b)
+
+    def g(x):
+        return L.add_mod(L.add_mod(L.mont_mul(L.mont_sqr(x), x), L.mont_mul(A, x)), B)
+
+    gx1 = g(x1)
+    x2 = L.mont_mul(tv1, x1)
+    gx2 = g(x2)
+    sq1 = fp_is_square(gx1)
+    x = L.select(sq1, x1, x2)
+    gx = L.select(sq1, gx1, gx2)
+    y = fp_sqrt(gx)
+    flip = fp_sgn0(u) != fp_sgn0(y)
+    y = L.select(flip, L.neg_mod(y), y)
+    return x, y
+
+
+def _sswu_g2(u):
+    shape = u[0].shape
+    A = jax.tree.map(lambda c: jnp.broadcast_to(c, shape), _A2)
+    B = jax.tree.map(lambda c: jnp.broadcast_to(c, shape), _B2)
+    Z = jax.tree.map(lambda c: jnp.broadcast_to(c, shape), _Z2)
+    u2 = T.fp2_sqr(u)
+    tv1 = T.fp2_mul(Z, u2)
+    tv2 = T.fp2_add(T.fp2_sqr(tv1), tv1)
+    one = T.fp2_ones(shape[:-1])
+    x1b = T.fp2_mul(jax.tree.map(lambda c: jnp.broadcast_to(c, shape), _NBA_G2),
+                    T.fp2_add(one, T.fp2_inv(tv2)))
+    x1 = T.fp2_select(T.fp2_is_zero(tv2),
+                      jax.tree.map(lambda c: jnp.broadcast_to(c, shape), _X1_EXC_G2), x1b)
+
+    def g(x):
+        return T.fp2_add(T.fp2_add(T.fp2_mul(T.fp2_sqr(x), x), T.fp2_mul(A, x)), B)
+
+    gx1 = g(x1)
+    x2 = T.fp2_mul(tv1, x1)
+    gx2 = g(x2)
+    sq1 = fp2_is_square(gx1)
+    x = T.fp2_select(sq1, x1, x2)
+    gx = T.fp2_select(sq1, gx1, gx2)
+    y = fp2_sqrt(gx)
+    flip = fp2_sgn0(u) != fp2_sgn0(y)
+    y = T.fp2_select(flip, T.fp2_neg(y), y)
+    return x, y
+
+
+# ---------------------------------------------------------------------------
+# Isogeny evaluation -> Jacobian on the target curve (no inversions)
+# ---------------------------------------------------------------------------
+
+def _horner(coeffs, x, mul, add, bshape):
+    acc = jax.tree.map(lambda c: jnp.broadcast_to(c, _leaf_shape(x)), coeffs[-1])
+    for c in reversed(coeffs[:-1]):
+        acc = add(mul(acc, x), jax.tree.map(lambda t: jnp.broadcast_to(t, _leaf_shape(x)), c))
+    return acc
+
+
+def _leaf_shape(x):
+    while isinstance(x, tuple):
+        x = x[0]
+    return x.shape
+
+
+def _iso_jacobian(x, y, iso, mul, sqr, add):
+    """Evaluate the isogeny rationally and emit Jacobian (X, Y, Z)."""
+    kxn, kxd, kyn, kyd = iso
+    xn = _horner(kxn, x, mul, add, None)
+    xd = _horner(kxd, x, mul, add, None)
+    yn = _horner(kyn, x, mul, add, None)
+    yd = _horner(kyd, x, mul, add, None)
+    z = mul(xd, yd)
+    X = mul(mul(xn, xd), sqr(yd))             # xn·xd·yd²
+    xd2 = sqr(xd)
+    Y = mul(mul(y, yn), mul(mul(xd2, xd), sqr(yd)))  # y·yn·xd³·yd²
+    return X, Y, z
+
+
+def map_to_g1_jac(u):
+    """SSWU + 11-isogeny: field element batch -> Jacobian points on E1."""
+    x, y = _sswu_g1(u)
+    X, Y, Z = _iso_jacobian(x, y, _G1_ISO, L.mont_mul, L.mont_sqr, L.add_mod)
+    return (X, Y, Z)
+
+
+def map_to_g2_jac(u):
+    x, y = _sswu_g2(u)
+    X, Y, Z = _iso_jacobian(x, y, _G2_ISO, T.fp2_mul, T.fp2_sqr, T.fp2_add)
+    return (X, Y, Z)
+
+
+# ---------------------------------------------------------------------------
+# Full hash_to_curve pipelines (host hashing -> device algebra)
+# ---------------------------------------------------------------------------
+
+def hash_msgs_to_field_g1(msgs, dst=DST_G1):
+    """Host: messages -> (u0_batch, u1_batch) Montgomery limb tensors."""
+    u0s, u1s = [], []
+    for m in msgs:
+        u0, u1 = hash_to_field_fp(m, dst, 2)
+        u0s.append(u0)
+        u1s.append(u1)
+    return L.encode_mont(u0s), L.encode_mont(u1s)
+
+
+def hash_msgs_to_field_g2(msgs, dst=DST_G2):
+    c = [[], [], [], []]
+    for m in msgs:
+        (a0, a1), (b0, b1) = hash_to_field_fp2(m, dst, 2)
+        for lst, v in zip(c, (a0, a1, b0, b1)):
+            lst.append(v)
+    return ((L.encode_mont(c[0]), L.encode_mont(c[1])),
+            (L.encode_mont(c[2]), L.encode_mont(c[3])))
+
+
+def hash_to_g2_jac(u0, u1):
+    """Device: two field-element batches -> G2 Jacobian point batch (in-group)."""
+    q0 = map_to_g2_jac(u0)
+    q1 = map_to_g2_jac(u1)
+    r = DC.G2_DEV.add(q0, q1)
+    return DC.g2_clear_cofactor(r)
+
+
+def hash_to_g1_jac(u0, u1):
+    q0 = map_to_g1_jac(u0)
+    q1 = map_to_g1_jac(u1)
+    r = DC.G1_DEV.add(q0, q1)
+    return DC.g1_clear_cofactor(r)

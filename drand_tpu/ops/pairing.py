@@ -1,0 +1,198 @@
+"""Device-side optimal ate pairing for BLS12-381, batched.
+
+Projective Miller loop with line coefficients (no inversions inside the
+loop), mirroring the host prototype validated against the affine golden
+pairing (crypto/host/pairing.py, itself pinned by LoE mainnet vectors).
+The loop is a `lax.scan` over the 63 static bits of |x|; the conditional
+add-step is computed every iteration and masked (branch-free).
+
+Reference hot call sites this replaces: tbls.VerifyPartial
+(chain/beacon/node.go:150) and VerifyRecovered (chainstore.go:207) — there
+they are per-signature CPU pairings; here whole batches of pairings run as
+one program, and verification equations are usually collapsed further via
+random linear combination (see drand_tpu.crypto.batch) so the pairing count
+per batch is O(1).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import limbs as L
+from . import tower as T
+from . import curve as DC
+from ..crypto.host.params import P, X as BLS_X, B2
+
+_B2_DEV = T.encode_fp2(B2)
+_HALF_M = L.encode_mont((P + 1) // 2)
+
+_LOOP_BITS = np.array([int(b) for b in bin(-BLS_X)[3:]], dtype=np.uint32)  # 63 bits
+
+
+def _fp2_triple(a):
+    return T.fp2_add(T.fp2_add(a, a), a)
+
+
+def _dbl_step(Rp):
+    """Doubling step: new R and line coefficients (ell0, ell_px, ell_py)."""
+    Rx, Ry, Rz = Rp
+    shape = Rx[0].shape
+    b2 = jax.tree.map(lambda c: jnp.broadcast_to(c, shape), _B2_DEV)
+    s1 = T.fp2_mul_many(
+        [(Ry, Ry), (Rz, Rz), (T.fp2_add(Ry, Rz), T.fp2_add(Ry, Rz)), (Rx, Rx), (Rx, Ry)])
+    t0, t1, u, v, m = s1
+    s2 = T.fp2_mul_many([(t1, b2)])
+    t2 = _fp2_triple(s2[0])
+    t3 = _fp2_triple(t2)
+    t4 = T.fp2_sub(T.fp2_sub(u, t1), t0)       # 2 Ry Rz
+    ell = (T.fp2_sub(t2, t0), _fp2_triple(v), T.fp2_neg(t4))
+    half = jnp.broadcast_to(_HALF_M, shape)
+    hs = L.mul_many([(T.fp2_add(t0, t3)[0], half), (T.fp2_add(t0, t3)[1], half),
+                     (T.fp2_sub(t0, t3)[0], half), (T.fp2_sub(t0, t3)[1], half)])
+    hh = (hs[0], hs[1])
+    g = (hs[2], hs[3])
+    s3 = T.fp2_mul_many([(hh, hh), (t2, t2), (g, m), (t0, t4)])
+    Ry2 = T.fp2_sub(s3[0], _fp2_triple(s3[1]))
+    return (s3[2], Ry2, s3[3]), ell
+
+
+def _add_step(Rp, Q):
+    """Mixed addition step with affine Q; returns new R and line coeffs."""
+    Rx, Ry, Rz = Rp
+    Qx, Qy = Q
+    s1 = T.fp2_mul_many([(Qy, Rz), (Qx, Rz)])
+    t0 = T.fp2_sub(Ry, s1[0])
+    t1 = T.fp2_sub(Rx, s1[1])
+    s2 = T.fp2_mul_many([(t0, Qx), (t1, Qy), (t1, t1), (t0, t0)])
+    ell = (T.fp2_sub(s2[0], s2[1]), T.fp2_neg(t0), t1)
+    t2 = s2[2]
+    s3 = T.fp2_mul_many([(t2, t1), (t2, Rx), (s2[3], Rz)])
+    t3, t4, t0sqRz = s3
+    t5 = T.fp2_add(T.fp2_sub(t3, T.fp2_add(t4, t4)), t0sqRz)
+    s4 = T.fp2_mul_many([(t1, t5), (T.fp2_sub(t4, t5), t0), (t3, Ry), (Rz, t3)])
+    Rx2 = s4[0]
+    Ry2 = T.fp2_sub(s4[1], s4[2])
+    Rz2 = s4[3]
+    return (Rx2, Ry2, Rz2), ell
+
+
+def _sparse014(o0, o1, o4, shape):
+    z = T.fp2_zeros(shape)
+    return ((o0, o1, z), (z, o4, z))
+
+
+def _apply_line(f, ell, px, py):
+    """f *= line, where the line's x/y coefficients are scaled by P's affine
+    coords.  Full fp12 multiply for now (sparse 014 later)."""
+    o1 = T.fp2_mul_fp(ell[1], px)
+    o4 = T.fp2_mul_fp(ell[2], py)
+    sp = _sparse014(ell[0], o1, o4, px.shape[:-1])
+    return T.fp12_mul(f, sp)
+
+
+def miller_loop(px, py, q2):
+    """f_{|x|,Q}(P), conjugated for x < 0.  All inputs affine, batched.
+
+    px, py: (..., 24) Fp limbs; q2: ((x0,x1),(y0,y1)) affine Fp2 pairs."""
+    shape = px.shape[:-1]
+    f0 = T.fp12_ones(shape)
+    R0 = (q2[0], q2[1], T.fp2_ones(shape))
+    bits = jnp.asarray(_LOOP_BITS)
+
+    def step(carry, bit):
+        f, Rp = carry
+        f = T.fp12_sqr(f)
+        Rp, ell = _dbl_step(Rp)
+        f = _apply_line(f, ell, px, py)
+        Rp_a, ell_a = _add_step(Rp, q2)
+        f_a = _apply_line(f, ell_a, px, py)
+        take = bit == 1
+        f = T.fp12_select(take, f_a, f)
+        Rp = DC.G2_DEV._select(take, Rp_a, Rp)
+        return (f, Rp), None
+
+    (f, _), _ = jax.lax.scan(step, (f0, R0), bits)
+    return T.fp12_conj(f)
+
+
+# ---------------------------------------------------------------------------
+# Final exponentiation (mirrors crypto/host/pairing.py:117-129)
+# ---------------------------------------------------------------------------
+
+def _pow_abs_x(g):
+    """g^|x| via scan over the static bits of |x| (MSB-first, skip leading 1)."""
+    bits = jnp.asarray(_LOOP_BITS)
+
+    def step(acc, bit):
+        acc = T.fp12_sqr(acc)
+        acc = T.fp12_select(bit == 1, T.fp12_mul(acc, g), acc)
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, g, bits)
+    return acc
+
+
+def _pow_x(g):
+    """g^x for x < 0: conjugate of g^|x| (valid after the easy part, where
+    g is in the cyclotomic subgroup and inverse == conjugate)."""
+    return T.fp12_conj(_pow_abs_x(g))
+
+
+def final_exponentiation(f):
+    # easy part: f^((p^6-1)(p^2+1))
+    f = T.fp12_mul(T.fp12_conj(f), T.fp12_inv(f))
+    f = T.fp12_mul(T.fp12_frobenius(f, 2), f)
+    # hard part (times 3): f^((x-1)^2 (x+p) (x^2+p^2-1)) * f^3
+    e1 = T.fp12_mul(_pow_x(f), T.fp12_conj(f))             # f^(x-1)
+    e1 = T.fp12_mul(_pow_x(e1), T.fp12_conj(e1))           # f^((x-1)^2)
+    e2 = T.fp12_mul(_pow_x(e1), T.fp12_frobenius(e1, 1))   # e1^(x+p)
+    e3 = T.fp12_mul(
+        T.fp12_mul(_pow_x(_pow_x(e2)), T.fp12_frobenius(e2, 2)),
+        T.fp12_conj(e2),
+    )                                                      # e2^(x^2+p^2-1)
+    f3 = T.fp12_mul(T.fp12_sqr(f), f)
+    return T.fp12_mul(e3, f3)
+
+
+def pairing(px, py, q2):
+    """Full batched pairing e(P, Q) (inputs affine limb tensors)."""
+    return final_exponentiation(miller_loop(px, py, q2))
+
+
+def fp12_prod_leading_axis(f):
+    """Multiply an Fp12 batch down its leading axis (tree reduction)."""
+    n = f[0][0][0].shape[0]
+    while n > 1:
+        half = n // 2
+        a = jax.tree.map(lambda t: t[:half], f)
+        b = jax.tree.map(lambda t: t[half:2 * half], f)
+        s = T.fp12_mul(a, b)
+        if n % 2:
+            rest = jax.tree.map(lambda t: t[2 * half:], f)
+            f = jax.tree.map(lambda x, y: jnp.concatenate([x, y], 0), s, rest)
+        else:
+            f = s
+        n = half + (n % 2)
+    return jax.tree.map(lambda t: t[0], f)
+
+
+def paired_product_is_one(px, py, q2, pair_axis_len: int):
+    """Check prod over the leading axis of e(P_i, Q_i) == 1 in ONE Miller call.
+
+    px, py: (k, ...) Fp limbs; q2 likewise.  The product collapses axis 0
+    (the k pairs of one verification equation); remaining axes stay batched."""
+    f = miller_loop(px, py, q2)
+    assert f[0][0][0].shape[0] == pair_axis_len
+    return T.fp12_is_one(final_exponentiation(fp12_prod_leading_axis(f)))
+
+
+def pairing_product_is_one(p1s, q2s):
+    """prod_i e(P_i, Q_i) == 1, one final exponentiation.
+
+    p1s: list of (px, py); q2s: list of affine fp2 pairs.  Each entry batched
+    identically; the product runs over the list index."""
+    f = None
+    for (px, py), q2 in zip(p1s, q2s):
+        fi = miller_loop(px, py, q2)
+        f = fi if f is None else T.fp12_mul(f, fi)
+    return T.fp12_is_one(final_exponentiation(f))

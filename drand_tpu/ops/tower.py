@@ -7,23 +7,26 @@ reference's kyber-bls12381 dependency (SURVEY.md §2.9):
   Fp6  : (a, b, c) of Fp2    a + b·v + c·v^2,    v^3 = xi = 1 + u
   Fp12 : (a, b)   of Fp6     a + b·w,            w^2 = v
 
-Every Fp leaf is a ``(..., 24)`` uint32 Montgomery limb tensor (see limbs.py);
-elements are plain nested tuples, so they are JAX pytrees and flow through
-`jit` / `vmap` / `lax.scan` unchanged.  All formulas are branch-free.
+Every Fp leaf is a ``(..., 24)`` uint32 Montgomery limb tensor (limbs.py);
+elements are nested tuples (JAX pytrees).  All formulas are branch-free.
+
+**Vertical batching**: the multiply formulas are *staged* — every group of
+independent limb products is executed as one stacked `mont_mul` (limbs.py
+`mul_many`), so e.g. an Fp6 multiply issues its 18 limb products as a single
+wide op.  This is what keeps XLA graphs small (compile time) and TPU vector
+lanes full (runtime); the `_many` variants batch k tower ops into the same
+stage count as one.
 """
 
 import jax.numpy as jnp
 
 from . import limbs as L
 from ..crypto.host.params import P
+from ..crypto.host import field as HF  # host golden code for constants only
 
 # ---------------------------------------------------------------------------
 # Fp2
 # ---------------------------------------------------------------------------
-
-
-def fp2(c0, c1):
-    return (c0, c1)
 
 
 def fp2_zeros(shape=()):
@@ -38,34 +41,59 @@ def fp2_ones(shape=()):
 
 
 def fp2_add(a, b):
-    return (L.add_mod(a[0], b[0]), L.add_mod(a[1], b[1]))
+    r = L.add_many([(a[0], b[0]), (a[1], b[1])])
+    return (r[0], r[1])
 
 
 def fp2_sub(a, b):
-    return (L.sub_mod(a[0], b[0]), L.sub_mod(a[1], b[1]))
+    r = L.sub_many([(a[0], b[0]), (a[1], b[1])])
+    return (r[0], r[1])
 
 
 def fp2_neg(a):
     return (L.neg_mod(a[0]), L.neg_mod(a[1]))
 
 
+def fp2_mul_many(pairs):
+    """k independent Fp2 products in 4 staged wide ops (3k limb muls in one)."""
+    k = len(pairs)
+    sums = L.add_many([(a[0], a[1]) for a, _ in pairs] + [(b[0], b[1]) for _, b in pairs])
+    t = L.mul_many(
+        [(a[0], b[0]) for a, b in pairs]
+        + [(a[1], b[1]) for a, b in pairs]
+        + [(sums[i], sums[k + i]) for i in range(k)]
+    )
+    t0 = t[:k]
+    t1 = t[k:2 * k]
+    t2 = t[2 * k:]
+    s = L.sub_many([(t0[i], t1[i]) for i in range(k)] + [(t2[i], t0[i]) for i in range(k)])
+    c0 = s[:k]
+    u = s[k:]
+    c1 = L.sub_many([(u[i], t1[i]) for i in range(k)])
+    return [(c0[i], c1[i]) for i in range(k)]
+
+
 def fp2_mul(a, b):
-    t0 = L.mont_mul(a[0], b[0])
-    t1 = L.mont_mul(a[1], b[1])
-    t2 = L.mont_mul(L.add_mod(a[0], a[1]), L.add_mod(b[0], b[1]))
-    return (L.sub_mod(t0, t1), L.sub_mod(L.sub_mod(t2, t0), t1))
+    return fp2_mul_many([(a, b)])[0]
+
+
+def fp2_sqr_many(xs):
+    """(a0+a1)(a0-a1), 2·a0·a1 — 2k limb muls in one stage."""
+    k = len(xs)
+    sums = L.add_many([(a[0], a[1]) for a in xs])
+    difs = L.sub_many([(a[0], a[1]) for a in xs])
+    t = L.mul_many([(sums[i], difs[i]) for i in range(k)] + [(a[0], a[1]) for a in xs])
+    c1 = L.add_many([(t[k + i], t[k + i]) for i in range(k)])
+    return [(t[i], c1[i]) for i in range(k)]
 
 
 def fp2_sqr(a):
-    # (a0+a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u
-    c0 = L.mont_mul(L.add_mod(a[0], a[1]), L.sub_mod(a[0], a[1]))
-    t = L.mont_mul(a[0], a[1])
-    return (c0, L.add_mod(t, t))
+    return fp2_sqr_many([a])[0]
 
 
 def fp2_mul_fp(a, k):
-    """Multiply by an Fp element (Montgomery limbs)."""
-    return (L.mont_mul(a[0], k), L.mont_mul(a[1], k))
+    r = L.mul_many([(a[0], k), (a[1], k)])
+    return (r[0], r[1])
 
 
 def fp2_conj(a):
@@ -78,9 +106,11 @@ def fp2_mul_xi(a):
 
 
 def fp2_inv(a):
-    norm = L.add_mod(L.mont_sqr(a[0]), L.mont_sqr(a[1]))
+    t = L.mul_many([(a[0], a[0]), (a[1], a[1])])
+    norm = L.add_mod(t[0], t[1])
     ninv = L.inv_mod(norm)
-    return (L.mont_mul(a[0], ninv), L.neg_mod(L.mont_mul(a[1], ninv)))
+    r = L.mul_many([(a[0], ninv), (a[1], ninv)])
+    return (r[0], L.neg_mod(r[1]))
 
 
 def fp2_is_zero(a):
@@ -99,20 +129,15 @@ def fp2_double(a):
     return fp2_add(a, a)
 
 
-def fp2_triple(a):
-    return fp2_add(fp2_add(a, a), a)
-
-
-def fp2_half(a):
-    """Divide by 2 (multiply by the Fp constant (p+1)/2 in Montgomery form)."""
-    return fp2_mul_fp(a, _HALF)
-
-
 _HALF = L.encode_mont((P + 1) // 2)
 
 
+def fp2_half(a):
+    return fp2_mul_fp(a, jnp.broadcast_to(_HALF, a[0].shape))
+
+
 # ---------------------------------------------------------------------------
-# Fp6 = Fp2[v]/(v^3 - xi)
+# Fp6 = Fp2[v]/(v^3 - xi), xi = 1 + u
 # ---------------------------------------------------------------------------
 
 
@@ -126,27 +151,58 @@ def fp6_ones(shape=()):
 
 
 def fp6_add(a, b):
-    return tuple(fp2_add(x, y) for x, y in zip(a, b))
+    r = L.add_many([(x[0], y[0]) for x, y in zip(a, b)] + [(x[1], y[1]) for x, y in zip(a, b)])
+    return tuple((r[i], r[3 + i]) for i in range(3))
 
 
 def fp6_sub(a, b):
-    return tuple(fp2_sub(x, y) for x, y in zip(a, b))
+    r = L.sub_many([(x[0], y[0]) for x, y in zip(a, b)] + [(x[1], y[1]) for x, y in zip(a, b)])
+    return tuple((r[i], r[3 + i]) for i in range(3))
 
 
 def fp6_neg(a):
     return tuple(fp2_neg(x) for x in a)
 
 
+def fp6_mul_many(pairs):
+    """k Fp6 products, Karatsuba-3: 6k Fp2 products in one fp2_mul_many."""
+    k = len(pairs)
+    # cross sums (fp2 adds, batched at limb level)
+    pre = L.add_many(
+        [p for a, b in pairs for p in (
+            (a[1][0], a[2][0]), (a[1][1], a[2][1]),
+            (b[1][0], b[2][0]), (b[1][1], b[2][1]),
+            (a[0][0], a[1][0]), (a[0][1], a[1][1]),
+            (b[0][0], b[1][0]), (b[0][1], b[1][1]),
+            (a[0][0], a[2][0]), (a[0][1], a[2][1]),
+            (b[0][0], b[2][0]), (b[0][1], b[2][1]),
+        )]
+    )
+
+    prods = []
+    for i, (a, b) in enumerate(pairs):
+        o = i * 12
+        a12 = (pre[o + 0], pre[o + 1])
+        b12 = (pre[o + 2], pre[o + 3])
+        a01 = (pre[o + 4], pre[o + 5])
+        b01 = (pre[o + 6], pre[o + 7])
+        a02 = (pre[o + 8], pre[o + 9])
+        b02 = (pre[o + 10], pre[o + 11])
+        prods += [(a[0], b[0]), (a[1], b[1]), (a[2], b[2]),
+                  (a12, b12), (a01, b01), (a02, b02)]
+    t = fp2_mul_many(prods)
+    out = []
+    for i in range(k):
+        t0, t1, t2, tc12, tc01, tc02 = t[6 * i:6 * i + 6]
+        c0 = fp2_add(t0, fp2_mul_xi(fp2_sub(fp2_sub(tc12, t1), t2)))
+        c1 = fp2_add(fp2_sub(fp2_sub(tc01, t0), t1), fp2_mul_xi(t2))
+        c2 = fp2_add(fp2_sub(fp2_sub(tc02, t0), t2), t1)
+        out.append((c0, c1, c2))
+    return out
+
+
 def fp6_mul(a, b):
-    a0, a1, a2 = a
-    b0, b1, b2 = b
-    t0 = fp2_mul(a0, b0)
-    t1 = fp2_mul(a1, b1)
-    t2 = fp2_mul(a2, b2)
-    c0 = fp2_add(t0, fp2_mul_xi(fp2_sub(fp2_sub(fp2_mul(fp2_add(a1, a2), fp2_add(b1, b2)), t1), t2)))
-    c1 = fp2_add(fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a1), fp2_add(b0, b1)), t0), t1), fp2_mul_xi(t2))
-    c2 = fp2_add(fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a2), fp2_add(b0, b2)), t0), t2), t1)
-    return (c0, c1, c2)
+    return fp6_mul_many([(a, b)])[0]
 
 
 def fp6_sqr(a):
@@ -159,16 +215,28 @@ def fp6_mul_by_v(a):
 
 def fp6_inv(a):
     a0, a1, a2 = a
-    c0 = fp2_sub(fp2_sqr(a0), fp2_mul_xi(fp2_mul(a1, a2)))
-    c1 = fp2_sub(fp2_mul_xi(fp2_sqr(a2)), fp2_mul(a0, a1))
-    c2 = fp2_sub(fp2_sqr(a1), fp2_mul(a0, a2))
-    t = fp2_add(fp2_mul_xi(fp2_add(fp2_mul(a1, c2), fp2_mul(a2, c1))), fp2_mul(a0, c0))
-    tinv = fp2_inv(t)
-    return (fp2_mul(c0, tinv), fp2_mul(c1, tinv), fp2_mul(c2, tinv))
+    t = fp2_mul_many([(a0, a0), (a1, a2), (a2, a2), (a0, a1), (a1, a1), (a0, a2)])
+    sq0, m12, sq2, m01, sq1, m02 = t
+    c0 = fp2_sub(sq0, fp2_mul_xi(m12))
+    c1 = fp2_sub(fp2_mul_xi(sq2), m01)
+    c2 = fp2_sub(sq1, m02)
+    u = fp2_mul_many([(a1, c2), (a2, c1), (a0, c0)])
+    tt = fp2_add(fp2_mul_xi(fp2_add(u[0], u[1])), u[2])
+    tinv = fp2_inv(tt)
+    r = fp2_mul_many([(c0, tinv), (c1, tinv), (c2, tinv)])
+    return (r[0], r[1], r[2])
 
 
 def fp6_select(cond, a, b):
     return tuple(fp2_select(cond, x, y) for x, y in zip(a, b))
+
+
+def fp6_is_zero(a):
+    z = None
+    for c in a:
+        e = fp2_is_zero(c)
+        z = e if z is None else z & e
+    return z
 
 
 # ---------------------------------------------------------------------------
@@ -180,6 +248,10 @@ def fp12_ones(shape=()):
     return (fp6_ones(shape), fp6_zeros(shape))
 
 
+def fp12_zeros(shape=()):
+    return (fp6_zeros(shape), fp6_zeros(shape))
+
+
 def fp12_add(a, b):
     return (fp6_add(a[0], b[0]), fp6_add(a[1], b[1]))
 
@@ -187,19 +259,34 @@ def fp12_add(a, b):
 def fp12_mul(a, b):
     a0, a1 = a
     b0, b1 = b
-    t0 = fp6_mul(a0, b0)
-    t1 = fp6_mul(a1, b1)
+    t = fp6_mul_many([(a0, b0), (a1, b1), (fp6_add(a0, a1), fp6_add(b0, b1))])
+    t0, t1, t2 = t
     c0 = fp6_add(t0, fp6_mul_by_v(t1))
-    c1 = fp6_sub(fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1)), t0), t1)
+    c1 = fp6_sub(fp6_sub(t2, t0), t1)
     return (c0, c1)
+
+
+def fp12_mul_many(pairs):
+    k = len(pairs)
+    prods = []
+    for a, b in pairs:
+        prods += [(a[0], b[0]), (a[1], b[1]), (fp6_add(a[0], a[1]), fp6_add(b[0], b[1]))]
+    t = fp6_mul_many(prods)
+    out = []
+    for i in range(k):
+        t0, t1, t2 = t[3 * i:3 * i + 3]
+        c0 = fp6_add(t0, fp6_mul_by_v(t1))
+        c1 = fp6_sub(fp6_sub(t2, t0), t1)
+        out.append((c0, c1))
+    return out
 
 
 def fp12_sqr(a):
     a0, a1 = a
-    t = fp6_mul(a0, a1)
-    c0 = fp6_mul(fp6_add(a0, a1), fp6_add(a0, fp6_mul_by_v(a1)))
-    c0 = fp6_sub(fp6_sub(c0, t), fp6_mul_by_v(t))
-    return (c0, fp6_add(t, t))
+    t = fp6_mul_many([(a0, a1), (fp6_add(a0, a1), fp6_add(a0, fp6_mul_by_v(a1)))])
+    tt, c0 = t
+    c0 = fp6_sub(fp6_sub(c0, tt), fp6_mul_by_v(tt))
+    return (c0, fp6_add(tt, tt))
 
 
 def fp12_conj(a):
@@ -208,24 +295,15 @@ def fp12_conj(a):
 
 def fp12_inv(a):
     a0, a1 = a
-    t = fp6_sub(fp6_sqr(a0), fp6_mul_by_v(fp6_sqr(a1)))
-    tinv = fp6_inv(t)
-    return (fp6_mul(a0, tinv), fp6_neg(fp6_mul(a1, tinv)))
+    t = fp6_mul_many([(a0, a0), (a1, a1)])
+    tt = fp6_sub(t[0], fp6_mul_by_v(t[1]))
+    tinv = fp6_inv(tt)
+    r = fp6_mul_many([(a0, tinv), (a1, tinv)])
+    return (r[0], fp6_neg(r[1]))
 
 
 def fp12_select(cond, a, b):
     return (fp6_select(cond, a[0], b[0]), fp6_select(cond, a[1], b[1]))
-
-
-def fp12_is_one(a):
-    one = fp12_ones(a[0][0][0].shape[:-1])
-    flat_a = _fp12_leaves(a)
-    flat_1 = _fp12_leaves(one)
-    ok = None
-    for x, y in zip(flat_a, flat_1):
-        e = L.eq(x, y)
-        ok = e if ok is None else ok & e
-    return ok
 
 
 def _fp12_leaves(a):
@@ -233,11 +311,18 @@ def _fp12_leaves(a):
     return [c for fp2c in (x0, x1, x2, y0, y1, y2) for c in fp2c]
 
 
+def fp12_is_one(a):
+    one = fp12_ones(a[0][0][0].shape[:-1])
+    ok = None
+    for x, y in zip(_fp12_leaves(a), _fp12_leaves(one)):
+        e = L.eq(x, y)
+        ok = e if ok is None else ok & e
+    return ok
+
+
 # ---------------------------------------------------------------------------
 # Frobenius (device constants precomputed on host via the golden field code)
 # ---------------------------------------------------------------------------
-
-from ..crypto.host import field as HF  # host golden code for constants only
 
 
 def _enc_fp2(c):
@@ -252,10 +337,9 @@ def fp12_frobenius(a, j=1):
     g = _FROB_DEV[j]
     (c0, c2, c4), (c1, c3, c5) = a
     cs = [c0, c1, c2, c3, c4, c5]
-    out = []
-    for i, c in enumerate(cs):
-        cc = fp2_conj(c) if j & 1 else c
-        out.append(fp2_mul(cc, g[i]))
+    if j & 1:
+        cs = [fp2_conj(c) for c in cs]
+    out = fp2_mul_many([(c, g[i]) for i, c in enumerate(cs)])
     return ((out[0], out[2], out[4]), (out[1], out[3], out[5]))
 
 

@@ -1,0 +1,122 @@
+"""Device curve / hash-to-curve / pairing kernels vs the host golden code.
+
+Host code is itself pinned by LoE mainnet known-answer vectors
+(tests/test_host_crypto.py), so agreement here transitively anchors the
+device kernels to real beacon data.  Compiles are cached persistently
+(tests/conftest.py) — first run is slow, later runs are seconds.
+"""
+
+import random
+
+import jax
+import pytest
+
+from drand_tpu.crypto.host import curve as C
+from drand_tpu.crypto.host import h2c as HH
+from drand_tpu.crypto.host import pairing as HP
+from drand_tpu.crypto.host.params import DST_G1, DST_G2, R, X as BLS_X
+from drand_tpu.ops import curve as DC
+from drand_tpu.ops import h2c as DH
+from drand_tpu.ops import limbs as L
+from drand_tpu.ops import pairing as DP
+from drand_tpu.ops import tower as T
+
+random.seed(99)
+
+KS = [random.randrange(1, R) for _ in range(4)]
+G1S = [C.G1.mul(C.G1.gen, k) for k in KS]
+G2S = [C.G2.mul(C.G2.gen, k) for k in KS]
+DP1 = DC.encode_g1_points(G1S)
+DP2 = DC.encode_g2_points(G2S)
+
+
+class TestCurve:
+    def test_g1_add_complete(self):
+        add_j = jax.jit(DC.G1_DEV.add)
+        assert DC.decode_g1_points(add_j(DP1, DC.encode_g1_points(G1S[::-1]))) == \
+            [C.G1.add(a, b) for a, b in zip(G1S, G1S[::-1])]
+        # P + P -> double, P + (-P) -> inf, inf identities
+        assert DC.decode_g1_points(add_j(DP1, DP1)) == [C.G1.double(p) for p in G1S]
+        neg = DC.encode_g1_points([C.G1.neg(p) for p in G1S])
+        assert DC.decode_g1_points(add_j(DP1, neg)) == [None] * 4
+        infs = DC.encode_g1_points([None] * 4)
+        assert DC.decode_g1_points(add_j(infs, DP1)) == G1S
+        assert DC.decode_g1_points(add_j(DP1, infs)) == G1S
+
+    def test_g2_double(self):
+        assert DC.decode_g2_points(jax.jit(DC.G2_DEV.double)(DP2)) == \
+            [C.G2.double(p) for p in G2S]
+
+    def test_scalar_mul_bits(self):
+        ss = [random.randrange(R) for _ in range(4)]
+        bits = DC.scalars_to_bits(ss)
+        got = DC.decode_g1_points(jax.jit(DC.G1_DEV.scalar_mul_bits)(DP1, bits))
+        assert got == [C.G1.mul(p, s) for p, s in zip(G1S, ss)]
+        got2 = DC.decode_g2_points(jax.jit(DC.G2_DEV.scalar_mul_bits)(DP2, bits))
+        assert got2 == [C.G2.mul(p, s) for p, s in zip(G2S, ss)]
+
+    def test_g2_cofactor_clear(self):
+        got = DC.decode_g2_points(jax.jit(DC.g2_clear_cofactor)(DP2))
+        assert got == [C.g2_clear_cofactor(p) for p in G2S]
+
+    def test_subgroup_checks(self):
+        assert all(bool(v) for v in jax.jit(DC.g2_in_subgroup)(DP2))
+        assert all(bool(v) for v in jax.jit(DC.g1_in_subgroup)(DP1))
+
+    def test_subgroup_check_rejects_non_member(self):
+        # A point on E2 but outside G2: map a field element to E2' through the
+        # isogeny WITHOUT clearing the cofactor.
+        u0, u1 = DH.hash_msgs_to_field_g2([b"non-member"])
+        raw = jax.jit(DH.map_to_g2_jac)(u0)
+        ok = jax.jit(DC.g2_in_subgroup)(raw)
+        assert not bool(ok[0])
+
+    def test_sum_points(self):
+        tot = jax.jit(DC.G1_DEV.sum_points)(DP1)
+        want = None
+        for p in G1S:
+            want = C.G1.add(want, p)
+        assert DC.decode_g1_points(tot)[0] == want
+
+
+class TestH2C:
+    def test_g2_matches_host(self):
+        msgs = [b"round-%d" % i for i in range(4)]
+        u0, u1 = DH.hash_msgs_to_field_g2(msgs)
+        got = DC.decode_g2_points(jax.jit(DH.hash_to_g2_jac)(u0, u1))
+        assert got == [HH.hash_to_curve_g2(m, DST_G2) for m in msgs]
+
+    def test_g1_matches_host(self):
+        msgs = [b"round-%d" % i for i in range(4)]
+        u0, u1 = DH.hash_msgs_to_field_g1(msgs)
+        got = DC.decode_g1_points(jax.jit(DH.hash_to_g1_jac)(u0, u1))
+        assert got == [HH.hash_to_curve_g1(m, DST_G1) for m in msgs]
+
+
+class TestPairing:
+    def test_pairing_matches_host(self):
+        px = L.encode_mont([p[0] for p in G1S[:2]])
+        py = L.encode_mont([p[1] for p in G1S[:2]])
+        qx = (L.encode_mont([q[0][0] for q in G2S[:2]]),
+              L.encode_mont([q[0][1] for q in G2S[:2]]))
+        qy = (L.encode_mont([q[1][0] for q in G2S[:2]]),
+              L.encode_mont([q[1][1] for q in G2S[:2]]))
+        f = jax.jit(DP.pairing)(px, py, (qx, qy))
+        for i in range(2):
+            got = T.decode_fp12(jax.tree.map(lambda a: a[i], f))
+            assert got == HP.pairing(G1S[i], G2S[i])
+
+    def test_product_check(self):
+        px = L.encode_mont([p[0] for p in G1S[:2]])
+        py = L.encode_mont([p[1] for p in G1S[:2]])
+        negpy = L.encode_mont([C.G1.neg(p)[1] for p in G1S[:2]])
+        qx = (L.encode_mont([q[0][0] for q in G2S[:2]]),
+              L.encode_mont([q[0][1] for q in G2S[:2]]))
+        qy = (L.encode_mont([q[1][0] for q in G2S[:2]]),
+              L.encode_mont([q[1][1] for q in G2S[:2]]))
+        ok = jax.jit(DP.pairing_product_is_one)(
+            [(px, py), (px, negpy)], [(qx, qy), (qx, qy)])
+        assert all(bool(v) for v in ok)
+        bad = jax.jit(DP.pairing_product_is_one)(
+            [(px, py), (px, py)], [(qx, qy), (qx, qy)])
+        assert not any(bool(v) for v in bad)
