@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Round benchmark: BASELINE config 2 — batch-verify unchained beacon rounds
+on one chip with the `bls-unchained-on-g1` scheme.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The baseline is the serial-CPU anchor from BASELINE.md: a single pairing-based
+verification is milliseconds-scale on one core, i.e. ~10^2-10^3 rounds/sec.
+We pin the anchor at 500 rounds/sec (midpoint, reference
+crypto/schemes_test.go:15-45 harness order-of-magnitude).
+
+The measured op is `BatchBeaconVerifier.verify_batch` end-to-end (host packing
++ device RLC pipeline), on signatures produced by the device signer — the
+same path a sync catch-up or client chain-replay takes.
+"""
+
+import json
+import os
+import sys
+import time
+
+# Persistent compile cache: the pairing/ladder programs are compile-heavy.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/drand_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+N = int(os.environ.get("DRAND_TPU_BENCH_N", "4096"))
+BASELINE_RPS = 500.0  # serial kyber CPU anchor (BASELINE.md)
+
+
+def main():
+    from drand_tpu.crypto import batch, schemes
+
+    sch = schemes.scheme_from_name(schemes.SHORT_SIG_SCHEME_ID)
+    sec, pub = sch.keypair(seed=b"drand-tpu-bench")
+    verifier = batch.BatchBeaconVerifier(sch, sch.public_bytes(pub))
+
+    rounds = list(range(1, N + 1))
+    msgs = [sch.digest_beacon(r, None) for r in rounds]
+    sigs = batch.sign_batch(sch, sec, msgs)
+
+    def fail():
+        print(json.dumps({"metric": "beacon_verify_rounds_per_sec", "value": 0,
+                          "unit": "rounds/s", "vs_baseline": 0,
+                          "error": "verification failed"}))
+        sys.exit(1)
+
+    # Warmup at full shape (compiles once; persistent cache across runs).
+    if not verifier.verify_batch(rounds, sigs).all():
+        fail()
+
+    t0 = time.perf_counter()
+    ok = verifier.verify_batch(rounds, sigs)
+    dt = time.perf_counter() - t0
+    if not ok.all():
+        fail()
+
+    rps = N / dt
+    print(json.dumps({
+        "metric": "beacon_verify_rounds_per_sec",
+        "value": round(rps, 1),
+        "unit": "rounds/s",
+        "vs_baseline": round(rps / BASELINE_RPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -59,101 +59,102 @@ def _rlc_scalars(n: int, pad: int):
 # across calls of the same padded size thanks to jit's shape cache)
 # ---------------------------------------------------------------------------
 
+def _rlc_run_g2sig(sig_jac, u0, u1, bits, pk_aff, neg_g1_aff):
+    """Scheme family with sigs on G2, keys on G1 (chained/unchained)."""
+    sub_ok = DC.g2_in_subgroup(sig_jac)
+    hm = DH.hash_to_g2_jac(u0, u1)
+    # one ladder for both MSMs: stack sigs and H(m)s along the batch axis
+    both = jax.tree.map(lambda a, b: jax.numpy.concatenate([a, b], 0), sig_jac, hm)
+    bits2 = jax.numpy.concatenate([bits, bits], axis=1)
+    mult = DC.G2_DEV.scalar_mul_bits(both, bits2)
+    n = bits.shape[1]
+    A = DC.G2_DEV.sum_points(jax.tree.map(lambda t: t[:n], mult))
+    B = DC.G2_DEV.sum_points(jax.tree.map(lambda t: t[n:], mult))
+    ax, ay, _ = DC.G2_DEV.to_affine(A)
+    bx, by, _ = DC.G2_DEV.to_affine(B)
+    # stack the 2 pairs of the check into one Miller call
+    px = jax.numpy.stack([neg_g1_aff[0], pk_aff[0]])
+    py = jax.numpy.stack([neg_g1_aff[1], pk_aff[1]])
+    qx = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), ax, bx)
+    qy = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), ay, by)
+    ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
+    return sub_ok, ok
+
+
+def _rlc_run_g1sig(sig_jac, u0, u1, bits, pk_aff, neg_g2_aff):
+    """Short-sig scheme: sigs on G1, keys on G2."""
+    sub_ok = DC.g1_in_subgroup(sig_jac)
+    hm = DH.hash_to_g1_jac(u0, u1)
+    both = jax.tree.map(lambda a, b: jax.numpy.concatenate([a, b], 0), sig_jac, hm)
+    bits2 = jax.numpy.concatenate([bits, bits], axis=1)
+    mult = DC.G1_DEV.scalar_mul_bits(both, bits2)
+    n = bits.shape[1]
+    A = DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[:n], mult))
+    B = DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[n:], mult))
+    ax, ay, _ = DC.G1_DEV.to_affine(A)
+    bx, by, _ = DC.G1_DEV.to_affine(B)
+    # e(A, -g2) · e(B, pk) == 1
+    px = jax.numpy.stack([ax, bx])
+    py = jax.numpy.stack([ay, by])
+    qx = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), neg_g2_aff[0], pk_aff[0])
+    qy = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), neg_g2_aff[1], pk_aff[1])
+    ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
+    return sub_ok, ok
+
+
+def _exact_run_g2sig(sig_jac, u0, u1, pk_aff, neg_g1_aff):
+    """Per-round exact check (fallback path): e(-g1,S_i)·e(pk,H_i) == 1."""
+    sub_ok = DC.g2_in_subgroup(sig_jac)
+    hm = DH.hash_to_g2_jac(u0, u1)
+    sx, sy, s_inf = DC.G2_DEV.to_affine(sig_jac)
+    hx, hy, _ = DC.G2_DEV.to_affine(hm)
+    n = u0[0].shape[0]
+    px = jax.numpy.stack([jax.numpy.broadcast_to(neg_g1_aff[0], (n, L.NLIMB)),
+                          jax.numpy.broadcast_to(pk_aff[0], (n, L.NLIMB))])
+    py = jax.numpy.stack([jax.numpy.broadcast_to(neg_g1_aff[1], (n, L.NLIMB)),
+                          jax.numpy.broadcast_to(pk_aff[1], (n, L.NLIMB))])
+    qx = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), sx, hx)
+    qy = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), sy, hy)
+    ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
+    return sub_ok & ~s_inf & ok
+
+
+def _exact_run_g1sig(sig_jac, u0, u1, pk_aff, neg_g2_aff):
+    sub_ok = DC.g1_in_subgroup(sig_jac)
+    hm = DH.hash_to_g1_jac(u0, u1)
+    sx, sy, s_inf = DC.G1_DEV.to_affine(sig_jac)
+    hx, hy, _ = DC.G1_DEV.to_affine(hm)
+    n = u0.shape[0]
+    # e(S, -g2) · e(H_i, pk) == 1
+    px = jax.numpy.stack([sx, hx])
+    py = jax.numpy.stack([sy, hy])
+    bc = lambda c: jax.numpy.broadcast_to(c, (n, L.NLIMB))
+    qx = jax.tree.map(lambda a, b: jax.numpy.stack([bc(a), bc(b)]),
+                      neg_g2_aff[0], pk_aff[0])
+    qy = jax.tree.map(lambda a, b: jax.numpy.stack([bc(a), bc(b)]),
+                      neg_g2_aff[1], pk_aff[1])
+    ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
+    return sub_ok & ~s_inf & ok
+
+
 @lru_cache(maxsize=None)
 def _rlc_pipeline_g2sig():
-    """Scheme family with sigs on G2, keys on G1 (chained/unchained)."""
-
-    def run(sig_jac, u0, u1, bits, pk_aff, neg_g1_aff):
-        sub_ok = DC.g2_in_subgroup(sig_jac)
-        hm = DH.hash_to_g2_jac(u0, u1)
-        # one ladder for both MSMs: stack sigs and H(m)s along the batch axis
-        both = jax.tree.map(lambda a, b: jax.numpy.concatenate([a, b], 0), sig_jac, hm)
-        bits2 = jax.numpy.concatenate([bits, bits], axis=1)
-        mult = DC.G2_DEV.scalar_mul_bits(both, bits2)
-        n = bits.shape[1]
-        A = DC.G2_DEV.sum_points(jax.tree.map(lambda t: t[:n], mult))
-        B = DC.G2_DEV.sum_points(jax.tree.map(lambda t: t[n:], mult))
-        ax, ay, _ = DC.G2_DEV.to_affine(A)
-        bx, by, _ = DC.G2_DEV.to_affine(B)
-        # stack the 2 pairs of the check into one Miller call
-        px = jax.numpy.stack([neg_g1_aff[0], pk_aff[0]])
-        py = jax.numpy.stack([neg_g1_aff[1], pk_aff[1]])
-        qx = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), ax, bx)
-        qy = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), ay, by)
-        ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
-        return sub_ok, ok
-
-    return jax.jit(run)
+    return jax.jit(_rlc_run_g2sig)
 
 
 @lru_cache(maxsize=None)
 def _rlc_pipeline_g1sig():
-    """Short-sig scheme: sigs on G1, keys on G2."""
-
-    def run(sig_jac, u0, u1, bits, pk_aff, neg_g2_aff):
-        sub_ok = DC.g1_in_subgroup(sig_jac)
-        hm = DH.hash_to_g1_jac(u0, u1)
-        both = jax.tree.map(lambda a, b: jax.numpy.concatenate([a, b], 0), sig_jac, hm)
-        bits2 = jax.numpy.concatenate([bits, bits], axis=1)
-        mult = DC.G1_DEV.scalar_mul_bits(both, bits2)
-        n = bits.shape[1]
-        A = DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[:n], mult))
-        B = DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[n:], mult))
-        ax, ay, _ = DC.G1_DEV.to_affine(A)
-        bx, by, _ = DC.G1_DEV.to_affine(B)
-        # e(A, -g2) · e(B, pk) == 1
-        px = jax.numpy.stack([ax, bx])
-        py = jax.numpy.stack([ay, by])
-        qx = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), neg_g2_aff[0], pk_aff[0])
-        qy = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), neg_g2_aff[1], pk_aff[1])
-        ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
-        return sub_ok, ok
-
-    return jax.jit(run)
+    return jax.jit(_rlc_run_g1sig)
 
 
 @lru_cache(maxsize=None)
 def _exact_pipeline_g2sig():
-    """Per-round exact check (fallback path): e(-g1,S_i)·e(pk,H_i) == 1."""
-
-    def run(sig_jac, u0, u1, pk_aff, neg_g1_aff):
-        sub_ok = DC.g2_in_subgroup(sig_jac)
-        hm = DH.hash_to_g2_jac(u0, u1)
-        sx, sy, s_inf = DC.G2_DEV.to_affine(sig_jac)
-        hx, hy, _ = DC.G2_DEV.to_affine(hm)
-        n = u0[0].shape[0]
-        px = jax.numpy.stack([jax.numpy.broadcast_to(neg_g1_aff[0], (n, L.NLIMB)),
-                              jax.numpy.broadcast_to(pk_aff[0], (n, L.NLIMB))])
-        py = jax.numpy.stack([jax.numpy.broadcast_to(neg_g1_aff[1], (n, L.NLIMB)),
-                              jax.numpy.broadcast_to(pk_aff[1], (n, L.NLIMB))])
-        qx = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), sx, hx)
-        qy = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), sy, hy)
-        ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
-        return sub_ok & ~s_inf & ok
-
-    return jax.jit(run)
+    return jax.jit(_exact_run_g2sig)
 
 
 @lru_cache(maxsize=None)
 def _exact_pipeline_g1sig():
-    def run(sig_jac, u0, u1, pk_aff, neg_g2_aff):
-        sub_ok = DC.g1_in_subgroup(sig_jac)
-        hm = DH.hash_to_g1_jac(u0, u1)
-        sx, sy, s_inf = DC.G1_DEV.to_affine(sig_jac)
-        hx, hy, _ = DC.G1_DEV.to_affine(hm)
-        n = u0.shape[0]
-        # e(S, -g2) · e(H_i, pk) == 1
-        px = jax.numpy.stack([sx, hx])
-        py = jax.numpy.stack([sy, hy])
-        bc = lambda c: jax.numpy.broadcast_to(c, (n, L.NLIMB))
-        qx = jax.tree.map(lambda a, b: jax.numpy.stack([bc(a), bc(b)]),
-                          neg_g2_aff[0], pk_aff[0])
-        qy = jax.tree.map(lambda a, b: jax.numpy.stack([bc(a), bc(b)]),
-                          neg_g2_aff[1], pk_aff[1])
-        ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
-        return sub_ok & ~s_inf & ok
-
-    return jax.jit(run)
+    return jax.jit(_exact_run_g1sig)
 
 
 # ---------------------------------------------------------------------------

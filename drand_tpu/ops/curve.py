@@ -274,12 +274,13 @@ G2_DEV = DevCurve(FP2_FNS, T.encode_fp2(B2), "G2")
 
 def scalars_to_bits(ks, nbits: int = 256) -> jnp.ndarray:
     """Host: list of ints -> (nbits, batch) MSB-first uint32 bit tensor."""
-    arr = np.zeros((nbits, len(ks)), dtype=np.uint32)
+    nbytes = (nbits + 7) // 8
+    lomask = (1 << nbits) - 1  # low nbits of the reduced scalar
+    buf = np.empty((len(ks), nbytes), dtype=np.uint8)
     for j, k in enumerate(ks):
-        k %= ORDER_R
-        for i in range(nbits):
-            arr[i, j] = (k >> (nbits - 1 - i)) & 1
-    return jnp.asarray(arr)
+        buf[j] = np.frombuffer((k % ORDER_R & lomask).to_bytes(nbytes, "big"), np.uint8)
+    bits = np.unpackbits(buf, axis=1)[:, -nbits:]
+    return jnp.asarray(np.ascontiguousarray(bits.T, dtype=np.uint32))
 
 
 # ---------------------------------------------------------------------------
