@@ -1,0 +1,12 @@
+"""Beacon protocol engine (reference chain/beacon/, SURVEY.md §2.5):
+ticker, partial cache, aggregator, store decorators, round-loop handler,
+sync manager."""
+
+from .clock import Clock, FakeClock, RealClock
+from .ticker import Ticker
+from .cache import PartialCache
+from .chainstore import ChainStore
+from .node import Handler, HandlerConfig
+
+__all__ = ["Clock", "RealClock", "FakeClock", "Ticker", "PartialCache",
+           "ChainStore", "Handler", "HandlerConfig"]

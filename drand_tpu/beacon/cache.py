@@ -1,0 +1,91 @@
+"""Partial-signature cache with anti-DoS bounds (chain/beacon/cache.go:17-168).
+
+Partials are cached per (round, previous_sig) key — a malicious node cannot
+poison a round by sending a partial with a different previous signature than
+honest nodes'.  Each signer index may occupy at most MAX_PARTIALS_PER_NODE
+cached rounds; its oldest round is evicted beyond that (constants.go:14)."""
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from ..crypto.tbls import index_of
+
+MAX_PARTIALS_PER_NODE = 100
+
+
+class _RoundCache:
+    def __init__(self, round_: int, prev_sig: Optional[bytes]):
+        self.round = round_
+        self.prev_sig = prev_sig
+        self.partials: Dict[int, bytes] = {}
+        # idx -> verification outcome, filled at aggregation time
+        self.checked: Dict[int, bool] = {}
+
+    def append(self, partial: bytes) -> bool:
+        idx = index_of(partial)
+        if idx in self.partials:
+            return False
+        self.partials[idx] = partial
+        return True
+
+    def __len__(self) -> int:
+        return len(self.partials)
+
+
+class PartialCache:
+    def __init__(self, max_per_node: int = MAX_PARTIALS_PER_NODE):
+        self._lock = threading.Lock()
+        self._rounds: Dict[Tuple[int, bytes], _RoundCache] = {}
+        # per-signer FIFO of cache keys it occupies (eviction order)
+        self._per_node: Dict[int, OrderedDict] = {}
+        self._max_per_node = max_per_node
+
+    @staticmethod
+    def _key(round_: int, prev_sig: Optional[bytes]):
+        return (round_, prev_sig or b"")
+
+    def append(self, round_: int, prev_sig: Optional[bytes],
+               partial: bytes) -> "_RoundCache":
+        """Cache one partial; returns the round cache it landed in."""
+        idx = index_of(partial)
+        key = self._key(round_, prev_sig)
+        with self._lock:
+            rc = self._rounds.get(key)
+            if rc is None:
+                rc = self._rounds[key] = _RoundCache(round_, prev_sig)
+            if rc.append(partial):
+                seen = self._per_node.setdefault(idx, OrderedDict())
+                if key not in seen:
+                    seen[key] = True
+                    if len(seen) > self._max_per_node:
+                        evict_key, _ = seen.popitem(last=False)
+                        evicted = self._rounds.get(evict_key)
+                        if evicted is not None:
+                            evicted.partials.pop(idx, None)
+                            if not evicted.partials:
+                                del self._rounds[evict_key]
+            return rc
+
+    def get(self, round_: int, prev_sig: Optional[bytes]) -> Optional[_RoundCache]:
+        with self._lock:
+            return self._rounds.get(self._key(round_, prev_sig))
+
+    def get_round_partials(self, round_: int) -> List[bytes]:
+        """All partials cached for a round across prev-sig variants."""
+        with self._lock:
+            out = []
+            for (r, _), rc in self._rounds.items():
+                if r == round_:
+                    out.extend(rc.partials.values())
+            return out
+
+    def flush_rounds(self, upto: int) -> None:
+        """Drop every cached round <= upto (cache.go:55-70): once a beacon is
+        stored, its partials are useless."""
+        with self._lock:
+            for key in [k for k in self._rounds if k[0] <= upto]:
+                del self._rounds[key]
+            for seen in self._per_node.values():
+                for key in [k for k in seen if k[0] <= upto]:
+                    del seen[key]

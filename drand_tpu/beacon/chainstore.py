@@ -1,0 +1,194 @@
+"""Aggregator: collect validated partials, recover at threshold, verify,
+append (chain/beacon/chainstore.go:24-333).
+
+A single aggregator thread consumes validated partials from a queue (the
+reference's `runAggregator` goroutine).  When a (round, prev_sig) cache
+reaches the group threshold it Lagrange-recovers the full signature
+(tbls.Recover, chainstore.go:202), verifies it against the collective key
+(chainstore.go:207) and appends through the decorator chain; the cache is
+flushed on every store (partials for stored rounds are dead weight)."""
+
+import queue
+import threading
+from typing import Callable, Optional
+
+from ..chain.beacon import Beacon
+from ..chain.errors import ErrNoBeaconStored
+from ..crypto import tbls
+from ..crypto.vault import Vault
+from .cache import PartialCache
+from .clock import Clock
+from .stores import (AppendStore, CallbackStore, DiscrepancyStore,
+                     ErrBeaconAlreadyStored, SchemeStore)
+
+
+class HostPartialVerifier:
+    """Serial host verification (the reference's per-packet path)."""
+
+    def __init__(self, scheme, pub_poly):
+        self.scheme = scheme
+        self.pub_poly = pub_poly
+
+    def verify(self, msg: bytes, partials):
+        return [tbls.verify_partial(self.scheme, self.pub_poly, msg, p)
+                for p in partials]
+
+
+class DevicePartialVerifier:
+    """TPU-batched verification (crypto/partials.py) — the design's point:
+    partials are validated in one RLC block at aggregation time instead of
+    one 2-pairing check per packet (node.go:150)."""
+
+    def __init__(self, scheme, pub_poly, n_nodes: int):
+        from .. crypto.partials import BatchPartialVerifier
+        self._bv = BatchPartialVerifier(scheme, pub_poly, n_nodes)
+
+    def verify(self, msg: bytes, partials):
+        return self._bv.verify_partials([msg], [list(partials)])[0].tolist()
+
+
+class ChainStore:
+    def __init__(self, backend, vault: Vault, clock: Clock, group,
+                 on_sync_needed: Optional[Callable[[int], None]] = None,
+                 on_discrepancy=None, partial_verifier=None):
+        """`backend`: raw chain.Store; `group`: key.Group (threshold, times).
+
+        Decorator assembly mirrors chainstore.go:43-75.  Partials get their
+        cryptographic check at aggregation time through `partial_verifier`
+        (host serial by default; DevicePartialVerifier for the TPU path)."""
+        self.vault = vault
+        self.group = group
+        self.partial_verifier = partial_verifier or HostPartialVerifier(
+            vault.scheme, vault.get_pub())
+        disc = DiscrepancyStore(backend, clock, group.period,
+                                group.genesis_time, on_discrepancy)
+        sch = SchemeStore(disc, vault.scheme.chained)
+        self._append = AppendStore(sch)
+        self.cbstore = CallbackStore(self._append)
+        self.cache = PartialCache()
+        self.on_sync_needed = on_sync_needed
+        self._partials: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._new_beacon = threading.Condition()
+        self._thread = threading.Thread(target=self._run_aggregator,
+                                        daemon=True, name="aggregator")
+        self._thread.start()
+
+    # -- store facade (reads/writes go through the decorator chain) ---------
+
+    @property
+    def store(self):
+        return self.cbstore
+
+    def last(self) -> Beacon:
+        return self.cbstore.last()
+
+    def put(self, beacon: Beacon) -> None:
+        self.cbstore.put(beacon)
+        self._on_stored(beacon)
+
+    def _on_stored(self, beacon: Beacon) -> None:
+        self.cache.flush_rounds(beacon.round)
+        with self._new_beacon:
+            self._new_beacon.notify_all()
+
+    def wait_for_round(self, round_: int, timeout: float) -> Optional[Beacon]:
+        """Block until the chain reaches `round_` (real-time timeout)."""
+        import time as _t
+        deadline = _t.monotonic() + timeout
+        while True:
+            try:
+                last = self.last()
+                if last.round >= round_:
+                    return self.cbstore.get(round_) if last.round != round_ else last
+            except ErrNoBeaconStored:
+                pass
+            remaining = deadline - _t.monotonic()
+            if remaining <= 0:
+                return None
+            with self._new_beacon:
+                self._new_beacon.wait(min(remaining, 0.1))
+
+    # -- aggregation ---------------------------------------------------------
+
+    def new_valid_partial(self, round_: int, prev_sig: Optional[bytes],
+                          partial: bytes) -> None:
+        """Feed one ingress-validated partial (chainstore.go:106)."""
+        self._partials.put((round_, prev_sig, partial))
+
+    def _run_aggregator(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = self._partials.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                self._process_partial(*item)
+            except Exception:
+                pass
+
+    def _process_partial(self, round_: int, prev_sig: Optional[bytes],
+                         partial: bytes) -> None:
+        try:
+            last = self.cbstore.last()
+        except ErrNoBeaconStored:
+            return
+        if round_ <= last.round:
+            return  # already have that beacon
+        rc = self.cache.append(round_, prev_sig, partial)
+        thr = self.group.threshold
+        if len(rc) < thr:
+            return
+
+        scheme = self.vault.scheme
+        msg = scheme.digest_beacon(round_, prev_sig if scheme.chained else None)
+
+        # Verify whatever the cache holds unchecked, in one batch (the
+        # TPU-first move of node.go:150's per-packet pairing to aggregation
+        # time); invalid partials are dropped from the cache for good.
+        unchecked = [p for idx, p in rc.partials.items()
+                     if idx not in rc.checked]
+        if unchecked:
+            results = self.partial_verifier.verify(msg, unchecked)
+            for p, ok in zip(unchecked, results):
+                idx = tbls.index_of(p)
+                rc.checked[idx] = bool(ok)
+                if not ok:
+                    rc.partials.pop(idx, None)
+        good = [p for idx, p in rc.partials.items() if rc.checked.get(idx)]
+        if len(good) < thr:
+            return
+
+        pub_poly = self.vault.get_pub()
+        try:
+            sig = tbls.recover(scheme, pub_poly, msg, good[:thr],
+                               thr, len(self.group), verify_each=False)
+        except ValueError:
+            return
+        pub = self.vault.public_key_bytes()
+        if not scheme.verify_beacon(pub, round_, prev_sig, sig):
+            # should be unreachable once partials are verified; drop and wait
+            # for more honest partials (chainstore.go:207-218)
+            rc.partials.clear()
+            rc.checked.clear()
+            return
+        beacon = Beacon(round=round_, signature=sig, previous_sig=prev_sig)
+        self._try_append(last, beacon)
+
+    def _try_append(self, last: Beacon, beacon: Beacon) -> None:
+        if last.round + 1 < beacon.round:
+            # we aggregated a round ahead of our chain: sync the gap first
+            if self.on_sync_needed is not None:
+                self.on_sync_needed(beacon.round)
+            return
+        try:
+            self.put(beacon)
+        except ErrBeaconAlreadyStored:
+            pass  # racing with the sync path is benign (chainstore.go:253-265)
+        except ValueError:
+            pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.cbstore.close()

@@ -1,0 +1,25 @@
+"""Chain core: beacon type, chain info, round/time math, stores.
+
+Mirrors the capability surface of the reference's `chain/` package root
+(SURVEY.md §2.3) plus the embedded storage backends (§2.4), redesigned for
+this framework: beacons are immutable dataclasses, stores are plain Python
+classes with an abstract interface, and the durable engine is sqlite (the
+in-tree analogue of the reference's boltdb single-bucket store).
+"""
+
+from .beacon import Beacon, genesis_beacon
+from .errors import ErrNoBeaconStored, ErrNoBeaconSaved
+from .info import Info
+from .timing import (TIME_OF_ROUND_ERROR, current_round, next_round,
+                     time_of_round)
+from .store import Cursor, Store, round_to_bytes, bytes_to_round
+from .memdb import MemDBStore
+from .sqlitedb import SqliteStore
+
+__all__ = [
+    "Beacon", "genesis_beacon", "Info",
+    "ErrNoBeaconStored", "ErrNoBeaconSaved",
+    "TIME_OF_ROUND_ERROR", "time_of_round", "current_round", "next_round",
+    "Store", "Cursor", "round_to_bytes", "bytes_to_round",
+    "MemDBStore", "SqliteStore",
+]

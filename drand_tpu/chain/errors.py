@@ -1,0 +1,9 @@
+"""Chain error types (chain/errors/errors.go)."""
+
+
+class ErrNoBeaconStored(Exception):
+    """Sync called too early: no beacon stored above the requested round."""
+
+
+class ErrNoBeaconSaved(Exception):
+    """Beacon not found in the database."""

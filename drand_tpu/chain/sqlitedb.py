@@ -1,0 +1,129 @@
+"""Durable embedded store on sqlite — the boltdb-equivalent engine.
+
+Uses the reference's *trimmed* format (chain/boltdb/trimmed.go:20-322): only
+(round, signature) is persisted; `previous_sig` is reconstructed from round-1
+on read when the caller asks for it (chained schemes need it to re-derive the
+digest; unchained schemes never do).  One table keyed by round — the direct
+analogue of boltdb's single `beacons` bucket keyed by be64(round)
+(chain/boltdb/store.go:24-329).
+"""
+
+import sqlite3
+import threading
+from typing import Optional
+
+from .beacon import Beacon
+from .errors import ErrNoBeaconSaved, ErrNoBeaconStored
+from .store import Cursor, Store
+
+
+class SqliteStore(Store):
+    def __init__(self, path: str, require_previous: bool = False):
+        """`require_previous`: reconstruct previous_sig on reads (set for
+        chained schemes; chain/beacon.go:90-97 context flag)."""
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.RLock()
+        self.require_previous = require_previous
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS beacons ("
+                " round INTEGER PRIMARY KEY,"
+                " signature BLOB NOT NULL)")
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.commit()
+
+    def __len__(self) -> int:
+        with self._lock:
+            (n,) = self._conn.execute("SELECT COUNT(*) FROM beacons").fetchone()
+            return n
+
+    def put(self, beacon: Beacon) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO beacons (round, signature) VALUES (?, ?)",
+                (beacon.round, beacon.signature))
+            self._conn.commit()
+
+    def _fill_previous(self, round_: int, signature: bytes) -> Beacon:
+        prev = None
+        if self.require_previous and round_ > 0:
+            row = self._conn.execute(
+                "SELECT signature FROM beacons WHERE round = ?",
+                (round_ - 1,)).fetchone()
+            if row is not None:
+                prev = bytes(row[0])
+        return Beacon(round=round_, signature=bytes(signature), previous_sig=prev)
+
+    def last(self) -> Beacon:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT round, signature FROM beacons"
+                " ORDER BY round DESC LIMIT 1").fetchone()
+            if row is None:
+                raise ErrNoBeaconStored()
+            return self._fill_previous(row[0], row[1])
+
+    def get(self, round_: int) -> Beacon:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT signature FROM beacons WHERE round = ?",
+                (round_,)).fetchone()
+            if row is None:
+                raise ErrNoBeaconSaved()
+            return self._fill_previous(round_, row[0])
+
+    def delete(self, round_: int) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM beacons WHERE round = ?", (round_,))
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    def cursor(self) -> Cursor:
+        return _SqliteCursor(self)
+
+    def save_to(self, fileobj) -> None:
+        """Native snapshot: the serialized sqlite image (BackupDatabase RPC,
+        chain/store.go:24 SaveTo analogue)."""
+        with self._lock:
+            fileobj.write(self._conn.serialize())
+
+
+class _SqliteCursor(Cursor):
+    def __init__(self, store: SqliteStore):
+        self._store = store
+        self._round: Optional[int] = None
+
+    def _row_to_beacon(self, row) -> Optional[Beacon]:
+        if row is None:
+            self._round = None
+            return None
+        self._round = row[0]
+        with self._store._lock:
+            return self._store._fill_previous(row[0], row[1])
+
+    def _query(self, sql, args=()):
+        with self._store._lock:
+            return self._store._conn.execute(sql, args).fetchone()
+
+    def first(self) -> Optional[Beacon]:
+        return self._row_to_beacon(self._query(
+            "SELECT round, signature FROM beacons ORDER BY round ASC LIMIT 1"))
+
+    def next(self) -> Optional[Beacon]:
+        if self._round is None:
+            return None
+        return self._row_to_beacon(self._query(
+            "SELECT round, signature FROM beacons WHERE round > ?"
+            " ORDER BY round ASC LIMIT 1", (self._round,)))
+
+    def seek(self, round_: int) -> Optional[Beacon]:
+        return self._row_to_beacon(self._query(
+            "SELECT round, signature FROM beacons WHERE round >= ?"
+            " ORDER BY round ASC LIMIT 1", (round_,)))
+
+    def last(self) -> Optional[Beacon]:
+        return self._row_to_beacon(self._query(
+            "SELECT round, signature FROM beacons ORDER BY round DESC LIMIT 1"))

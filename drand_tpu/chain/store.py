@@ -1,0 +1,79 @@
+"""Store interface + cursor (chain/store.go:16-56,82-92).
+
+Stores hold the beacon chain ordered by round.  All methods are synchronous;
+engines guard their own state (the beacon engine calls them from multiple
+threads).
+"""
+
+import struct
+from abc import ABC, abstractmethod
+from typing import Iterator, Optional
+
+from .beacon import Beacon
+
+
+def round_to_bytes(r: int) -> bytes:
+    """8-byte fixed-length big-endian round key (store.go:82)."""
+    return struct.pack(">Q", r)
+
+
+def bytes_to_round(b: bytes) -> int:
+    return struct.unpack(">Q", b)[0]
+
+
+class Cursor(ABC):
+    """Iterates beacons in ascending round order."""
+
+    @abstractmethod
+    def first(self) -> Optional[Beacon]: ...
+
+    @abstractmethod
+    def next(self) -> Optional[Beacon]: ...
+
+    @abstractmethod
+    def seek(self, round_: int) -> Optional[Beacon]: ...
+
+    @abstractmethod
+    def last(self) -> Optional[Beacon]: ...
+
+    def __iter__(self) -> Iterator[Beacon]:
+        b = self.first()
+        while b is not None:
+            yield b
+            b = self.next()
+
+
+class Store(ABC):
+    """Beacon chain storage (chain/store.go:16-24)."""
+
+    @abstractmethod
+    def __len__(self) -> int: ...
+
+    @abstractmethod
+    def put(self, beacon: Beacon) -> None: ...
+
+    @abstractmethod
+    def last(self) -> Beacon:
+        """Raises ErrNoBeaconStored when empty."""
+
+    @abstractmethod
+    def get(self, round_: int) -> Beacon:
+        """Raises ErrNoBeaconSaved when absent."""
+
+    @abstractmethod
+    def cursor(self) -> Cursor: ...
+
+    @abstractmethod
+    def close(self) -> None: ...
+
+    @abstractmethod
+    def delete(self, round_: int) -> None: ...
+
+    def save_to(self, fileobj) -> None:
+        """Stream a backup of the full store (chain/store.go:24).
+
+        Default: hexjson lines in round order (engines may override with a
+        native snapshot)."""
+        cur = self.cursor()
+        for b in cur:
+            fileobj.write(b.to_json() + b"\n")

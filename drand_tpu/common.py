@@ -1,0 +1,23 @@
+"""Shared constants: beacon IDs, versioning (reference common/beacon.go:9-21,
+common/version.go)."""
+
+DEFAULT_BEACON_ID = "default"
+DEFAULT_CHAIN_HASH = "default"
+MULTI_BEACON_FOLDER = "multibeacon"
+
+# Reduce log verbosity in bulk loops: log every LOGS_TO_SKIP steps.
+LOGS_TO_SKIP = 300
+
+# Protocol version advertised in packet metadata; peers reject incompatible
+# major.minor (core/drand_daemon_interceptors.go:19-89).
+VERSION = (2, 0, 0)
+
+
+def is_default_beacon_id(beacon_id: str) -> bool:
+    return beacon_id in ("", DEFAULT_BEACON_ID)
+
+
+def compare_beacon_ids(id1: str, id2: str) -> bool:
+    if is_default_beacon_id(id1) and is_default_beacon_id(id2):
+        return True
+    return id1 == id2

@@ -220,33 +220,56 @@ class BatchBeaconVerifier:
 
     # -- verification ---------------------------------------------------------
 
+    def _rlc_ok(self, pts, msgs) -> bool:
+        """One RLC check over the range; True iff every round verifies."""
+        n = len(msgs)
+        pad = _pad_len(n)
+        sig_jac, u0, u1 = self._encode(pts, msgs, pad)
+        bits = _rlc_scalars(n, pad)
+        pipe = _rlc_pipeline_g2sig() if self.g2sig else _rlc_pipeline_g1sig()
+        sub_ok, ok = pipe(sig_jac, u0, u1, bits, self.pk_aff, self.fixed_aff)
+        return bool(ok) and np.asarray(sub_ok)[:n].all()
+
+    def _exact(self, pts, msgs) -> np.ndarray:
+        """Per-round exact pairing checks over the range."""
+        n = len(msgs)
+        pad = _pad_len(n)
+        sig_jac, u0, u1 = self._encode(pts, msgs, pad)
+        pipe = _exact_pipeline_g2sig() if self.g2sig else _exact_pipeline_g1sig()
+        return np.asarray(pipe(sig_jac, u0, u1, self.pk_aff, self.fixed_aff))[:n]
+
+    # Below this range size a failed RLC goes straight to exact checks;
+    # above it, bisect with RLC halves so one bad round costs O(log n) RLC
+    # passes + one small exact pass instead of exact pairings for the whole
+    # chunk.  Compiled shapes stay bounded: every level is a power of two.
+    _BISECT_MIN = 64
+
+    def _verify_range(self, pts, msgs, bad) -> np.ndarray:
+        n = len(msgs)
+        if not bad.any() and self._rlc_ok(pts, msgs):
+            return np.ones(n, dtype=bool)
+        if n <= self._BISECT_MIN:
+            return self._exact(pts, msgs) & ~bad
+        mid = n // 2
+        return np.concatenate([
+            self._verify_range(pts[:mid], msgs[:mid], bad[:mid]),
+            self._verify_range(pts[mid:], msgs[mid:], bad[mid:]),
+        ])
+
     def verify_batch(self, rounds, sigs, prev_sigs=None) -> np.ndarray:
         """Verify N beacons; returns a bool validity array of length N.
 
-        Fast path: one RLC check for the whole batch.  On failure, exact
-        per-round checks locate the invalid rounds."""
+        Fast path: one RLC check for the whole batch.  On failure, RLC
+        bisection narrows to the bad region, then exact per-round checks
+        locate the invalid rounds."""
         n = len(rounds)
         if n == 0:
             return np.zeros(0, dtype=bool)
         if prev_sigs is None:
             prev_sigs = [None] * n
-        pad = _pad_len(n)
         msgs = self._messages(rounds, prev_sigs)
         pts, bad = self._parse_sigs(sigs)
-        sig_jac, u0, u1 = self._encode(pts, msgs, pad)
-
-        if not bad.any():
-            bits = _rlc_scalars(n, pad)
-            pipe = _rlc_pipeline_g2sig() if self.g2sig else _rlc_pipeline_g1sig()
-            sub_ok, ok = pipe(sig_jac, u0, u1, bits, self.pk_aff, self.fixed_aff)
-            sub_ok = np.asarray(sub_ok)[:n]
-            if bool(ok) and sub_ok.all():
-                return np.ones(n, dtype=bool)
-
-        # exact fallback: locate bad rounds
-        pipe = _exact_pipeline_g2sig() if self.g2sig else _exact_pipeline_g1sig()
-        valid = np.asarray(pipe(sig_jac, u0, u1, self.pk_aff, self.fixed_aff))[:n]
-        return valid & ~bad
+        return self._verify_range(pts, msgs, bad)
 
     def verify_chain(self, beacons):
         """Verify a chained sequence of (round, sig, prev_sig) host-side

@@ -1,0 +1,243 @@
+"""Batched threshold-partial verification on TPU (BASELINE config 3).
+
+The reference verifies each incoming partial with two pairings on the CPU
+(`tbls.VerifyPartial`, chain/beacon/node.go:150) — O(n) pairings per round
+per node, its hottest call site.  Here a whole (rounds x slots) block is
+collapsed into ONE Miller product via a per-signer random linear combination:
+
+    forall (r,j):  e(-g1, S_rj) · e(pk_idx(rj), H_r) == 1
+    ==>  e(-g1, sum_rj c_rj·S_rj) · prod_i e(pk_i, T_i) == 1
+         with  T_i = sum over slots with idx==i of c_rj·H_r
+
+sound except with probability ~2^-SECURITY_BITS.  pk_i = PubPoly.eval(i) is
+evaluated once per group on the host (the polynomial is tiny); the Miller
+product has (#distinct signers + 1) pairs.  On RLC failure, exact per-slot
+pairing checks locate invalid partials.
+
+Slot layout: callers pass ragged per-round partial lists (wire format:
+be16(index) || sig); rows are padded to the widest row and masked.
+"""
+
+import secrets
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import tbls as HT
+from .batch import SECURITY_BITS, _NEG_G1, _NEG_G2
+from .host.params import G1_GEN, G2_GEN
+from .schemes import Scheme, GroupG2
+from ..ops import curve as DC
+from ..ops import h2c as DH
+from ..ops import limbs as L
+from ..ops import pairing as DP
+
+
+def _tile_rounds(tree_pt, k):
+    """(r, ...) point -> (r*k, ...): slot (r, j) sees round r's value."""
+    return jax.tree.map(lambda t: jnp.repeat(t, k, axis=0), tree_pt)
+
+
+def _masked_sums(curve, pts, onehot):
+    """Per-signer sums: T_i = sum over slots with onehot[i]==1 (complete
+    adds; masked-out slots become infinity)."""
+    inf = curve.infinity((onehot.shape[1],))
+    out = []
+    for i in range(onehot.shape[0]):
+        cond = onehot[i] == 1
+        sel = curve._select(cond, pts, inf)
+        out.append(curve.sum_points(sel))
+    return out
+
+
+def _stack_points(pts):
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *pts)
+
+
+def _rlc_partials_run_g2sig(sig_jac, u0, u1, bits, onehot, pk_sel, neg_g1_aff):
+    """sigs on G2, pks on G1.  sig_jac: (rk,) G2 jac; u0/u1: (r,) fp2;
+    bits: (SB, 2rk); onehot: (p, rk); pk_sel: ((p,24),(p,24)) G1 affine."""
+    rk = onehot.shape[1]
+    r = u0[0].shape[0]
+    k = rk // r
+    sub_ok = DC.g2_in_subgroup(sig_jac)
+    hm = _tile_rounds(DH.hash_to_g2_jac(u0, u1), k)
+    both = jax.tree.map(lambda a, b: jnp.concatenate([a, b], 0), sig_jac, hm)
+    mult = DC.G2_DEV.scalar_mul_bits(both, bits)
+    s_sum = DC.G2_DEV.sum_points(jax.tree.map(lambda t: t[:rk], mult))
+    ch = jax.tree.map(lambda t: t[rk:], mult)
+    ts = _masked_sums(DC.G2_DEV, ch, onehot)
+    qx_all, qy_all, _ = DC.G2_DEV.to_affine(_stack_points([s_sum] + ts))
+    px = jnp.concatenate([neg_g1_aff[0][None], pk_sel[0]], axis=0)
+    py = jnp.concatenate([neg_g1_aff[1][None], pk_sel[1]], axis=0)
+    ok = DP.paired_product_is_one(px, py, (qx_all, qy_all),
+                                  onehot.shape[0] + 1)
+    return sub_ok, ok
+
+
+def _rlc_partials_run_g1sig(sig_jac, u0, u1, bits, onehot, pk_sel, neg_g2_aff):
+    """sigs on G1, pks on G2 (short-sig scheme)."""
+    rk = onehot.shape[1]
+    r = u0.shape[0]
+    k = rk // r
+    sub_ok = DC.g1_in_subgroup(sig_jac)
+    hm = _tile_rounds(DH.hash_to_g1_jac(u0, u1), k)
+    both = jax.tree.map(lambda a, b: jnp.concatenate([a, b], 0), sig_jac, hm)
+    mult = DC.G1_DEV.scalar_mul_bits(both, bits)
+    s_sum = DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[:rk], mult))
+    ch = jax.tree.map(lambda t: t[rk:], mult)
+    ts = _masked_sums(DC.G1_DEV, ch, onehot)
+    px_all, py_all, _ = DC.G1_DEV.to_affine(_stack_points([s_sum] + ts))
+    qx = jax.tree.map(lambda a, b: jnp.concatenate([a[None], b], axis=0),
+                      neg_g2_aff[0], pk_sel[0])
+    qy = jax.tree.map(lambda a, b: jnp.concatenate([a[None], b], axis=0),
+                      neg_g2_aff[1], pk_sel[1])
+    ok = DP.paired_product_is_one(px_all, py_all, (qx, qy),
+                                  onehot.shape[0] + 1)
+    return sub_ok, ok
+
+
+def _exact_partials_run_g2sig(sig_jac, u0, u1, k, pk_slot, neg_g1_aff):
+    """Per-slot exact checks with per-slot pubkeys (fallback path)."""
+    sub_ok = DC.g2_in_subgroup(sig_jac)
+    hm = _tile_rounds(DH.hash_to_g2_jac(u0, u1), k)
+    sx, sy, s_inf = DC.G2_DEV.to_affine(sig_jac)
+    hx, hy, _ = DC.G2_DEV.to_affine(hm)
+    rk = pk_slot[0].shape[0]
+    px = jnp.stack([jnp.broadcast_to(neg_g1_aff[0], (rk, L.NLIMB)), pk_slot[0]])
+    py = jnp.stack([jnp.broadcast_to(neg_g1_aff[1], (rk, L.NLIMB)), pk_slot[1]])
+    qx = jax.tree.map(lambda a, b: jnp.stack([a, b]), sx, hx)
+    qy = jax.tree.map(lambda a, b: jnp.stack([a, b]), sy, hy)
+    ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
+    return sub_ok & ~s_inf & ok
+
+
+def _exact_partials_run_g1sig(sig_jac, u0, u1, k, pk_slot, neg_g2_aff):
+    sub_ok = DC.g1_in_subgroup(sig_jac)
+    hm = _tile_rounds(DH.hash_to_g1_jac(u0, u1), k)
+    sx, sy, s_inf = DC.G1_DEV.to_affine(sig_jac)
+    hx, hy, _ = DC.G1_DEV.to_affine(hm)
+    rk = sx.shape[0]
+    px = jnp.stack([sx, hx])
+    py = jnp.stack([sy, hy])
+    bc = lambda c: jnp.broadcast_to(c, (rk, L.NLIMB))
+    qx = jax.tree.map(lambda a, b: jnp.stack([bc(a), b]), neg_g2_aff[0], pk_slot[0])
+    qy = jax.tree.map(lambda a, b: jnp.stack([bc(a), b]), neg_g2_aff[1], pk_slot[1])
+    ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
+    return sub_ok & ~s_inf & ok
+
+
+@lru_cache(maxsize=None)
+def _rlc_pipeline(g2sig: bool):
+    return jax.jit(_rlc_partials_run_g2sig if g2sig else _rlc_partials_run_g1sig)
+
+
+@lru_cache(maxsize=None)
+def _exact_pipeline(g2sig: bool):
+    return jax.jit(_exact_partials_run_g2sig if g2sig else _exact_partials_run_g1sig,
+                   static_argnums=(3,))
+
+
+class BatchPartialVerifier:
+    """Verifies (round, slot) blocks of threshold partials for one group."""
+
+    def __init__(self, scheme: Scheme, pub_poly: HT.PubPoly, n_nodes: int):
+        self.scheme = scheme
+        self.g2sig = scheme.sig_group is GroupG2
+        self.n_nodes = n_nodes
+        # host: evaluate every node's public share once per group
+        self.pub_points = [pub_poly.eval(i) for i in range(n_nodes)]
+        if self.g2sig:
+            # pks on G1
+            self.pk_x = np.stack([np.asarray(L.encode_mont(p[0])) for p in self.pub_points])
+            self.pk_y = np.stack([np.asarray(L.encode_mont(p[1])) for p in self.pub_points])
+            self.fixed_aff = (L.encode_mont(_NEG_G1[0]), L.encode_mont(_NEG_G1[1]))
+        else:
+            # pks on G2: nested ((x0,x1),(y0,y1)) limb stacks
+            enc = lambda sel: np.stack([np.asarray(L.encode_mont(sel(p))) for p in self.pub_points])
+            self.pk_x = (enc(lambda p: p[0][0]), enc(lambda p: p[0][1]))
+            self.pk_y = (enc(lambda p: p[1][0]), enc(lambda p: p[1][1]))
+            self.fixed_aff = ((L.encode_mont(_NEG_G2[0][0]), L.encode_mont(_NEG_G2[0][1])),
+                              (L.encode_mont(_NEG_G2[1][0]), L.encode_mont(_NEG_G2[1][1])))
+
+    # -- host-side packing ---------------------------------------------------
+
+    def _parse(self, rows, k):
+        """-> (slot points, slot indices (r,k), valid mask (r,k))."""
+        gen = G2_GEN if self.g2sig else G1_GEN
+        from_bytes = (self.scheme.sig_group.from_bytes)
+        pts, idxs, valid = [], [], []
+        for row in rows:
+            for j in range(k):
+                if j >= len(row) or row[j] is None:
+                    pts.append(gen); idxs.append(0); valid.append(False)
+                    continue
+                p = bytes(row[j])
+                idx = HT.index_of(p)
+                try:
+                    if not (0 <= idx < self.n_nodes):
+                        raise ValueError("bad signer index")
+                    pt = from_bytes(p[2:], check_subgroup=False)
+                    if pt is None:
+                        raise ValueError("infinity partial")
+                except (ValueError, AssertionError):
+                    pts.append(gen); idxs.append(0); valid.append(False)
+                    continue
+                pts.append(pt); idxs.append(idx); valid.append(True)
+        shape = (len(rows), k)
+        return pts, np.array(idxs).reshape(shape), np.array(valid).reshape(shape)
+
+    def _encode_slots(self, pts, msgs):
+        if self.g2sig:
+            sig_jac = DC.encode_g2_points(pts)
+            u0, u1 = DH.hash_msgs_to_field_g2(msgs, self.scheme.dst)
+        else:
+            sig_jac = DC.encode_g1_points(pts)
+            u0, u1 = DH.hash_msgs_to_field_g1(msgs, self.scheme.dst)
+        return sig_jac, u0, u1
+
+    def _pk_sel(self, signer_list):
+        ix = np.asarray(signer_list)
+        if self.g2sig:
+            return (jnp.asarray(self.pk_x[ix]), jnp.asarray(self.pk_y[ix]))
+        sel = lambda pair: (jnp.asarray(pair[0][ix]), jnp.asarray(pair[1][ix]))
+        return (sel(self.pk_x), sel(self.pk_y))
+
+    # -- verification --------------------------------------------------------
+
+    def verify_partials(self, msgs, partial_rows) -> np.ndarray:
+        """msgs: one digest per round; partial_rows: ragged per-round lists of
+        wire partials (be16(index) || sig).  Returns an (r, kmax) validity
+        mask (padded slots are False)."""
+        r = len(msgs)
+        if r == 0:
+            return np.zeros((0, 0), dtype=bool)
+        k = max((len(row) for row in partial_rows), default=0)
+        if k == 0:
+            return np.zeros((r, 0), dtype=bool)
+        pts, idxs, valid = self._parse(partial_rows, k)
+        sig_jac, u0, u1 = self._encode_slots(pts, msgs)
+        rk = r * k
+
+        if valid.any():
+            flat_valid = valid.reshape(-1)
+            flat_idx = idxs.reshape(-1)
+            cs = [secrets.randbits(SECURITY_BITS) if v else 0 for v in flat_valid]
+            signers = sorted(set(flat_idx[flat_valid]))
+            onehot = np.zeros((len(signers), rk), dtype=np.uint32)
+            for i, s in enumerate(signers):
+                onehot[i] = (flat_idx == s) & flat_valid
+            bits = DC.scalars_to_bits(cs + cs, nbits=SECURITY_BITS)
+            sub_ok, ok = _rlc_pipeline(self.g2sig)(
+                sig_jac, u0, u1, bits, jnp.asarray(onehot),
+                self._pk_sel(signers), self.fixed_aff)
+            if bool(ok) and np.asarray(sub_ok)[flat_valid].all():
+                return valid
+
+        # exact fallback: per-slot pairings with per-slot public shares
+        pk_slot = self._pk_sel(idxs.reshape(-1))
+        got = np.asarray(_exact_pipeline(self.g2sig)(
+            sig_jac, u0, u1, k, pk_slot, self.fixed_aff))
+        return got.reshape(r, k) & valid

@@ -1,0 +1,59 @@
+"""Crypto vault: thread-safe holder of the node's DKG share + group info.
+
+Reference: crypto/vault/vault.go:21-85.  The beacon Handler signs partials
+through the vault; at reshare transition the share and group are swapped
+atomically (vault.go:74-85, chain/beacon/node.go:257-281).
+"""
+
+import threading
+from typing import Optional
+
+from .schemes import Scheme
+from . import tbls
+
+
+class Vault:
+    def __init__(self, scheme: Scheme, group, share):
+        """`group`: key.Group; `share`: key.Share (or None until DKG ends)."""
+        self._lock = threading.RLock()
+        self.scheme = scheme
+        self._group = group
+        self._share = share
+
+    # -- signing (vault.go:60-68) -------------------------------------------
+
+    def sign_partial(self, msg: bytes) -> bytes:
+        with self._lock:
+            if self._share is None:
+                raise RuntimeError("vault has no share (DKG not run)")
+            return tbls.sign_partial(self.scheme, self._share.private, msg)
+
+    # -- reads ---------------------------------------------------------------
+
+    def get_group(self):
+        with self._lock:
+            return self._group
+
+    def get_share(self):
+        with self._lock:
+            return self._share
+
+    def get_pub(self) -> Optional[tbls.PubPoly]:
+        """The public polynomial for partial verification (vault.go:48-52)."""
+        with self._lock:
+            return None if self._share is None else self._share.pub_poly()
+
+    def public_key_bytes(self) -> Optional[bytes]:
+        with self._lock:
+            if self._share is not None:
+                return self._share.commits[0]
+            if self._group is not None and self._group.public_key is not None:
+                return self._group.public_key.key()
+            return None
+
+    # -- reshare transition (vault.go:74-85) --------------------------------
+
+    def set_info(self, group, share) -> None:
+        with self._lock:
+            self._group = group
+            self._share = share

@@ -1,0 +1,26 @@
+"""Secure filesystem helpers (reference fs/fs.go): private dirs 0700,
+secret files 0600."""
+
+import os
+
+
+def create_secure_folder(path: str) -> str:
+    os.makedirs(path, mode=0o700, exist_ok=True)
+    return path
+
+
+def write_secure_file(path: str, data: bytes) -> None:
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        os.write(fd, data)
+    finally:
+        os.close(fd)
+
+
+def check_secure_file(path: str) -> bool:
+    """True iff the file exists with owner-only permissions."""
+    try:
+        mode = os.stat(path).st_mode & 0o777
+    except FileNotFoundError:
+        return False
+    return mode & 0o077 == 0

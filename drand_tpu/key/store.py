@@ -1,0 +1,123 @@
+"""File-based key/group/share persistence.
+
+Reference: key/store.go:34-177.  Disk layout per beacon:
+
+    <base>/multibeacon/<beaconID>/key/drand_id.private     (0600)
+    <base>/multibeacon/<beaconID>/key/drand_id.public
+    <base>/multibeacon/<beaconID>/groups/drand_group.toml
+    <base>/multibeacon/<beaconID>/share/dist_key.private   (0600)
+
+Private key material is written with owner-only permissions via fs helpers.
+"""
+
+import os
+import tomllib
+from typing import Optional
+
+from .. import fs
+from ..common import DEFAULT_BEACON_ID, MULTI_BEACON_FOLDER
+from ..crypto.schemes import get_scheme_by_id_with_default
+from ..crypto.tbls import PriShare
+from .group import Group
+from .keys import Identity, Pair, Share
+
+
+class FileStore:
+    KEY_FOLDER = "key"
+    GROUP_FOLDER = "groups"
+    SHARE_FOLDER = "share"
+    KEY_FILE = "drand_id"
+    GROUP_FILE = "drand_group.toml"
+    SHARE_FILE = "dist_key.private"
+
+    def __init__(self, base_folder: str, beacon_id: str = ""):
+        self.beacon_id = beacon_id or DEFAULT_BEACON_ID
+        self.base = os.path.join(base_folder, MULTI_BEACON_FOLDER, self.beacon_id)
+        self.key_dir = fs.create_secure_folder(os.path.join(self.base, self.KEY_FOLDER))
+        self.group_dir = fs.create_secure_folder(os.path.join(self.base, self.GROUP_FOLDER))
+        self.share_dir = fs.create_secure_folder(os.path.join(self.base, self.SHARE_FOLDER))
+        self.private_key_file = os.path.join(self.key_dir, self.KEY_FILE + ".private")
+        self.public_key_file = os.path.join(self.key_dir, self.KEY_FILE + ".public")
+        self.group_file = os.path.join(self.group_dir, self.GROUP_FILE)
+        self.share_file = os.path.join(self.share_dir, self.SHARE_FILE)
+
+    # -- keypair ------------------------------------------------------------
+
+    def save_keypair(self, pair: Pair) -> None:
+        ident = pair.public
+        priv = (f'Key = "{pair.key:064x}"\n'
+                f'SchemeName = "{ident.scheme.id}"\n')
+        fs.write_secure_file(self.private_key_file, priv.encode())
+        with open(self.public_key_file, "w") as f:
+            f.write(self._identity_toml(ident))
+
+    @staticmethod
+    def _identity_toml(ident: Identity) -> str:
+        return (f'Address = "{ident.addr}"\n'
+                f'Key = "{ident.key.hex()}"\n'
+                f"TLS = {str(ident.tls).lower()}\n"
+                f'Signature = "{(ident.signature or b"").hex()}"\n'
+                f'SchemeName = "{ident.scheme.id}"\n')
+
+    def load_keypair(self) -> Pair:
+        with open(self.private_key_file, "rb") as f:
+            priv = tomllib.load(f)
+        ident = self.load_public_identity()
+        return Pair(key=int(priv["Key"], 16), public=ident)
+
+    def load_public_identity(self) -> Identity:
+        with open(self.public_key_file, "rb") as f:
+            doc = tomllib.load(f)
+        scheme = get_scheme_by_id_with_default(doc.get("SchemeName", ""))
+        return Identity(
+            key=bytes.fromhex(doc["Key"]), addr=doc["Address"], scheme=scheme,
+            tls=bool(doc.get("TLS", False)),
+            signature=bytes.fromhex(doc["Signature"]) if doc.get("Signature") else None)
+
+    # -- group --------------------------------------------------------------
+
+    def save_group(self, group: Group) -> None:
+        with open(self.group_file, "w") as f:
+            f.write(group.to_toml())
+
+    def load_group(self) -> Optional[Group]:
+        if not os.path.exists(self.group_file):
+            return None
+        with open(self.group_file) as f:
+            return Group.from_toml(f.read())
+
+    # -- DKG share ----------------------------------------------------------
+
+    def save_share(self, share: Share) -> None:
+        lines = [f"Index = {share.private.index}",
+                 f'Share = "{share.private.value:064x}"',
+                 f'SchemeName = "{share.scheme.id}"',
+                 "Commits = ["]
+        lines += [f'  "{c.hex()}",' for c in share.commits]
+        lines += ["]"]
+        fs.write_secure_file(self.share_file, ("\n".join(lines) + "\n").encode())
+
+    def load_share(self) -> Optional[Share]:
+        if not os.path.exists(self.share_file):
+            return None
+        with open(self.share_file, "rb") as f:
+            doc = tomllib.load(f)
+        scheme = get_scheme_by_id_with_default(doc.get("SchemeName", ""))
+        return Share(
+            scheme=scheme,
+            private=PriShare(index=int(doc["Index"]), value=int(doc["Share"], 16)),
+            commits=[bytes.fromhex(c) for c in doc["Commits"]])
+
+    def reset(self) -> None:
+        """Remove group + share state (CLI `util reset` / `util del-beacon`)."""
+        for p in (self.group_file, self.share_file):
+            if os.path.exists(p):
+                os.remove(p)
+
+
+def list_beacon_ids(base_folder: str):
+    root = os.path.join(base_folder, MULTI_BEACON_FOLDER)
+    if not os.path.isdir(root):
+        return []
+    return sorted(d for d in os.listdir(root)
+                  if os.path.isdir(os.path.join(root, d)))

@@ -1,8 +1,9 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Tests must be deterministic and runnable without TPU hardware; multi-chip
-sharding tests use the 8 virtual CPU devices.  The real-chip path is exercised
-by bench.py / __graft_entry__.py instead.
+Tests must be deterministic and runnable without TPU hardware.  The 8 virtual
+CPU devices back the sharding tests in test_multichip.py; the real-chip path
+is exercised by bench.py, and the full sharded aggregation step by
+__graft_entry__.dryrun_multichip (driver-run).
 """
 
 import os

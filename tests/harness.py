@@ -1,0 +1,123 @@
+"""In-process multi-node test harness (the core/util_test.go:43-78 pattern):
+n handlers share one FakeClock and exchange partials through a LocalNetwork
+that can drop nodes (DenyClient-style fault injection).  Shares are
+fabricated from a single polynomial (test/test.go BatchIdentities pattern) —
+DKG-produced shares are exercised by the dkg tests instead."""
+
+import threading
+
+from drand_tpu.beacon import FakeClock, Handler, HandlerConfig
+from drand_tpu.chain import MemDBStore
+from drand_tpu.crypto import tbls
+from drand_tpu.crypto.schemes import scheme_from_name
+from drand_tpu.key import DistPublic, Share, new_group, new_keypair
+
+
+class LocalNetwork:
+    """Synchronous in-process partial delivery with per-node kill switches."""
+
+    def __init__(self):
+        self.handlers = {}
+        self.down = set()
+        self._lock = threading.Lock()
+
+    def register(self, index, handler):
+        with self._lock:
+            self.handlers[index] = handler
+            self.down.discard(index)
+
+    def kill(self, index):
+        with self._lock:
+            self.down.add(index)
+
+    def revive(self, index):
+        with self._lock:
+            self.down.discard(index)
+
+    def broadcaster(self, sender_index):
+        def broadcast(packet):
+            with self._lock:
+                targets = [(i, h) for i, h in self.handlers.items()
+                           if i != sender_index and i not in self.down
+                           and sender_index not in self.down]
+            for _, h in targets:
+                try:
+                    h.process_partial_beacon(packet)
+                except ValueError:
+                    pass
+        return broadcast
+
+
+class BeaconScenario:
+    """n-node beacon network under a stepped clock."""
+
+    def __init__(self, n, thr, scheme_id="pedersen-bls-chained",
+                 period=30, catchup_period=5, genesis_offset=100,
+                 store_factory=None, secret=111222333):
+        self.scheme = scheme_from_name(scheme_id)
+        self.clock = FakeClock(start=1_000_000)
+        self.net = LocalNetwork()
+        self.period = period
+        self.genesis = int(self.clock.now()) + genesis_offset
+
+        pairs = [new_keypair(f"127.0.0.1:{9000 + i}", self.scheme,
+                             seed=b"scenario%d" % i) for i in range(n)]
+        self.group = new_group([p.public for p in pairs], thr,
+                               genesis=self.genesis, period=period,
+                               catchup_period=catchup_period,
+                               scheme=self.scheme)
+        self.poly = tbls.PriPoly.random(thr, secret=secret)
+        commits = [self.scheme.key_group.to_bytes(c)
+                   for c in self.poly.commit(self.scheme.key_group).commits]
+        self.group.public_key = DistPublic(commits)
+        self.commits = commits
+        self.public_key = commits[0]
+        self.store_factory = store_factory or (lambda i: MemDBStore(buffer_size=100))
+        self.handlers = {}
+        for node in self.group.nodes:
+            self._make_handler(node.index)
+
+    def _make_handler(self, index, store=None):
+        share = Share(scheme=self.scheme, private=self.poly.eval(index),
+                      commits=self.commits)
+        h = Handler(HandlerConfig(
+            group=self.group, share=share, index=index,
+            store=store if store is not None else self.store_factory(index),
+            clock=self.clock,
+            broadcast=self.net.broadcaster(index)))
+        self.net.register(index, h)
+        self.handlers[index] = h
+        return h
+
+    def start_all(self):
+        for h in self.handlers.values():
+            h.start()
+
+    def advance_to_genesis(self):
+        self.clock.set_time(self.genesis)
+
+    def advance_round(self):
+        self.clock.advance(self.period)
+
+    def wait_round(self, index, round_, timeout=60):
+        b = self.handlers[index].chain.wait_for_round(round_, timeout)
+        assert b is not None, \
+            f"node {index} never reached round {round_}"
+        return b
+
+    def kill(self, index):
+        self.net.kill(index)
+        h = self.handlers.pop(index)
+        store = h.cfg.store
+        h.stop()
+        return store
+
+    def restart(self, index, store):
+        h = self._make_handler(index, store=store)
+        self.net.revive(index)
+        h.catchup()
+        return h
+
+    def stop_all(self):
+        for h in list(self.handlers.values()):
+            h.stop()

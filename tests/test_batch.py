@@ -1,0 +1,143 @@
+"""Device batch pipelines: verify_batch / verify_chain / sign_batch /
+recover_batch (drand_tpu/crypto/batch.py) — the framework's flagship ops.
+
+Batch sizes stay at the minimum pad (8) so every test shares one compiled
+shape per pipeline kind.
+"""
+
+import numpy as np
+import pytest
+
+from drand_tpu.chain import Beacon
+from drand_tpu.crypto import batch, tbls
+from drand_tpu.crypto.schemes import list_schemes, scheme_from_name
+
+from test_host_crypto import MAINNET_BEACONS
+
+
+def _keyed_verifier(scheme_id, seed=b"batch-test"):
+    sch = scheme_from_name(scheme_id)
+    sec, pub = sch.keypair(seed=seed)
+    return sch, sec, batch.BatchBeaconVerifier(sch, sch.public_bytes(pub))
+
+
+def _signed_chain(sch, sec, n):
+    """Host-signed beacons (chained linkage when the scheme is chained)."""
+    prev = None
+    beacons = []
+    for r in range(1, n + 1):
+        sig = sch.sign(sec, sch.digest_beacon(r, prev if sch.chained else None))
+        beacons.append(Beacon(round=r, signature=sig,
+                              previous_sig=prev if sch.chained else None))
+        prev = sig
+    return beacons
+
+
+# ---------------------------------------------------------------------------
+# verify_batch
+# ---------------------------------------------------------------------------
+
+def test_verify_batch_mainnet_vectors_g2():
+    """Both chained mainnet beacons under their own pubkeys + a corrupted
+    copy: RLC fails, the exact fallback localizes the bad round."""
+    sch_id, round_, pub, sig, prev = MAINNET_BEACONS[0]
+    ver = batch.BatchBeaconVerifier(scheme_from_name(sch_id), bytes.fromhex(pub))
+    sig_b, prev_b = bytes.fromhex(sig), bytes.fromhex(prev)
+    bad_sig = bytearray(sig_b)
+    bad_sig[6] ^= 1
+
+    got = ver.verify_batch([round_, round_ + 1, round_],
+                           [sig_b, sig_b, bytes(bad_sig)],
+                           [prev_b, prev_b, prev_b])
+    assert got.tolist() == [True, False, False]
+
+
+def test_verify_batch_mainnet_vector_g1():
+    sch_id, round_, pub, sig, _ = MAINNET_BEACONS[3]
+    ver = batch.BatchBeaconVerifier(scheme_from_name(sch_id), bytes.fromhex(pub))
+    got = ver.verify_batch([round_, round_ + 1], [bytes.fromhex(sig)] * 2)
+    assert got.tolist() == [True, False]
+
+
+def test_verify_batch_all_valid_rlc_path():
+    sch, sec, ver = _keyed_verifier("bls-unchained-on-g1")
+    beacons = _signed_chain(sch, sec, 8)
+    got = ver.verify_batch([b.round for b in beacons],
+                           [b.signature for b in beacons])
+    assert got.all()
+
+
+def test_verify_batch_single_and_garbage():
+    sch, sec, ver = _keyed_verifier("bls-unchained-on-g1")
+    [b] = _signed_chain(sch, sec, 1)
+    assert ver.verify_batch([b.round], [b.signature]).tolist() == [True]
+    # malformed signature bytes never verify and never crash
+    assert ver.verify_batch([1, 1], [b"\x00" * 48, b.signature]).tolist() == [False, True]
+    assert ver.verify_batch([], []).tolist() == []
+
+
+def test_verify_batch_localizes_corruption():
+    sch, sec, ver = _keyed_verifier("bls-unchained-on-g1")
+    beacons = _signed_chain(sch, sec, 6)
+    sigs = [b.signature for b in beacons]
+    sigs[3] = sigs[2]  # valid point, wrong round
+    got = ver.verify_batch([b.round for b in beacons], sigs)
+    assert got.tolist() == [True, True, True, False, True, True]
+
+
+# ---------------------------------------------------------------------------
+# verify_chain
+# ---------------------------------------------------------------------------
+
+def test_verify_chain_linkage():
+    sch, sec, ver = _keyed_verifier("pedersen-bls-chained")
+    beacons = _signed_chain(sch, sec, 5)
+    ok, valid = ver.verify_chain(beacons)
+    assert ok and valid.all()
+
+    # break the linkage of round 4 (its own signature still verifies
+    # against its stored previous_sig, but the link test must flag it)
+    broken = list(beacons)
+    broken[3] = Beacon(round=4, signature=beacons[3].signature,
+                       previous_sig=beacons[1].signature)
+    ok, valid = ver.verify_chain(broken)
+    assert not ok
+    assert not valid[3]
+
+
+# ---------------------------------------------------------------------------
+# sign_batch / recover_batch vs host golden
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("scheme_id", list_schemes())
+def test_sign_batch_matches_host(scheme_id):
+    sch = scheme_from_name(scheme_id)
+    sec, _ = sch.keypair(seed=b"sign-batch")
+    msgs = [sch.digest_beacon(r, None) for r in range(1, 5)]
+    got = batch.sign_batch(sch, sec, msgs)
+    assert got == [sch.sign(sec, m) for m in msgs]
+
+
+@pytest.mark.parametrize("scheme_id", list_schemes())
+def test_recover_batch_matches_host(scheme_id):
+    sch = scheme_from_name(scheme_id)
+    t, n = 3, 5
+    poly = tbls.PriPoly.random(t, secret=424242)
+    shares = poly.shares(n)
+    pub_poly = poly.commit(sch.key_group)
+
+    rounds = [11, 12]
+    idx_sets = [[0, 2, 4], [1, 2, 3]]
+    indices, partials, expected = [], [], []
+    for r, idxs in zip(rounds, idx_sets):
+        msg = sch.digest_beacon(r, None)
+        indices.append(idxs)
+        partials.append([sch.sign(shares[i].value, msg) for i in idxs])
+        host = tbls.recover(
+            sch, pub_poly, msg,
+            [tbls.sign_partial(sch, shares[i], msg) for i in idxs], t, n)
+        expected.append(host)
+        assert host == sch.sign(poly.secret(), msg)
+
+    got = batch.recover_batch(sch, indices, partials)
+    assert got == expected

@@ -1,0 +1,237 @@
+"""Beacon engine: ticker, cache, store decorators, and the n-node
+fake-clock scenario (chain/beacon/ + the core/util_test.go pattern)."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from drand_tpu.beacon import FakeClock, PartialCache, Ticker
+from drand_tpu.beacon.stores import (AppendStore, CallbackStore,
+                                     DiscrepancyStore, ErrBeaconAlreadyStored,
+                                     SchemeStore)
+from drand_tpu.chain import Beacon, MemDBStore, genesis_beacon
+from drand_tpu.crypto.schemes import scheme_from_name
+
+from harness import BeaconScenario
+
+
+# ---------------------------------------------------------------------------
+# Ticker
+# ---------------------------------------------------------------------------
+
+def _drain(q, timeout=5.0):
+    out = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            out.append(q.get(timeout=0.05))
+            deadline = time.monotonic() + 0.3
+        except queue.Empty:
+            if out:
+                break
+    return out
+
+
+def test_ticker_fires_rounds():
+    clock = FakeClock(start=1000)
+    t = Ticker(clock, period=30, genesis_time=1100)
+    ch = t.channel()
+    t.start()
+    try:
+        clock.set_time(1100)
+        ticks = _drain(ch)
+        assert [x.round for x in ticks] == [1]
+        clock.advance(30)
+        ticks = _drain(ch)
+        assert [x.round for x in ticks] == [2]
+        # jumping several periods fires only the then-current round
+        clock.advance(90)
+        ticks = _drain(ch)
+        assert [x.round for x in ticks] == [5]
+        assert t.current_round() == 5
+    finally:
+        t.stop()
+
+
+def test_ticker_start_at_filter():
+    clock = FakeClock(start=1000)
+    t = Ticker(clock, period=10, genesis_time=1000)
+    late = t.channel(start_at=1020)  # only rounds >= 3
+    t.start()
+    try:
+        clock.advance(1)   # fire round 1 (time 1000)
+        clock.advance(10)  # round 2
+        clock.advance(10)  # round 3
+        ticks = _drain(late)
+        assert [x.round for x in ticks] == [3]
+    finally:
+        t.stop()
+
+
+# ---------------------------------------------------------------------------
+# Partial cache
+# ---------------------------------------------------------------------------
+
+def _partial(idx, body=b"sig"):
+    return idx.to_bytes(2, "big") + body
+
+
+def test_cache_dedupe_and_prev_sig_isolation():
+    c = PartialCache()
+    rc = c.append(5, b"prev", _partial(1))
+    assert len(rc) == 1
+    c.append(5, b"prev", _partial(1))          # dup ignored
+    assert len(c.get(5, b"prev")) == 1
+    c.append(5, b"other", _partial(2))         # different prev-sig bucket
+    assert len(c.get(5, b"prev")) == 1
+    assert len(c.get(5, b"other")) == 1
+    assert len(c.get_round_partials(5)) == 2
+
+
+def test_cache_flush():
+    c = PartialCache()
+    for r in range(1, 6):
+        c.append(r, None, _partial(1))
+    c.flush_rounds(3)
+    assert c.get(3, None) is None
+    assert c.get(4, None) is not None
+
+
+def test_cache_per_node_eviction():
+    c = PartialCache(max_per_node=3)
+    for r in range(1, 5):
+        c.append(r, None, _partial(7))
+    # signer 7 may occupy only 3 rounds: round 1 evicted
+    assert c.get(1, None) is None
+    assert len(c.get(4, None)) == 1
+    # other signers unaffected
+    c.append(1, None, _partial(9))
+    assert len(c.get(1, None)) == 1
+
+
+# ---------------------------------------------------------------------------
+# Store decorators
+# ---------------------------------------------------------------------------
+
+def _b(r, sig=b"", prev=None):
+    return Beacon(round=r, signature=sig or b"s%d" % r, previous_sig=prev)
+
+
+def test_append_store_monotonic():
+    s = AppendStore(MemDBStore(buffer_size=100))
+    s.put(_b(0))
+    s.put(_b(1))
+    with pytest.raises(ErrBeaconAlreadyStored):
+        s.put(_b(1))
+    with pytest.raises(ValueError):
+        s.put(_b(5))
+    s.put(_b(2))
+    assert s.last().round == 2
+
+
+def test_scheme_store_chained_linkage():
+    s = SchemeStore(MemDBStore(buffer_size=100), chained=True)
+    s.put(_b(0, sig=b"g"))
+    s.put(_b(1, sig=b"s1", prev=b"g"))
+    with pytest.raises(ValueError):
+        s.put(_b(2, sig=b"s2", prev=b"WRONG"))
+    s.put(_b(2, sig=b"s2", prev=b"s1"))
+
+
+def test_scheme_store_unchained_strips_prev():
+    s = SchemeStore(MemDBStore(buffer_size=100), chained=False)
+    s.put(_b(1, prev=b"whatever"))
+    assert s.get(1).previous_sig is None
+
+
+def test_discrepancy_store_records_latency():
+    clock = FakeClock(start=1060)
+    s = DiscrepancyStore(MemDBStore(buffer_size=100), clock,
+                         period=30, genesis=1000)
+    seen = []
+    s.on_discrepancy = lambda r, ms: seen.append((r, ms))
+    s.put(_b(3))  # expected at 1060 -> 0ms late
+    assert seen == [(3, 0.0)]
+    clock.advance(2)
+    s.put(_b(4))  # expected at 1090, stored at 1062 -> -28s early
+    assert seen[-1][0] == 4 and seen[-1][1] == pytest.approx(-28000.0)
+
+
+def test_callback_store_fanout_and_replace():
+    s = CallbackStore(MemDBStore(buffer_size=100))
+    got_a, got_b = [], []
+    done = threading.Event()
+    s.add_callback("a", got_a.append)
+    s.add_callback("b", lambda b: (got_b.append(b), done.set()))
+    s.put(_b(1))
+    assert done.wait(2)
+    time.sleep(0.05)
+    assert [b.round for b in got_a] == [1]
+    assert [b.round for b in got_b] == [1]
+    # same-id registration replaces the old subscriber
+    replaced = []
+    s.add_callback("a", replaced.append)
+    s.put(_b(2))
+    time.sleep(0.2)
+    assert [b.round for b in got_a] == [1]
+    assert [b.round for b in replaced] == [2]
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# Scenario: 1-of-1 real-crypto chain (the test/mock/grpcserver.go pattern)
+# ---------------------------------------------------------------------------
+
+def test_single_node_chain():
+    sc = BeaconScenario(n=1, thr=1, period=30)
+    try:
+        sc.start_all()
+        sc.advance_to_genesis()
+        b1 = sc.wait_round(0, 1)
+        sc.advance_round()
+        b2 = sc.wait_round(0, 2)
+        # 1-of-1 recovery equals the plain signature of the collective key
+        sch = sc.scheme
+        assert b1.signature == sch.sign(
+            sc.poly.secret(), sch.digest_beacon(1, sc.group.get_genesis_seed()))
+        assert b2.previous_sig == b1.signature
+        assert sch.verify_beacon(sc.public_key, 2, b2.previous_sig, b2.signature)
+    finally:
+        sc.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# Scenario: n=4 network with a node failure
+# ---------------------------------------------------------------------------
+
+def test_four_node_network_produces_verified_chain():
+    sc = BeaconScenario(n=4, thr=3, period=30)
+    try:
+        sc.start_all()
+        sc.advance_to_genesis()
+        for i in range(4):
+            sc.wait_round(i, 1)
+        sc.advance_round()
+        for i in range(4):
+            sc.wait_round(i, 2)
+
+        # all nodes agree and the chain verifies against the collective key
+        sch = sc.scheme
+        heads = [sc.handlers[i].chain.store.get(2) for i in range(4)]
+        assert len({h.signature for h in heads}) == 1
+        b1 = sc.handlers[0].chain.store.get(1)
+        assert sch.verify_beacon(sc.public_key, 1,
+                                 sc.group.get_genesis_seed(), b1.signature)
+        assert heads[0].previous_sig == b1.signature
+        assert sch.verify_beacon(sc.public_key, 2, heads[0].previous_sig,
+                                 heads[0].signature)
+
+        # threshold resilience: kill one node, chain continues (3 == thr)
+        sc.kill(3)
+        sc.advance_round()
+        for i in range(3):
+            sc.wait_round(i, 3)
+    finally:
+        sc.stop_all()

@@ -1,0 +1,224 @@
+"""Chain core: beacon codec, info hash, round math, storage matrix."""
+
+import hashlib
+import io
+
+import pytest
+
+from drand_tpu.chain import (Beacon, ErrNoBeaconSaved, ErrNoBeaconStored,
+                             Info, MemDBStore, SqliteStore,
+                             TIME_OF_ROUND_ERROR, bytes_to_round,
+                             current_round, genesis_beacon, next_round,
+                             round_to_bytes, time_of_round)
+
+
+# ---------------------------------------------------------------------------
+# Beacon
+# ---------------------------------------------------------------------------
+
+def test_beacon_json_roundtrip():
+    b = Beacon(round=42, signature=b"\x01\x02", previous_sig=b"\x03\x04")
+    assert Beacon.from_json(b.to_json()) == b
+    b2 = Beacon(round=7, signature=b"\xaa" * 96)
+    assert Beacon.from_json(b2.to_json()) == b2
+    assert b2.previous_sig is None
+
+
+def test_beacon_randomness():
+    sig = b"\x05" * 96
+    assert Beacon(round=1, signature=sig).randomness() == hashlib.sha256(sig).digest()
+
+
+def test_genesis_beacon():
+    g = genesis_beacon(b"seed-bytes")
+    assert g.round == 0 and g.signature == b"seed-bytes" and g.previous_sig is None
+
+
+# ---------------------------------------------------------------------------
+# Round/time math (chain/time.go semantics)
+# ---------------------------------------------------------------------------
+
+def test_time_of_round():
+    assert time_of_round(30, 1000, 0) == 1000     # round 0 = genesis
+    assert time_of_round(30, 1000, 1) == 1000     # round 1 at genesis
+    assert time_of_round(30, 1000, 2) == 1030
+    assert time_of_round(-1, 1000, 5) == TIME_OF_ROUND_ERROR
+    assert time_of_round(30, 1000, 1 << 60) == TIME_OF_ROUND_ERROR
+
+
+def test_next_and_current_round():
+    period, genesis = 30, 1000
+    # before genesis: next round is 1 at genesis
+    assert next_round(500, period, genesis) == (1, genesis)
+    assert current_round(500, period, genesis) == 1
+    # at genesis: round 1 is current, round 2 next
+    assert next_round(1000, period, genesis) == (2, 1030)
+    assert current_round(1000, period, genesis) == 1
+    # mid-period
+    assert next_round(1029, period, genesis) == (2, 1030)
+    assert current_round(1030, period, genesis) == 2
+    assert current_round(1059, period, genesis) == 2
+    # round <-> time consistency
+    for r in (1, 2, 3, 10, 1000):
+        t = time_of_round(period, genesis, r)
+        assert current_round(t, period, genesis) == r
+
+
+def test_round_bytes():
+    for r in (0, 1, 0xDEADBEEF, (1 << 64) - 1):
+        assert bytes_to_round(round_to_bytes(r)) == r
+    assert round_to_bytes(1) == b"\x00" * 7 + b"\x01"
+
+
+# ---------------------------------------------------------------------------
+# Chain info
+# ---------------------------------------------------------------------------
+
+_LOE_PK = bytes.fromhex(
+    "868f005eb8e6e4ca0a47c8a77ceaa5309a47978a7c71bc5cce96366b5d7a5699"
+    "37c529eeda66c7293784a9402801af31")
+_LOE_SEED = bytes.fromhex(
+    "176f93498eac9ca337150b46d21dd58673ea4e3581185f869672e59fa4cb390a")
+
+
+def _loe_info(beacon_id="default"):
+    return Info(public_key=_LOE_PK, period=30, genesis_time=1595431050,
+                genesis_seed=_LOE_SEED, scheme="pedersen-bls-chained",
+                beacon_id=beacon_id)
+
+
+def test_info_hash_regression():
+    # Algorithm pin: sha256(be32(period) || be64(genesis) || pk || seed),
+    # beacon id omitted when default (chain/info.go:46-66).  Inputs are the
+    # public LoE mainnet parameters; the digest locks our implementation.
+    info = _loe_info()
+    assert info.hash_string() == (
+        "8990e7a9aaed2ffed73dbd7092123d6f289930540d7651336225dc172e51b2ce")
+    # default and empty beacon ids hash identically
+    assert _loe_info(beacon_id="").hash() == info.hash()
+    # a non-default id changes the chain hash
+    assert _loe_info(beacon_id="other").hash() != info.hash()
+
+
+def test_info_json_roundtrip():
+    info = _loe_info()
+    assert Info.from_json(info.to_json()).equal(info)
+    # hash check on decode
+    tampered = info.to_json().replace(b'"period":30', b'"period":25')
+    with pytest.raises(ValueError):
+        Info.from_json(tampered)
+
+
+def test_info_equal():
+    assert _loe_info().equal(_loe_info(beacon_id=""))
+    assert not _loe_info().equal(_loe_info(beacon_id="x"))
+
+
+# ---------------------------------------------------------------------------
+# Storage matrix (chain/boltdb + memdb suites)
+# ---------------------------------------------------------------------------
+
+def _mk_chain(n, start=0):
+    prev = None
+    out = []
+    for r in range(start, start + n):
+        sig = hashlib.sha256(b"sig%d" % r).digest()
+        out.append(Beacon(round=r, signature=sig, previous_sig=prev))
+        prev = sig
+    return out
+
+
+@pytest.fixture(params=["memdb", "sqlite", "sqlite-prev"])
+def store(request, tmp_path):
+    if request.param == "memdb":
+        s = MemDBStore(buffer_size=100)
+    else:
+        s = SqliteStore(str(tmp_path / "chain.db"),
+                        require_previous=request.param.endswith("prev"))
+    yield s
+    s.close()
+
+
+def test_store_basic(store):
+    assert len(store) == 0
+    with pytest.raises(ErrNoBeaconStored):
+        store.last()
+    with pytest.raises(ErrNoBeaconSaved):
+        store.get(1)
+
+    chain = _mk_chain(10)
+    for b in chain:
+        store.put(b)
+    assert len(store) == 10
+    assert store.last().round == 9
+    assert store.get(4).round == 4
+    assert store.get(4).signature == chain[4].signature
+
+    # duplicate put is harmless
+    store.put(chain[4])
+    assert len(store) == 10
+
+    store.delete(4)
+    assert len(store) == 9
+    with pytest.raises(ErrNoBeaconSaved):
+        store.get(4)
+
+
+def test_store_cursor(store):
+    chain = _mk_chain(8)
+    for b in reversed(chain):  # out-of-order inserts must still sort
+        store.put(b)
+    cur = store.cursor()
+    assert cur.first().round == 0
+    assert cur.next().round == 1
+    assert cur.seek(5).round == 5
+    assert cur.next().round == 6
+    assert cur.last().round == 7
+    assert cur.next() is None
+    assert [b.round for b in store.cursor()] == list(range(8))
+    # seek past the end
+    assert store.cursor().seek(100) is None
+
+
+def test_sqlite_previous_reconstruction(tmp_path):
+    s = SqliteStore(str(tmp_path / "c.db"), require_previous=True)
+    chain = _mk_chain(5)
+    for b in chain:
+        s.put(b)
+    got = s.get(3)
+    assert got.previous_sig == chain[2].signature  # rebuilt from round-2
+    assert s.get(0).previous_sig is None
+    # hole: previous unavailable -> None, not an error
+    s.delete(2)
+    assert s.get(3).previous_sig is None
+    s.close()
+
+
+def test_memdb_trim():
+    s = MemDBStore(buffer_size=10)
+    for b in _mk_chain(25):
+        s.put(b)
+    assert len(s) == 10
+    assert s.cursor().first().round == 15
+    assert s.last().round == 24
+    with pytest.raises(ValueError):
+        MemDBStore(buffer_size=5)
+
+
+def test_store_save_to(store):
+    for b in _mk_chain(3):
+        store.put(b)
+    buf = io.BytesIO()
+    store.save_to(buf)
+    assert len(buf.getvalue()) > 0
+
+
+def test_sqlite_persistence(tmp_path):
+    path = str(tmp_path / "p.db")
+    s = SqliteStore(path)
+    for b in _mk_chain(4):
+        s.put(b)
+    s.close()
+    s2 = SqliteStore(path)
+    assert len(s2) == 4 and s2.last().round == 3
+    s2.close()

@@ -1,0 +1,218 @@
+"""Identity/group/file-store/vault layer (reference key/ + crypto/vault)."""
+
+import os
+
+import pytest
+
+from drand_tpu.crypto import schnorr, tbls
+from drand_tpu.crypto.schemes import list_schemes, scheme_from_name
+from drand_tpu.crypto.vault import Vault
+from drand_tpu.key import (DistPublic, FileStore, Group, Share, minimum_t,
+                           new_group, new_keypair)
+from drand_tpu.key.keys import dkg_auth_sign, dkg_auth_verify
+from drand_tpu.key.store import list_beacon_ids
+
+SCH = scheme_from_name("pedersen-bls-chained")
+
+
+def _pairs(n, scheme=SCH):
+    return [new_keypair(f"127.0.0.1:{8000+i}", scheme, seed=b"key%d" % i)
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Identity / keypair
+# ---------------------------------------------------------------------------
+
+def test_self_signed_identity():
+    pair = _pairs(1)[0]
+    assert pair.public.valid_signature()
+    # PoP binds the key: another node's signature is invalid here
+    other = new_keypair("127.0.0.1:9000", SCH, seed=b"other")
+    pair.public.signature = other.public.signature
+    assert not pair.public.valid_signature()
+
+
+def test_identity_hash_ignores_address():
+    a = new_keypair("host-a:1", SCH, seed=b"same").public
+    b = new_keypair("host-b:2", SCH, seed=b"same").public
+    assert a.hash() == b.hash()
+    assert not a.equal(b)
+
+
+@pytest.mark.parametrize("scheme_id", list_schemes())
+def test_keypair_all_schemes(scheme_id):
+    sch = scheme_from_name(scheme_id)
+    pair = new_keypair("127.0.0.1:1234", sch, seed=b"x")
+    assert len(pair.public.key) == sch.key_group.point_len
+    assert pair.public.valid_signature()
+
+
+def test_minimum_t():
+    assert [minimum_t(n) for n in (2, 3, 4, 5, 13)] == [2, 2, 3, 3, 7]
+
+
+# ---------------------------------------------------------------------------
+# Schnorr DKG auth
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("scheme_id", list_schemes())
+def test_schnorr_roundtrip(scheme_id):
+    sch = scheme_from_name(scheme_id)
+    sec, pub = sch.keypair(seed=b"schnorr")
+    pub_b = sch.public_bytes(pub)
+    sig = dkg_auth_sign(sch, sec, b"dkg packet")
+    assert dkg_auth_verify(sch, pub_b, b"dkg packet", sig)
+    assert not dkg_auth_verify(sch, pub_b, b"other packet", sig)
+    bad = bytearray(sig)
+    bad[-1] ^= 1
+    assert not dkg_auth_verify(sch, pub_b, b"dkg packet", bytes(bad))
+    # wrong key
+    _, pub2 = sch.keypair(seed=b"schnorr2")
+    assert not dkg_auth_verify(sch, sch.public_bytes(pub2), b"dkg packet", sig)
+
+
+# ---------------------------------------------------------------------------
+# Group
+# ---------------------------------------------------------------------------
+
+def _group(n=4, t=None, **kw):
+    pairs = _pairs(n)
+    g = new_group([p.public for p in pairs], t or minimum_t(n),
+                  genesis=1700000000, period=30, catchup_period=5,
+                  scheme=SCH, **kw)
+    return g, pairs
+
+
+def test_group_basics():
+    g, pairs = _group(4)
+    assert len(g) == 4
+    assert sorted(n.index for n in g.nodes) == [0, 1, 2, 3]
+    found = g.find(pairs[0].public)
+    assert found is not None and found.identity.equal(pairs[0].public)
+    assert g.node(found.index).equal(found)
+    assert g.node(99) is None
+
+
+def test_group_hash_sensitivity():
+    g1, _ = _group(4)
+    g2, _ = _group(4)
+    assert g1.hash() == g2.hash()  # deterministic
+    g2.threshold = 4
+    assert g1.hash() != g2.hash()
+    g3, _ = _group(4)
+    g3.transition_time = 12345
+    assert g3.hash() != g1.hash()
+    g4, _ = _group(4, beacon_id="other")
+    assert g4.hash() != g1.hash()
+    # default and empty beacon ids are the same chain
+    g5, _ = _group(4, beacon_id="default")
+    assert g5.hash() == g1.hash()
+
+
+def test_group_genesis_seed_is_hash():
+    g, _ = _group(4)
+    assert g.get_genesis_seed() == g.hash()
+    # once set, stays stable even if the group mutates (reshare keeps seed)
+    seed = g.get_genesis_seed()
+    g.transition_time = 999
+    assert g.get_genesis_seed() == seed
+
+
+def test_group_toml_roundtrip():
+    g, _ = _group(5, t=3)
+    poly = tbls.PriPoly.random(3, secret=777)
+    g.public_key = DistPublic(
+        [SCH.key_group.to_bytes(c) for c in poly.commit(SCH.key_group).commits])
+    g.get_genesis_seed()
+    g.transition_time = 1700009999
+
+    g2 = Group.from_toml(g.to_toml())
+    assert g2.hash() == g.hash()
+    assert g2.threshold == g.threshold
+    assert g2.period == g.period and g2.catchup_period == g.catchup_period
+    assert g2.genesis_time == g.genesis_time
+    assert g2.genesis_seed == g.genesis_seed
+    assert g2.transition_time == g.transition_time
+    assert g2.public_key.equal(g.public_key)
+    assert all(a.equal(b) for a, b in zip(g2.nodes, g.nodes))
+    assert all(n.identity.valid_signature() for n in g2.nodes)
+
+
+def test_group_toml_rejects_bad_threshold():
+    g, _ = _group(4)
+    toml = g.to_toml().replace("Threshold = 3", "Threshold = 1")
+    with pytest.raises(ValueError):
+        Group.from_toml(toml)
+    toml = g.to_toml().replace("Threshold = 3", "Threshold = 9")
+    with pytest.raises(ValueError):
+        Group.from_toml(toml)
+
+
+# ---------------------------------------------------------------------------
+# File store
+# ---------------------------------------------------------------------------
+
+def test_file_store_roundtrip(tmp_path):
+    base = str(tmp_path)
+    store = FileStore(base, beacon_id="testnet")
+    pair = _pairs(1)[0]
+    store.save_keypair(pair)
+
+    loaded = store.load_keypair()
+    assert loaded.key == pair.key
+    assert loaded.public.equal(pair.public)
+    assert loaded.public.valid_signature()
+
+    # private material is owner-only
+    assert os.stat(store.private_key_file).st_mode & 0o077 == 0
+
+    g, _ = _group(4)
+    store.save_group(g)
+    assert store.load_group().hash() == g.hash()
+
+    poly = tbls.PriPoly.random(3, secret=42)
+    share = Share(scheme=SCH, private=poly.eval(2),
+                  commits=[SCH.key_group.to_bytes(c)
+                           for c in poly.commit(SCH.key_group).commits])
+    store.save_share(share)
+    s2 = store.load_share()
+    assert s2.private == share.private
+    assert s2.commits == share.commits
+    assert os.stat(store.share_file).st_mode & 0o077 == 0
+
+    assert list_beacon_ids(base) == ["testnet"]
+    store.reset()
+    assert store.load_group() is None and store.load_share() is None
+
+
+# ---------------------------------------------------------------------------
+# Vault
+# ---------------------------------------------------------------------------
+
+def test_vault_sign_and_rotate():
+    t, n = 2, 3
+    poly = tbls.PriPoly.random(t, secret=1111)
+    commits = [SCH.key_group.to_bytes(c) for c in poly.commit(SCH.key_group).commits]
+    share = Share(scheme=SCH, private=poly.eval(0), commits=commits)
+    g, _ = _group(3, t=2)
+    vault = Vault(SCH, g, share)
+
+    msg = SCH.digest_beacon(5, b"prev")
+    partial = vault.sign_partial(msg)
+    assert tbls.verify_partial(SCH, vault.get_pub(), msg, partial)
+    assert vault.public_key_bytes() == commits[0]
+
+    # reshare: new polynomial, same collective key is NOT required by vault
+    poly2 = tbls.PriPoly.random(t, secret=2222)
+    share2 = Share(scheme=SCH, private=poly2.eval(0),
+                   commits=[SCH.key_group.to_bytes(c)
+                            for c in poly2.commit(SCH.key_group).commits])
+    vault.set_info(g, share2)
+    partial2 = vault.sign_partial(msg)
+    assert tbls.verify_partial(SCH, vault.get_pub(), msg, partial2)
+    assert partial2 != partial
+
+    empty = Vault(SCH, g, None)
+    with pytest.raises(RuntimeError):
+        empty.sign_partial(msg)

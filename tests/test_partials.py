@@ -1,0 +1,56 @@
+"""Batched threshold-partial verification (drand_tpu/crypto/partials.py)
+against the host tbls golden path.  Shapes stay tiny (r=2, k=2) so each
+orientation compiles one RLC and one exact program.
+"""
+
+import numpy as np
+import pytest
+
+from drand_tpu.crypto import partials, tbls
+from drand_tpu.crypto.schemes import scheme_from_name
+
+
+def _setup(scheme_id, t=2, n=3):
+    sch = scheme_from_name(scheme_id)
+    poly = tbls.PriPoly.random(t, secret=424243)
+    shares = poly.shares(n)
+    pp = poly.commit(sch.key_group)
+    return sch, shares, pp, partials.BatchPartialVerifier(sch, pp, n)
+
+
+@pytest.mark.parametrize("scheme_id", ["bls-unchained-on-g1",
+                                       "pedersen-bls-unchained"])
+def test_verify_partials_happy_and_fallback(scheme_id):
+    sch, shares, pp, bv = _setup(scheme_id)
+    msgs = [sch.digest_beacon(r, None) for r in (1, 2)]
+    rows = [[tbls.sign_partial(sch, shares[i], m) for i in (0, 2)] for m in msgs]
+
+    # happy path: RLC accepts everything the host accepts
+    ok = bv.verify_partials(msgs, rows)
+    assert ok.all()
+    for m, row in zip(msgs, rows):
+        for p in row:
+            assert tbls.verify_partial(sch, pp, m, p)
+
+    # corruption is localized by the exact fallback
+    bad = bytearray(rows[1][0])
+    bad[10] ^= 1
+    rows2 = [rows[0], [bytes(bad), rows[1][1]]]
+    assert bv.verify_partials(msgs, rows2).tolist() == [[True, True], [False, True]]
+    assert not tbls.verify_partial(sch, pp, msgs[1], bytes(bad))
+
+    # ragged rows pad with False; out-of-range signer index rejected
+    forged = (5).to_bytes(2, "big") + rows[1][1][2:]
+    rows3 = [[rows[0][0]], [forged, rows[1][1]]]
+    assert bv.verify_partials(msgs, rows3).tolist() == [[True, False], [False, True]]
+
+    # wrong-index partial (valid sig bytes under another share) fails
+    swapped = rows[0][1][:2] + rows[0][0][2:]  # index 2 prefix, share-0 sig
+    assert bv.verify_partials([msgs[0]], [[swapped]]).tolist() == [[False]]
+    assert not tbls.verify_partial(sch, pp, msgs[0], swapped)
+
+
+def test_verify_partials_empty():
+    sch, shares, pp, bv = _setup("bls-unchained-on-g1")
+    assert bv.verify_partials([], []).shape == (0, 0)
+    assert bv.verify_partials([b"x"], [[]]).shape == (1, 0)
