@@ -19,13 +19,19 @@ class _RoundCache:
         self.round = round_
         self.prev_sig = prev_sig
         self.partials: Dict[int, bytes] = {}
-        # idx -> verification outcome, filled at aggregation time
-        self.checked: Dict[int, bool] = {}
+        # partial BYTES -> verification outcome, filled at aggregation time.
+        # Keyed by the exact bytes (not the signer index) so that dropping an
+        # invalid partial and later receiving an honest one from the same
+        # index forces re-verification, and an evicted-then-replaced partial
+        # can never inherit a stale verdict.
+        self.checked: Dict[bytes, bool] = {}
 
     def append(self, partial: bytes) -> bool:
         idx = index_of(partial)
         if idx in self.partials:
             return False
+        if self.checked.get(partial) is False:
+            return False  # known-bad bytes; don't re-admit
         self.partials[idx] = partial
         return True
 

@@ -13,7 +13,7 @@ import threading
 from typing import Callable, Optional
 
 from ..chain.beacon import Beacon
-from ..chain.errors import ErrNoBeaconStored
+from ..chain.errors import ErrNoBeaconSaved, ErrNoBeaconStored
 from ..crypto import tbls
 from ..crypto.vault import Vault
 from .cache import PartialCache
@@ -100,7 +100,12 @@ class ChainStore:
             try:
                 last = self.last()
                 if last.round >= round_:
-                    return self.cbstore.get(round_) if last.round != round_ else last
+                    if last.round == round_:
+                        return last
+                    try:
+                        return self.cbstore.get(round_)
+                    except ErrNoBeaconSaved:
+                        return None  # trimmed/skipped (e.g. memdb ring buffer)
             except ErrNoBeaconStored:
                 pass
             remaining = deadline - _t.monotonic()
@@ -145,17 +150,17 @@ class ChainStore:
 
         # Verify whatever the cache holds unchecked, in one batch (the
         # TPU-first move of node.go:150's per-packet pairing to aggregation
-        # time); invalid partials are dropped from the cache for good.
-        unchecked = [p for idx, p in rc.partials.items()
-                     if idx not in rc.checked]
+        # time).  Verdicts are keyed by the exact partial bytes: a dropped
+        # invalid partial does not block a later honest partial from the
+        # same signer index from being verified and used.
+        unchecked = [p for p in rc.partials.values() if p not in rc.checked]
         if unchecked:
             results = self.partial_verifier.verify(msg, unchecked)
             for p, ok in zip(unchecked, results):
-                idx = tbls.index_of(p)
-                rc.checked[idx] = bool(ok)
+                rc.checked[p] = bool(ok)
                 if not ok:
-                    rc.partials.pop(idx, None)
-        good = [p for idx, p in rc.partials.items() if rc.checked.get(idx)]
+                    rc.partials.pop(tbls.index_of(p), None)
+        good = [p for p in rc.partials.values() if rc.checked.get(p)]
         if len(good) < thr:
             return
 

@@ -77,6 +77,16 @@ class AppendStore(_Decorator):
             self.inner.put(beacon)
             self._last = beacon
 
+    def delete(self, round_: int) -> None:
+        """Deleting (e.g. a rolled-back head) must invalidate the cached
+        last beacon or the round stays unwritable forever."""
+        with self._lock:
+            self.inner.delete(round_)
+            try:
+                self._last = self.inner.last()
+            except ErrNoBeaconStored:
+                self._last = None
+
 
 class SchemeStore(_Decorator):
     """Linkage rules by scheme (store.go:80-124): chained beacons must carry

@@ -1,0 +1,312 @@
+"""SyncManager + SyncChain server (chain/beacon/sync_manager.go:28-590).
+
+The TPU-first redesign of the reference's sync path: where the Go code
+verifies each streamed beacon with one CPU pairing (sync_manager.go:406 —
+the designated batch hook per SURVEY.md §2.5), beacons here are buffered
+into chunks and verified in ONE device RLC pass per chunk through
+`BatchBeaconVerifier`, with the chained-linkage check done as the cheap
+host-side prefix pass.
+
+Components:
+  * `SyncManager.run` — serializes sync requests (queue 3), restarts a sync
+    idle for > 2·period (sync_manager.go:52-53,154-162), shuffles peers for
+    failover (sync_manager.go:302).
+  * `check_past_beacons` / `correct_past_beacons` — full-chain validation
+    and repair (sync_manager.go:170-268); repair writes through the RAW
+    store, bypassing the append decorator (the "insecure store" ReSync path,
+    sync_manager.go:411-416).
+  * `SyncChainServer` — the serving side of a sync stream: cursor replay
+    from `from_round`, then live-follow via a store callback registered
+    under the remote address (replaced on re-request, sync_manager.go:542-560).
+"""
+
+import queue
+import random
+import threading
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence
+
+from ..chain.beacon import Beacon
+from ..chain.errors import ErrNoBeaconSaved, ErrNoBeaconStored
+from .stores import ErrBeaconAlreadyStored
+
+DEFAULT_CHUNK = 512
+SYNC_QUEUE = 3
+
+
+class ErrFailedAll(Exception):
+    """Every candidate peer failed to advance the sync (sync_manager.go:59)."""
+
+
+class SyncManager:
+    """Pulls missing rounds from peers with batched device verification.
+
+    `fetch(peer, from_round)` must return an iterator of Beacons streamed by
+    the peer (the net layer's SyncChain client; tests wire SyncChainServer
+    generators directly)."""
+
+    def __init__(self, chain, scheme, public_key_bytes: bytes, period: int,
+                 clock, fetch: Callable[[object, int], Iterable[Beacon]],
+                 peers: Sequence[object] = (), chunk: int = DEFAULT_CHUNK,
+                 verifier=None):
+        from ..crypto.batch import BatchBeaconVerifier
+        self.chain = chain                  # ChainStore facade (decorators)
+        self.scheme = scheme
+        self.period = period
+        self.clock = clock
+        self.fetch = fetch
+        self.peers = list(peers)
+        self.chunk = chunk
+        self.verifier = verifier or BatchBeaconVerifier(scheme,
+                                                        public_key_bytes)
+        self._requests: queue.Queue = queue.Queue(maxsize=SYNC_QUEUE)
+        self._stop = threading.Event()
+        self._last_progress = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- request plane -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self.run, daemon=True,
+                                            name="sync-manager")
+            self._thread.start()
+
+    def send_sync_request(self, target_round: int,
+                          peers: Optional[Sequence[object]] = None) -> None:
+        """Non-blocking enqueue; a full queue drops the request — the next
+        gap detection re-issues it (sync_manager.go:121-142)."""
+        try:
+            self._requests.put_nowait((target_round, list(peers or self.peers)))
+        except queue.Full:
+            pass
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                target, peers = self._requests.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            # collapse queued requests to the farthest target
+            try:
+                while True:
+                    t2, p2 = self._requests.get_nowait()
+                    if t2 > target:
+                        target, peers = t2, p2
+            except queue.Empty:
+                pass
+            if target <= self._head_round():
+                continue
+            try:
+                self.sync(target, peers)
+            except ErrFailedAll:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- the sync itself -----------------------------------------------------
+
+    def _head_round(self) -> int:
+        try:
+            return self.chain.last().round
+        except ErrNoBeaconStored:
+            return 0
+
+    def sync(self, target_round: int, peers: Sequence[object]) -> None:
+        """Stream from shuffled peers until the chain reaches target_round."""
+        peers = list(peers)
+        random.shuffle(peers)
+        for peer in peers:
+            if self._stop.is_set():
+                return
+            try:
+                if self._try_peer(peer, target_round):
+                    return
+            except Exception:
+                continue
+        raise ErrFailedAll(f"no peer could sync us to round {target_round}")
+
+    def _try_peer(self, peer, target_round: int) -> bool:
+        head = self.chain.last()
+        buf: List[Beacon] = []
+        for b in self.fetch(peer, head.round + 1):
+            if self._stop.is_set():
+                return False
+            if b.round <= self._head_round():
+                continue
+            buf.append(b)
+            if len(buf) >= self.chunk:
+                head = self._verify_and_store(head, buf)
+                buf = []
+                if head is None:
+                    return False
+                if head.round >= target_round:
+                    return True
+        if buf:
+            head = self._verify_and_store(head, buf)
+        return head is not None and head.round >= target_round
+
+    def _verify_and_store(self, head: Beacon, chunk: List[Beacon]
+                          ) -> Optional[Beacon]:
+        """One device pass for the whole chunk; store on full success.
+
+        Returns the new head, or None if the peer's stream is invalid
+        (caller fails over to the next peer)."""
+        if not self._chunk_links(head, chunk):
+            return None
+        ok = self.verifier.verify_batch(
+            [b.round for b in chunk],
+            [b.signature for b in chunk],
+            [b.previous_sig for b in chunk])
+        if not ok.all():
+            return None
+        for b in chunk:
+            try:
+                self.chain.put(b)
+            except (ErrBeaconAlreadyStored, ValueError):
+                # racing the aggregator is benign (chainstore.go:253-265)
+                pass
+        self._last_progress = self.clock.now()
+        return chunk[-1]
+
+    def _chunk_links(self, head: Beacon, chunk: List[Beacon]) -> bool:
+        """Host-side linkage prefix pass (SURVEY.md §5.7)."""
+        prev = head
+        for b in chunk:
+            if b.round != prev.round + 1:
+                return False
+            if self.scheme.chained and prev.round > 0 \
+                    and b.previous_sig != prev.signature:
+                return False
+            prev = b
+        return True
+
+    # -- chain validation & repair (sync_manager.go:170-268) -----------------
+
+    def check_past_beacons(self, upto: int,
+                           progress: Optional[Callable[[int, int], None]] = None
+                           ) -> List[int]:
+        """Validate rounds 1..upto of our own store in device chunks.
+
+        Returns the faulty round numbers: missing from the store, failing
+        signature verification, or breaking the chained linkage."""
+        faulty: List[int] = []
+        store = self.chain.store
+        buf: List[Beacon] = []
+        prev: Optional[Beacon] = None       # linkage carried across chunks
+        for r in range(1, upto + 1):
+            try:
+                b = store.get(r)
+            except ErrNoBeaconSaved:
+                faulty.append(r)
+                continue
+            buf.append(b)
+            if len(buf) >= self.chunk:
+                faulty.extend(self._check_chunk(buf, prev))
+                prev = buf[-1]
+                if progress:
+                    progress(r, upto)
+                buf = []
+        if buf:
+            faulty.extend(self._check_chunk(buf, prev))
+            if progress:
+                progress(upto, upto)
+        return sorted(set(faulty))
+
+    def _check_chunk(self, chunk: List[Beacon],
+                     prev: Optional[Beacon]) -> List[int]:
+        ok = self.verifier.verify_batch(
+            [b.round for b in chunk],
+            [b.signature for b in chunk],
+            [b.previous_sig for b in chunk])
+        bad = [b.round for b, good in zip(chunk, ok) if not good]
+        if self.scheme.chained:
+            pairs = zip(([prev] if prev else []) + chunk, chunk if prev else chunk[1:])
+            for a, b in pairs:
+                if b.round == a.round + 1 and b.previous_sig != a.signature:
+                    bad.append(b.round)
+        return bad
+
+    def correct_past_beacons(self, raw_store, faulty: Sequence[int],
+                             peers: Optional[Sequence[object]] = None) -> List[int]:
+        """Re-fetch faulty rounds from peers, verify, and overwrite through
+        the RAW store (the append decorator would reject non-head writes).
+
+        Returns the rounds that could not be repaired."""
+        peers = list(peers or self.peers)
+        random.shuffle(peers)
+        remaining = sorted(set(faulty))
+        for peer in peers:
+            if not remaining:
+                break
+            still = []
+            for r in remaining:
+                b = self._fetch_one(peer, r)
+                if b is None or not self.verifier.verify_batch(
+                        [b.round], [b.signature], [b.previous_sig]).all():
+                    still.append(r)
+                    continue
+                raw_store.delete(r)
+                raw_store.put(b)
+            remaining = still
+        return remaining
+
+    def _fetch_one(self, peer, round_: int) -> Optional[Beacon]:
+        try:
+            for b in self.fetch(peer, round_):
+                if b.round == round_:
+                    return b
+                if b.round > round_:
+                    return None
+        except Exception:
+            return None
+        return None
+
+
+class SyncChainServer:
+    """Serving side of a sync stream (sync_manager.go:468-570)."""
+
+    def __init__(self, chain):
+        self.chain = chain                  # ChainStore facade
+
+    def stream(self, remote_addr: str, from_round: int,
+               stop: Optional[threading.Event] = None) -> Iterator[Beacon]:
+        """Replay from `from_round` via cursor, then live-follow stored
+        beacons through a callback keyed by the remote address — a
+        re-request from the same address replaces the old stream's callback
+        (sync_manager.go:542-560)."""
+        stop = stop or threading.Event()
+        q: queue.Queue = queue.Queue(maxsize=100)
+        cb_id = f"sync-{remote_addr}"
+        self.chain.cbstore.add_callback(cb_id, lambda b: _offer(q, b))
+        sent = from_round - 1
+        try:
+            cur = self.chain.store.cursor()
+            b = cur.seek(from_round) if from_round > 0 else cur.first()
+            while b is not None:
+                if b.round > sent:
+                    yield b
+                    sent = b.round
+                b = cur.next()
+            while not stop.is_set():
+                try:
+                    b = q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                if b is None:
+                    return
+                if b.round > sent:
+                    yield b
+                    sent = b.round
+        finally:
+            self.chain.cbstore.remove_callback(cb_id)
+
+
+def _offer(q: queue.Queue, item) -> None:
+    try:
+        q.put_nowait(item)
+    except queue.Full:
+        pass  # slow stream consumer; cursor catch-up will repair

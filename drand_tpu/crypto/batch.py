@@ -220,21 +220,36 @@ class BatchBeaconVerifier:
 
     # -- verification ---------------------------------------------------------
 
-    def _rlc_ok(self, pts, msgs) -> bool:
-        """One RLC check over the range; True iff every round verifies."""
-        n = len(msgs)
-        pad = _pad_len(n)
-        sig_jac, u0, u1 = self._encode(pts, msgs, pad)
-        bits = _rlc_scalars(n, pad)
+    def _slice_enc(self, enc, lo, hi):
+        """Slice the one-time batch encoding to [lo, hi), padded back to a
+        power of two with slots reused from the head of the batch — pad
+        slots are inert (zero RLC coefficients; exact results discarded), so
+        any well-formed slot serves.  Encoding once and slicing avoids
+        re-hashing messages and re-encoding Montgomery limbs at every
+        bisection level."""
+        import jax.numpy as jnp
+        padlen = _pad_len(hi - lo)
+        extra = padlen - (hi - lo)
+
+        def cut(t):
+            if lo == 0 and t.shape[0] == padlen:
+                return t                      # top level: already padded
+            s = t[lo:hi]
+            return jnp.concatenate([s, t[:extra]], axis=0) if extra else s
+
+        return jax.tree.map(cut, enc)
+
+    def _rlc_ok(self, enc, n) -> bool:
+        """One RLC check over an encoded range; True iff all n rounds verify."""
+        sig_jac, u0, u1 = enc
+        bits = _rlc_scalars(n, _pad_len(n))
         pipe = _rlc_pipeline_g2sig() if self.g2sig else _rlc_pipeline_g1sig()
         sub_ok, ok = pipe(sig_jac, u0, u1, bits, self.pk_aff, self.fixed_aff)
         return bool(ok) and np.asarray(sub_ok)[:n].all()
 
-    def _exact(self, pts, msgs) -> np.ndarray:
-        """Per-round exact pairing checks over the range."""
-        n = len(msgs)
-        pad = _pad_len(n)
-        sig_jac, u0, u1 = self._encode(pts, msgs, pad)
+    def _exact(self, enc, n) -> np.ndarray:
+        """Per-round exact pairing checks over an encoded range."""
+        sig_jac, u0, u1 = enc
         pipe = _exact_pipeline_g2sig() if self.g2sig else _exact_pipeline_g1sig()
         return np.asarray(pipe(sig_jac, u0, u1, self.pk_aff, self.fixed_aff))[:n]
 
@@ -244,16 +259,17 @@ class BatchBeaconVerifier:
     # chunk.  Compiled shapes stay bounded: every level is a power of two.
     _BISECT_MIN = 64
 
-    def _verify_range(self, pts, msgs, bad) -> np.ndarray:
-        n = len(msgs)
-        if not bad.any() and self._rlc_ok(pts, msgs):
+    def _verify_range(self, enc, lo, hi, bad) -> np.ndarray:
+        n = hi - lo
+        sub = self._slice_enc(enc, lo, hi)
+        if not bad[lo:hi].any() and self._rlc_ok(sub, n):
             return np.ones(n, dtype=bool)
         if n <= self._BISECT_MIN:
-            return self._exact(pts, msgs) & ~bad
-        mid = n // 2
+            return self._exact(sub, n) & ~bad[lo:hi]
+        mid = lo + n // 2
         return np.concatenate([
-            self._verify_range(pts[:mid], msgs[:mid], bad[:mid]),
-            self._verify_range(pts[mid:], msgs[mid:], bad[mid:]),
+            self._verify_range(enc, lo, mid, bad),
+            self._verify_range(enc, mid, hi, bad),
         ])
 
     def verify_batch(self, rounds, sigs, prev_sigs=None) -> np.ndarray:
@@ -261,7 +277,8 @@ class BatchBeaconVerifier:
 
         Fast path: one RLC check for the whole batch.  On failure, RLC
         bisection narrows to the bad region, then exact per-round checks
-        locate the invalid rounds."""
+        locate the invalid rounds.  Points and message hashes are encoded
+        exactly once; bisection works on slices of that encoding."""
         n = len(rounds)
         if n == 0:
             return np.zeros(0, dtype=bool)
@@ -269,7 +286,8 @@ class BatchBeaconVerifier:
             prev_sigs = [None] * n
         msgs = self._messages(rounds, prev_sigs)
         pts, bad = self._parse_sigs(sigs)
-        return self._verify_range(pts, msgs, bad)
+        enc = self._encode(pts, msgs, _pad_len(n))
+        return self._verify_range(enc, 0, n, bad)
 
     def verify_chain(self, beacons):
         """Verify a chained sequence of (round, sig, prev_sig) host-side

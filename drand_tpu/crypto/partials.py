@@ -218,23 +218,24 @@ class BatchPartialVerifier:
         if k == 0:
             return np.zeros((r, 0), dtype=bool)
         pts, idxs, valid = self._parse(partial_rows, k)
+        if not valid.any():
+            return valid  # nothing parsed — no device work to do
         sig_jac, u0, u1 = self._encode_slots(pts, msgs)
         rk = r * k
 
-        if valid.any():
-            flat_valid = valid.reshape(-1)
-            flat_idx = idxs.reshape(-1)
-            cs = [secrets.randbits(SECURITY_BITS) if v else 0 for v in flat_valid]
-            signers = sorted(set(flat_idx[flat_valid]))
-            onehot = np.zeros((len(signers), rk), dtype=np.uint32)
-            for i, s in enumerate(signers):
-                onehot[i] = (flat_idx == s) & flat_valid
-            bits = DC.scalars_to_bits(cs + cs, nbits=SECURITY_BITS)
-            sub_ok, ok = _rlc_pipeline(self.g2sig)(
-                sig_jac, u0, u1, bits, jnp.asarray(onehot),
-                self._pk_sel(signers), self.fixed_aff)
-            if bool(ok) and np.asarray(sub_ok)[flat_valid].all():
-                return valid
+        flat_valid = valid.reshape(-1)
+        flat_idx = idxs.reshape(-1)
+        cs = [secrets.randbits(SECURITY_BITS) if v else 0 for v in flat_valid]
+        signers = sorted(set(flat_idx[flat_valid]))
+        onehot = np.zeros((len(signers), rk), dtype=np.uint32)
+        for i, s in enumerate(signers):
+            onehot[i] = (flat_idx == s) & flat_valid
+        bits = DC.scalars_to_bits(cs + cs, nbits=SECURITY_BITS)
+        sub_ok, ok = _rlc_pipeline(self.g2sig)(
+            sig_jac, u0, u1, bits, jnp.asarray(onehot),
+            self._pk_sel(signers), self.fixed_aff)
+        if bool(ok) and np.asarray(sub_ok)[flat_valid].all():
+            return valid
 
         # exact fallback: per-slot pairings with per-slot public shares
         pk_slot = self._pk_sel(idxs.reshape(-1))

@@ -12,6 +12,9 @@ def create_secure_folder(path: str) -> str:
 def write_secure_file(path: str, data: bytes) -> None:
     fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
     try:
+        # O_CREAT's mode only applies to newly created files; force 0600 on
+        # pre-existing files too so secrets never stay world-readable.
+        os.fchmod(fd, 0o600)
         os.write(fd, data)
     finally:
         os.close(fd)
