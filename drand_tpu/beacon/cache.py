@@ -12,6 +12,12 @@ from typing import Dict, List, Optional, Tuple
 from ..crypto.tbls import index_of
 
 MAX_PARTIALS_PER_NODE = 100
+# How many distinct INVALID partials one signer index may submit to a single
+# round before that index is banned for the round.  Bounds both the `checked`
+# map and the device-verification work an equivocating member can force
+# (without it, distinct garbage blobs re-admit forever on a round that never
+# reaches threshold).
+MAX_BAD_PER_INDEX = 3
 
 
 class _RoundCache:
@@ -25,11 +31,20 @@ class _RoundCache:
         # index forces re-verification, and an evicted-then-replaced partial
         # can never inherit a stale verdict.
         self.checked: Dict[bytes, bool] = {}
+        self.bad_count: Dict[int, int] = {}
+
+    def mark_bad(self, partial: bytes) -> None:
+        """Record a failed verification verdict (called by the aggregator)."""
+        self.checked[partial] = False
+        idx = index_of(partial)
+        self.bad_count[idx] = self.bad_count.get(idx, 0) + 1
 
     def append(self, partial: bytes) -> bool:
         idx = index_of(partial)
         if idx in self.partials:
             return False
+        if self.bad_count.get(idx, 0) >= MAX_BAD_PER_INDEX:
+            return False  # index banned for this round (anti-DoS)
         if self.checked.get(partial) is False:
             return False  # known-bad bytes; don't re-admit
         self.partials[idx] = partial

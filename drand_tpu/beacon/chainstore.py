@@ -157,8 +157,10 @@ class ChainStore:
         if unchecked:
             results = self.partial_verifier.verify(msg, unchecked)
             for p, ok in zip(unchecked, results):
-                rc.checked[p] = bool(ok)
-                if not ok:
+                if ok:
+                    rc.checked[p] = True
+                else:
+                    rc.mark_bad(p)
                     rc.partials.pop(tbls.index_of(p), None)
         good = [p for p in rc.partials.values() if rc.checked.get(p)]
         if len(good) < thr:

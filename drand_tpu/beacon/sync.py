@@ -110,10 +110,14 @@ class SyncManager:
     # -- the sync itself -----------------------------------------------------
 
     def _head_round(self) -> int:
+        head = self._head_beacon()
+        return head.round if head is not None else 0
+
+    def _head_beacon(self) -> Optional[Beacon]:
         try:
-            return self.chain.last().round
+            return self.chain.last()
         except ErrNoBeaconStored:
-            return 0
+            return None   # fresh store (follow-mode bootstrap)
 
     def sync(self, target_round: int, peers: Sequence[object]) -> None:
         """Stream from shuffled peers until the chain reaches target_round."""
@@ -130,13 +134,17 @@ class SyncManager:
         raise ErrFailedAll(f"no peer could sync us to round {target_round}")
 
     def _try_peer(self, peer, target_round: int) -> bool:
-        head = self.chain.last()
+        head = self._head_beacon()
         buf: List[Beacon] = []
-        for b in self.fetch(peer, head.round + 1):
+        # Idle watchdog: a peer that stops producing for > 2·period is
+        # abandoned so sync() can fail over (sync_manager.go:52-53,154-162);
+        # without it a black-holed TCP stream stalls the manager forever.
+        stream = _IdleTimeoutIter(
+            self.fetch(peer, (head.round + 1) if head else 1),
+            idle=max(2 * self.period, 10), stop=self._stop)
+        for b in stream:
             if self._stop.is_set():
                 return False
-            if b.round <= self._head_round():
-                continue
             buf.append(b)
             if len(buf) >= self.chunk:
                 head = self._verify_and_store(head, buf)
@@ -149,12 +157,22 @@ class SyncManager:
             head = self._verify_and_store(head, buf)
         return head is not None and head.round >= target_round
 
-    def _verify_and_store(self, head: Beacon, chunk: List[Beacon]
+    def _verify_and_store(self, head: Optional[Beacon], chunk: List[Beacon]
                           ) -> Optional[Beacon]:
         """One device pass for the whole chunk; store on full success.
 
         Returns the new head, or None if the peer's stream is invalid
         (caller fails over to the next peer)."""
+        # The aggregator may have stored rounds while we streamed
+        # (chainstore.go:253-265): advance to the freshest head and drop the
+        # now-stale prefix BEFORE the linkage check, or an honest peer would
+        # be blamed for the overlap.
+        cur = self._head_beacon()
+        if cur is not None and (head is None or cur.round > head.round):
+            head = cur
+            chunk = [b for b in chunk if b.round > head.round]
+            if not chunk:
+                return head
         if not self._chunk_links(head, chunk):
             return None
         ok = self.verifier.verify_batch(
@@ -172,15 +190,19 @@ class SyncManager:
         self._last_progress = self.clock.now()
         return chunk[-1]
 
-    def _chunk_links(self, head: Beacon, chunk: List[Beacon]) -> bool:
-        """Host-side linkage prefix pass (SURVEY.md §5.7)."""
+    def _chunk_links(self, head: Optional[Beacon], chunk: List[Beacon]) -> bool:
+        """Host-side linkage prefix pass (SURVEY.md §5.7).
+
+        With no local head (fresh store) the first streamed beacon anchors
+        the walk; its own validity is established by the signature check."""
         prev = head
         for b in chunk:
-            if b.round != prev.round + 1:
-                return False
-            if self.scheme.chained and prev.round > 0 \
-                    and b.previous_sig != prev.signature:
-                return False
+            if prev is not None:
+                if b.round != prev.round + 1:
+                    return False
+                if self.scheme.chained and prev.round > 0 \
+                        and b.previous_sig != prev.signature:
+                    return False
             prev = b
         return True
 
@@ -242,16 +264,21 @@ class SyncManager:
         for peer in peers:
             if not remaining:
                 break
-            still = []
-            for r in remaining:
-                b = self._fetch_one(peer, r)
-                if b is None or not self.verifier.verify_batch(
-                        [b.round], [b.signature], [b.previous_sig]).all():
-                    still.append(r)
-                    continue
-                raw_store.delete(r)
-                raw_store.put(b)
-            remaining = still
+            fetched = [(r, self._fetch_one(peer, r)) for r in remaining]
+            got = [(r, b) for r, b in fetched if b is not None]
+            if got:
+                # one device pass for everything this peer produced
+                ok = self.verifier.verify_batch(
+                    [b.round for _, b in got],
+                    [b.signature for _, b in got],
+                    [b.previous_sig for _, b in got])
+                repaired = set()
+                for (r, b), good in zip(got, ok):
+                    if good:
+                        raw_store.delete(r)
+                        raw_store.put(b)
+                        repaired.add(r)
+                remaining = [r for r in remaining if r not in repaired]
         return remaining
 
     def _fetch_one(self, peer, round_: int) -> Optional[Beacon]:
@@ -284,13 +311,7 @@ class SyncChainServer:
         self.chain.cbstore.add_callback(cb_id, lambda b: _offer(q, b))
         sent = from_round - 1
         try:
-            cur = self.chain.store.cursor()
-            b = cur.seek(from_round) if from_round > 0 else cur.first()
-            while b is not None:
-                if b.round > sent:
-                    yield b
-                    sent = b.round
-                b = cur.next()
+            sent = yield from self._replay(from_round, sent)
             while not stop.is_set():
                 try:
                     b = q.get(timeout=0.1)
@@ -298,15 +319,92 @@ class SyncChainServer:
                     continue
                 if b is None:
                     return
+                if b.round > sent + 1:
+                    # the bounded queue dropped beacons (slow consumer):
+                    # re-replay the hole from the store before following on
+                    sent = yield from self._replay(sent + 1, sent)
                 if b.round > sent:
                     yield b
                     sent = b.round
         finally:
             self.chain.cbstore.remove_callback(cb_id)
 
+    def _replay(self, from_round: int, sent: int):
+        """Cursor replay of stored rounds >= from_round; returns new `sent`."""
+        cur = self.chain.store.cursor()
+        b = cur.seek(from_round) if from_round > 0 else cur.first()
+        while b is not None:
+            if b.round > sent:
+                yield b
+                sent = b.round
+            b = cur.next()
+        return sent
+
 
 def _offer(q: queue.Queue, item) -> None:
     try:
         q.put_nowait(item)
     except queue.Full:
-        pass  # slow stream consumer; cursor catch-up will repair
+        pass  # slow stream consumer; the live loop's gap replay repairs
+
+
+class _IdleTimeoutIter:
+    """Iterator wrapper that gives up when the source is idle too long.
+
+    The source is drained on a daemon thread into a queue; `__next__`
+    raises StopIteration after `idle` seconds without an item, and the
+    underlying gRPC call is cancelled if it exposes `cancel()`."""
+
+    _END = object()
+
+    def __init__(self, source, idle: float, stop: threading.Event):
+        self._source = source
+        self._idle = idle
+        self._stop = stop
+        self._dead = False          # consumer gave up; pump must exit
+        self._q: queue.Queue = queue.Queue(maxsize=64)
+        self._thread = threading.Thread(target=self._pump, daemon=True,
+                                        name="sync-stream-pump")
+        self._thread.start()
+
+    def _pump(self):
+        try:
+            for item in self._source:
+                while not self._stop.is_set() and not self._dead:
+                    try:
+                        self._q.put(item, timeout=1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set() or self._dead:
+                    self._cancel()
+                    return
+        except Exception:
+            pass
+        finally:
+            try:
+                self._q.put_nowait(self._END)
+            except queue.Full:
+                pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            item = self._q.get(timeout=self._idle)
+        except queue.Empty:
+            self._dead = True
+            self._cancel()
+            raise StopIteration
+        if item is self._END:
+            raise StopIteration
+        return item
+
+    def _cancel(self):
+        cancel = getattr(self._source, "cancel", None)
+        if callable(cancel):
+            try:
+                cancel()
+            except Exception:
+                pass

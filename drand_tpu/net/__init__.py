@@ -1,0 +1,16 @@
+"""L5 network plane: gRPC services, client pool, gateways, control plane.
+
+Reference: net/ (SURVEY.md §2.6).  Messages live in drand_tpu/protos;
+service specs in services.py; the generic service framework in rpc.py.
+"""
+
+from .client import CertManager, Peer, ProtocolClient
+from .listener import (ControlClient, ControlListener, Listener,
+                       PrivateGateway)
+from .services import CONTROL, PROTOCOL, PUBLIC
+
+__all__ = [
+    "CertManager", "Peer", "ProtocolClient", "ControlClient",
+    "ControlListener", "Listener", "PrivateGateway", "CONTROL", "PROTOCOL",
+    "PUBLIC",
+]

@@ -1,0 +1,143 @@
+"""Node-to-node gRPC client with a lazy per-peer connection pool.
+
+Reference: net/client_grpc.go:31-369 (conn pool :276, SyncChain stream pump
+:211-248, 1-minute default timeout :39 overridable via DRAND_DIAL_TIMEOUT).
+TLS here means channel credentials from the trusted-cert pool
+(net/certs.go:45); plaintext otherwise.
+"""
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Dict, Iterator, Optional, Sequence
+
+import grpc
+
+from ..chain.beacon import Beacon
+from ..protos import drand_pb2 as pb
+from . import convert, services
+
+DEFAULT_TIMEOUT = float(os.environ.get("DRAND_DIAL_TIMEOUT", "60"))
+
+
+@dataclass(frozen=True)
+class Peer:
+    """Reachable node address (net/peer.go)."""
+    address: str
+    tls: bool = False
+
+
+class CertManager:
+    """Pool of trusted PEM certs for TLS channels (net/certs.go:45)."""
+
+    def __init__(self):
+        self._pems = []
+
+    def add(self, pem_path: str) -> None:
+        with open(pem_path, "rb") as f:
+            self._pems.append(f.read())
+
+    def credentials(self) -> grpc.ChannelCredentials:
+        root = b"".join(self._pems) if self._pems else None
+        return grpc.ssl_channel_credentials(root_certificates=root)
+
+
+class ProtocolClient:
+    """Dial-side of the Protocol + Public services, one channel per peer."""
+
+    def __init__(self, certs: Optional[CertManager] = None,
+                 timeout: float = DEFAULT_TIMEOUT):
+        self.certs = certs or CertManager()
+        self.timeout = timeout
+        self._conns: Dict[tuple, grpc.Channel] = {}
+        self._lock = threading.Lock()
+
+    # -- pool ----------------------------------------------------------------
+
+    def channel(self, peer: Peer) -> grpc.Channel:
+        key = (peer.address, peer.tls)   # a TLS peer must never reuse a
+        with self._lock:                 # cached plaintext channel
+            ch = self._conns.get(key)
+            if ch is None:
+                if peer.tls:
+                    ch = grpc.secure_channel(peer.address,
+                                             self.certs.credentials())
+                else:
+                    ch = grpc.insecure_channel(peer.address)
+                self._conns[key] = ch
+            return ch
+
+    def close(self) -> None:
+        with self._lock:
+            for ch in self._conns.values():
+                ch.close()
+            self._conns.clear()
+
+    def _protocol(self, peer: Peer):
+        return services.PROTOCOL.stub(self.channel(peer))
+
+    def _public(self, peer: Peer):
+        return services.PUBLIC.stub(self.channel(peer))
+
+    # -- Protocol service ----------------------------------------------------
+
+    def get_identity(self, peer: Peer, beacon_id: str = "") -> pb.IdentityResponse:
+        req = pb.IdentityRequest(metadata=convert.metadata(beacon_id))
+        return self._protocol(peer).get_identity(req, timeout=self.timeout)
+
+    def signal_dkg_participant(self, peer: Peer, packet: pb.SignalDKGPacket,
+                               timeout: Optional[float] = None) -> None:
+        self._protocol(peer).signal_dkg_participant(
+            packet, timeout=timeout or self.timeout)
+
+    def push_dkg_info(self, peer: Peer, packet: pb.DKGInfoPacket,
+                      timeout: Optional[float] = None) -> None:
+        self._protocol(peer).push_dkg_info(packet,
+                                           timeout=timeout or self.timeout)
+
+    def broadcast_dkg(self, peer: Peer, packet: pb.DKGPacket) -> None:
+        self._protocol(peer).broadcast_dkg(packet, timeout=self.timeout)
+
+    def partial_beacon(self, peer: Peer, packet: pb.PartialBeaconPacket,
+                       timeout: Optional[float] = None) -> None:
+        self._protocol(peer).partial_beacon(packet,
+                                            timeout=timeout or self.timeout)
+
+    def sync_chain(self, peer: Peer, from_round: int,
+                   beacon_id: str = "") -> Iterator[Beacon]:
+        """Server-stream of BeaconPackets starting at from_round
+        (client_grpc.go:211-248)."""
+        req = pb.SyncRequest(from_round=from_round,
+                             metadata=convert.metadata(beacon_id))
+        for packet in self._protocol(peer).sync_chain(req):
+            yield convert.proto_to_beacon(packet)
+
+    def status(self, peer: Peer, beacon_id: str = "",
+               check_conn: Sequence[Peer] = ()) -> pb.StatusResponse:
+        req = pb.StatusRequest(metadata=convert.metadata(beacon_id))
+        for p in check_conn:
+            req.check_conn.append(pb.StatusAddress(address=p.address,
+                                                   tls=p.tls))
+        return self._protocol(peer).status(req, timeout=self.timeout)
+
+    # -- Public service ------------------------------------------------------
+
+    def public_rand(self, peer: Peer, round_: int = 0,
+                    beacon_id: str = "") -> pb.PublicRandResponse:
+        req = pb.PublicRandRequest(round=round_,
+                                   metadata=convert.metadata(beacon_id))
+        return self._public(peer).public_rand(req, timeout=self.timeout)
+
+    def public_rand_stream(self, peer: Peer, round_: int = 0,
+                           beacon_id: str = "") -> Iterator[pb.PublicRandResponse]:
+        req = pb.PublicRandRequest(round=round_,
+                                   metadata=convert.metadata(beacon_id))
+        return self._public(peer).public_rand_stream(req)
+
+    def chain_info(self, peer: Peer, beacon_id: str = "") -> pb.ChainInfoPacket:
+        req = pb.ChainInfoRequest(metadata=convert.metadata(beacon_id))
+        return self._public(peer).chain_info(req, timeout=self.timeout)
+
+    def home(self, peer: Peer, beacon_id: str = "") -> pb.HomeResponse:
+        req = pb.HomeRequest(metadata=convert.metadata(beacon_id))
+        return self._public(peer).home(req, timeout=self.timeout)

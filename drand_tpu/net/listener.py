@@ -1,0 +1,100 @@
+"""gRPC listeners + gateways (net/listener.go:37-209, net/gateway.go:17-105,
+net/control.go:23-96).
+
+A `PrivateGateway` is the daemon's composite network face: a serving
+listener (Protocol + Public on the node-to-node port) plus the dialing
+`ProtocolClient`.  The control plane is a separate localhost listener
+serving the `Control` service for the CLI.
+"""
+
+import threading
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from . import services
+from .client import CertManager, Peer, ProtocolClient
+
+
+class Listener:
+    """One gRPC server bound to an address, serving given (spec, impl)
+    pairs.  TLS when cert/key paths are provided (net/listener.go:132-166)."""
+
+    def __init__(self, address: str, handlers, tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None, max_workers: int = 16):
+        self.address = address
+        self.server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        self.server.add_generic_rpc_handlers(
+            tuple(spec.handler(impl) for spec, impl in handlers))
+        if tls_cert and tls_key:
+            with open(tls_key, "rb") as f:
+                key = f.read()
+            with open(tls_cert, "rb") as f:
+                crt = f.read()
+            creds = grpc.ssl_server_credentials(((key, crt),))
+            self.port = self.server.add_secure_port(address, creds)
+        else:
+            self.port = self.server.add_insecure_port(address)
+        if self.port == 0:
+            raise OSError(f"cannot bind {address}")
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self, grace: float = 1.0) -> None:
+        self.server.stop(grace).wait()
+
+
+class PrivateGateway:
+    """Serving + dialing composite for the node-to-node plane
+    (net/gateway.go:17-105).  `protocol_impl` and `public_impl` provide the
+    snake_case RPC methods of their service specs."""
+
+    def __init__(self, address: str, protocol_impl, public_impl,
+                 certs: Optional[CertManager] = None,
+                 tls_cert: Optional[str] = None, tls_key: Optional[str] = None):
+        self.listener = Listener(
+            address,
+            [(services.PROTOCOL, protocol_impl), (services.PUBLIC, public_impl)],
+            tls_cert=tls_cert, tls_key=tls_key)
+        self.client = ProtocolClient(certs=certs)
+        host = address.rsplit(":", 1)[0]
+        self.listen_addr = f"{host}:{self.listener.port}"
+
+    def start_all(self) -> None:
+        self.listener.start()
+
+    def stop_all(self) -> None:
+        self.listener.stop()
+        self.client.close()
+
+
+class ControlListener:
+    """Localhost control-plane server (net/control.go:23-66)."""
+
+    def __init__(self, control_impl, port: int = 0, host: str = "127.0.0.1"):
+        self.listener = Listener(f"{host}:{port}",
+                                 [(services.CONTROL, control_impl)])
+        self.port = self.listener.port
+
+    def start(self) -> None:
+        self.listener.start()
+
+    def stop(self) -> None:
+        self.listener.stop()
+
+
+class ControlClient:
+    """CLI-side control-plane client (net/control.go:68-96)."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 timeout: float = 10.0):
+        self.channel = grpc.insecure_channel(f"{host}:{port}")
+        self.timeout = timeout
+        self.stub = services.CONTROL.stub(self.channel,
+                                          default_timeout=timeout)
+
+    def close(self) -> None:
+        self.channel.close()

@@ -1,0 +1,48 @@
+"""The three drand service specs (wire-path compatible with the reference).
+
+  Protocol — node-to-node plane (protobuf/drand/protocol.proto:17-37)
+  Public   — public API plane   (protobuf/drand/api.proto:16-28)
+  Control  — localhost CLI<->daemon plane (protobuf/drand/control.proto:15-56)
+"""
+
+from ..protos import drand_pb2 as pb
+from .rpc import Method, ServiceSpec
+
+PROTOCOL = ServiceSpec("drand.Protocol", [
+    Method("GetIdentity", pb.IdentityRequest, pb.IdentityResponse),
+    Method("SignalDKGParticipant", pb.SignalDKGPacket, pb.Empty),
+    Method("PushDKGInfo", pb.DKGInfoPacket, pb.Empty),
+    Method("BroadcastDKG", pb.DKGPacket, pb.Empty),
+    Method("PartialBeacon", pb.PartialBeaconPacket, pb.Empty),
+    Method("SyncChain", pb.SyncRequest, pb.BeaconPacket, server_stream=True),
+    Method("Status", pb.StatusRequest, pb.StatusResponse),
+])
+
+PUBLIC = ServiceSpec("drand.Public", [
+    Method("PublicRand", pb.PublicRandRequest, pb.PublicRandResponse),
+    Method("PublicRandStream", pb.PublicRandRequest, pb.PublicRandResponse,
+           server_stream=True),
+    Method("ChainInfo", pb.ChainInfoRequest, pb.ChainInfoPacket),
+    Method("Home", pb.HomeRequest, pb.HomeResponse),
+])
+
+CONTROL = ServiceSpec("drand.Control", [
+    Method("PingPong", pb.Ping, pb.Pong),
+    Method("Status", pb.StatusRequest, pb.StatusResponse),
+    Method("ListSchemes", pb.ListSchemesRequest, pb.ListSchemesResponse),
+    Method("ListBeaconIDs", pb.ListBeaconIDsRequest, pb.ListBeaconIDsResponse),
+    Method("InitDKG", pb.InitDKGPacket, pb.GroupPacket),
+    Method("InitReshare", pb.InitResharePacket, pb.GroupPacket),
+    Method("PublicKey", pb.PublicKeyRequest, pb.PublicKeyResponse),
+    Method("PrivateKey", pb.PrivateKeyRequest, pb.PrivateKeyResponse),
+    Method("ChainInfo", pb.ChainInfoRequest, pb.ChainInfoPacket),
+    Method("GroupFile", pb.GroupRequest, pb.GroupPacket),
+    Method("Shutdown", pb.ShutdownRequest, pb.ShutdownResponse),
+    Method("LoadBeacon", pb.LoadBeaconRequest, pb.LoadBeaconResponse),
+    Method("StartFollowChain", pb.StartSyncRequest, pb.SyncProgress,
+           server_stream=True),
+    Method("StartCheckChain", pb.StartSyncRequest, pb.SyncProgress,
+           server_stream=True),
+    Method("BackupDatabase", pb.BackupDBRequest, pb.BackupDBResponse),
+    Method("RemoteStatus", pb.RemoteStatusRequest, pb.RemoteStatusResponse),
+])
