@@ -21,8 +21,14 @@ import sys
 import time
 
 # Persistent compile cache: the pairing/ladder programs are compile-heavy.
+# Under axon, jax is already imported (sitecustomize) before this file runs
+# and has snapshotted its env-derived config — set the config directly.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/drand_tpu_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/drand_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 
 N = int(os.environ.get("DRAND_TPU_BENCH_N", "4096"))
 BASELINE_RPS = 500.0  # serial kyber CPU anchor (BASELINE.md)

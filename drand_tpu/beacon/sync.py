@@ -142,20 +142,25 @@ class SyncManager:
         stream = _IdleTimeoutIter(
             self.fetch(peer, (head.round + 1) if head else 1),
             idle=max(2 * self.period, 10), stop=self._stop)
-        for b in stream:
-            if self._stop.is_set():
-                return False
-            buf.append(b)
-            if len(buf) >= self.chunk:
-                head = self._verify_and_store(head, buf)
-                buf = []
-                if head is None:
+        try:
+            for b in stream:
+                if self._stop.is_set():
                     return False
-                if head.round >= target_round:
-                    return True
-        if buf:
-            head = self._verify_and_store(head, buf)
-        return head is not None and head.round >= target_round
+                buf.append(b)
+                if len(buf) >= self.chunk:
+                    head = self._verify_and_store(head, buf)
+                    buf = []
+                    if head is None:
+                        return False
+                    if head.round >= target_round:
+                        return True
+            if buf:
+                head = self._verify_and_store(head, buf)
+            return head is not None and head.round >= target_round
+        finally:
+            # every exit path must tear the stream down, or the pump thread
+            # keeps draining the peer's live-follow stream forever
+            stream.close()
 
     def _verify_and_store(self, head: Optional[Beacon], chunk: List[Beacon]
                           ) -> Optional[Beacon]:
@@ -382,10 +387,14 @@ class _IdleTimeoutIter:
         except Exception:
             pass
         finally:
-            try:
-                self._q.put_nowait(self._END)
-            except queue.Full:
-                pass
+            # the END sentinel must be delivered even through a full queue,
+            # or the consumer only notices stream end after the idle timeout
+            while not self._stop.is_set() and not self._dead:
+                try:
+                    self._q.put(self._END, timeout=1)
+                    break
+                except queue.Full:
+                    continue
 
     def __iter__(self):
         return self
@@ -400,6 +409,11 @@ class _IdleTimeoutIter:
         if item is self._END:
             raise StopIteration
         return item
+
+    def close(self):
+        """Consumer is done with the stream: stop the pump + cancel the RPC."""
+        self._dead = True
+        self._cancel()
 
     def _cancel(self):
         cancel = getattr(self._source, "cancel", None)

@@ -1,0 +1,115 @@
+"""Watch aggregator + polling watcher.
+
+`WatchAggregator` (client/aggregator.go:26-219): fans ONE upstream watch
+out to any number of subscribers, with auto-restart when the upstream
+stream dies.  `PollingWatcher` (client/poll.go:17-62): synthesizes a watch
+for transports with no streaming (plain HTTP) by polling `get` aligned to
+the round schedule.
+"""
+
+import queue
+import threading
+import time
+from typing import Iterator, List, Optional
+
+from ..chain.info import Info
+from ..chain.timing import time_of_round
+from .interface import Client, Result
+
+
+class WatchAggregator(Client):
+    def __init__(self, inner: Client, auto_watch: bool = False):
+        self.inner = inner
+        self._subs: List[queue.Queue] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._pump: Optional[threading.Thread] = None
+        if auto_watch:
+            self._ensure_pump()
+
+    def _ensure_pump(self) -> None:
+        with self._lock:
+            if self._pump is None:
+                self._pump = threading.Thread(target=self._run, daemon=True,
+                                              name="watch-aggregator")
+                self._pump.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                for result in self.inner.watch(self._stop):
+                    with self._lock:
+                        subs = list(self._subs)
+                    for q in subs:
+                        try:
+                            q.put_nowait(result)
+                        except queue.Full:
+                            pass
+                    if self._stop.is_set():
+                        return
+            except Exception:
+                pass
+            self._stop.wait(1.0)   # upstream died: retry (aggregator.go)
+
+    def get(self, round_: int = 0) -> Result:
+        return self.inner.get(round_)
+
+    def watch(self, stop: Optional[threading.Event] = None
+              ) -> Iterator[Result]:
+        self._ensure_pump()
+        q: queue.Queue = queue.Queue(maxsize=32)
+        with self._lock:
+            self._subs.append(q)
+        try:
+            while not self._stop.is_set() \
+                    and not (stop is not None and stop.is_set()):
+                try:
+                    yield q.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+        finally:
+            with self._lock:
+                self._subs.remove(q)
+
+    def info(self) -> Info:
+        return self.inner.info()
+
+    def close(self) -> None:
+        self._stop.set()
+        self.inner.close()
+
+
+class PollingWatcher(Client):
+    """Wraps a get-only transport; watch polls once per round, aligned to
+    the round schedule (client/poll.go:17-62)."""
+
+    def __init__(self, inner: Client):
+        self.inner = inner
+
+    def get(self, round_: int = 0) -> Result:
+        return self.inner.get(round_)
+
+    def info(self) -> Info:
+        return self.inner.info()
+
+    def watch(self, stop: Optional[threading.Event] = None
+              ) -> Iterator[Result]:
+        stop = stop or threading.Event()
+        info = self.info()
+        last = 0
+        while not stop.is_set():
+            try:
+                result = self.inner.get(0)
+                if result.round > last:
+                    last = result.round
+                    yield result
+            except Exception:
+                pass
+            # sleep to just after the next round boundary
+            nxt = time_of_round(info.period, info.genesis_time, last + 1)
+            delay = max(nxt - time.time(), 0.0) + 0.1
+            if stop.wait(min(delay, info.period)):
+                return
+
+    def close(self) -> None:
+        self.inner.close()

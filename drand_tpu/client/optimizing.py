@@ -1,0 +1,136 @@
+"""Optimizing client (reference: client/optimizing.go:36-638).
+
+Tracks per-source latency, re-probing every `speed_test_interval`; `get`
+races the top-2 fastest sources with a stagger and returns the first
+success; `watch` follows the fastest source and fails over on error.
+"""
+
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from typing import Iterator, List, Optional
+
+from ..chain.info import Info
+from ..log import Logger
+from .interface import Client, Result
+
+SPEED_TEST_INTERVAL = 300.0     # optimizing.go: 5 min
+RACE_STAGGER = 0.5              # head start for the fastest source (s)
+DEFAULT_TIMEOUT = 5.0
+
+
+class _Source:
+    def __init__(self, client: Client):
+        self.client = client
+        self.latency = float("inf")
+
+    def probe(self) -> None:
+        t0 = time.perf_counter()
+        try:
+            self.client.get(0)
+            self.latency = time.perf_counter() - t0
+        except Exception:
+            self.latency = float("inf")
+
+
+class OptimizingClient(Client):
+    def __init__(self, sources: List[Client],
+                 speed_test_interval: float = SPEED_TEST_INTERVAL,
+                 log: Optional[Logger] = None):
+        if not sources:
+            raise ValueError("optimizing client needs at least one source")
+        self.sources = [_Source(c) for c in sources]
+        self.log = (log or Logger()).named("optimizing")
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._interval = speed_test_interval
+        self._prober: Optional[threading.Thread] = None
+
+    def start_speed_tests(self) -> None:
+        """Periodic latency ranking (optimizing.go testSpeed)."""
+        if self._prober is None:
+            self._prober = threading.Thread(target=self._probe_loop,
+                                            daemon=True, name="speed-test")
+            self._prober.start()
+
+    def _probe_loop(self) -> None:
+        while not self._stop.is_set():
+            for s in self.sources:
+                if self._stop.is_set():
+                    return
+                s.probe()
+            self._stop.wait(self._interval)
+
+    def _ranked(self) -> List[_Source]:
+        with self._lock:
+            return sorted(self.sources, key=lambda s: s.latency)
+
+    # -- Client --------------------------------------------------------------
+
+    def get(self, round_: int = 0) -> Result:
+        """Race the two fastest sources with a stagger
+        (optimizing.go:233-266,287-350)."""
+        ranked = self._ranked()
+        racers = ranked[:2]
+        errors: List[Exception] = []
+        with ThreadPoolExecutor(max_workers=len(racers)) as pool:
+            futures = {}
+            for i, src in enumerate(racers):
+                if i > 0:
+                    done, _ = wait(futures, timeout=RACE_STAGGER,
+                                   return_when=FIRST_COMPLETED)
+                    for f in done:
+                        try:
+                            return f.result()
+                        except Exception as e:
+                            errors.append(e)
+                futures[pool.submit(src.client.get, round_)] = src
+            for f, src in futures.items():
+                try:
+                    result = f.result(timeout=DEFAULT_TIMEOUT)
+                    src.latency = min(src.latency, DEFAULT_TIMEOUT)
+                    return result
+                except Exception as e:
+                    src.latency = float("inf")
+                    errors.append(e)
+        raise errors[-1] if errors else RuntimeError("no source succeeded")
+
+    def watch(self, stop: Optional[threading.Event] = None
+              ) -> Iterator[Result]:
+        """Follow the fastest source; on stream failure fail over to the
+        next (optimizing.go watch failover)."""
+        stop = stop or self._stop
+        last_round = 0
+        while not stop.is_set():
+            progressed = False
+            for src in self._ranked():
+                try:
+                    for result in src.client.watch(stop):
+                        if result.round > last_round:
+                            last_round = result.round
+                            progressed = True
+                            yield result
+                        if stop.is_set():
+                            return
+                except Exception as e:
+                    self.log.warn("watch source failed; failing over",
+                                  err=str(e))
+                    continue
+            if not progressed:
+                # every source failed without yielding: back off briefly
+                if stop.wait(1.0):
+                    return
+
+    def info(self) -> Info:
+        err: Optional[Exception] = None
+        for src in self._ranked():
+            try:
+                return src.client.info()
+            except Exception as e:
+                err = e
+        raise err or RuntimeError("no source for info")
+
+    def close(self) -> None:
+        self._stop.set()
+        for s in self.sources:
+            s.client.close()

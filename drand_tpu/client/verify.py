@@ -1,0 +1,138 @@
+"""Verifying client (reference: client/verify.go:13-199 — north-star loop #2).
+
+Every result is verified against the chain info before it is returned.  In
+strict chained mode the client walks from its last point of trust to the
+requested round; where the reference verifies that walk one beacon at a
+time on the CPU (verify.go:139-160), this client fetches the span and
+verifies it in device-sized batches through `BatchBeaconVerifier` — the
+chain-catchup case BASELINE config 1 measures.
+"""
+
+import threading
+from typing import Iterator, Optional
+
+from ..chain.beacon import Beacon
+from ..chain.info import Info
+from ..crypto.schemes import scheme_from_name
+from ..log import Logger
+from .interface import Client, Result
+
+BATCH = 512
+
+
+def verify_beacon_with_info(info: Info, beacon: Beacon) -> bool:
+    scheme = scheme_from_name(info.scheme)
+    return scheme.verify_beacon(info.public_key, beacon.round,
+                                beacon.previous_sig, beacon.signature)
+
+
+class VerifyingClient(Client):
+    def __init__(self, inner: Client, info: Optional[Info] = None,
+                 strict: bool = False, log: Optional[Logger] = None):
+        """`strict`: full chained-walk verification from the last verified
+        point of trust (client.WithFullChainVerification)."""
+        self.inner = inner
+        self._info = info
+        self.strict = strict
+        self.log = (log or Logger()).named("verify")
+        self._lock = threading.Lock()
+        self._trusted: Optional[Beacon] = None   # last verified beacon
+        self._scheme = None
+        self._verifier = None
+
+    # -- plumbing ------------------------------------------------------------
+
+    def info(self) -> Info:
+        if self._info is None:
+            self._info = self.inner.info()
+        return self._info
+
+    # batches below this size verify on the host (native C path) — the
+    # device pipeline's compile+dispatch only pays off on real sweeps
+    DEVICE_MIN_BATCH = 64
+
+    def _ensure_crypto(self):
+        if self._verifier is None:
+            from ..crypto.hostverify import HostBatchVerifier
+            info = self.info()
+            self._scheme = scheme_from_name(info.scheme)
+            self._verifier = HostBatchVerifier(self._scheme,
+                                               info.public_key)
+            self._device_verifier = None
+        return self._scheme, self._verifier
+
+    def _sweep_verifier(self, n: int):
+        """Device verifier for large spans, host verifier otherwise."""
+        if n < self.DEVICE_MIN_BATCH:
+            return self._verifier
+        if self._device_verifier is None:
+            from ..crypto.batch import BatchBeaconVerifier
+            info = self.info()
+            self._device_verifier = BatchBeaconVerifier(self._scheme,
+                                                        info.public_key)
+        return self._device_verifier
+
+    # -- Client --------------------------------------------------------------
+
+    def get(self, round_: int = 0) -> Result:
+        result = self.inner.get(round_)
+        return self._verified(result)
+
+    def watch(self, stop: Optional[threading.Event] = None
+              ) -> Iterator[Result]:
+        for result in self.inner.watch(stop):
+            try:
+                yield self._verified(result)
+            except ValueError as e:
+                self.log.warn("dropping unverifiable watch result",
+                              round=result.round, err=str(e))
+
+    def close(self) -> None:
+        self.inner.close()
+
+    # -- verification --------------------------------------------------------
+
+    def _verified(self, result: Result) -> Result:
+        scheme, verifier = self._ensure_crypto()
+        beacon = result.beacon()
+        if self.strict and scheme.chained:
+            self._walk_to(beacon)
+        elif not verifier.verify_batch(
+                [beacon.round], [beacon.signature],
+                [beacon.previous_sig]).all():
+            raise ValueError(f"round {beacon.round}: invalid signature")
+        with self._lock:
+            if self._trusted is None or beacon.round > self._trusted.round:
+                self._trusted = beacon
+        # randomness is recomputed locally, never trusted from the wire
+        # (verify.go:197)
+        return Result.from_beacon(beacon)
+
+    def _walk_to(self, target: Beacon) -> None:
+        """Chained catch-up from the last point of trust, batch-verified
+        (getTrustedPreviousSignature verify.go:109-171, redesigned as a
+        device sweep)."""
+        scheme, verifier = self._ensure_crypto()
+        with self._lock:
+            trusted = self._trusted
+        start = trusted.round + 1 if trusted is not None else 1
+        span: list = []
+        for r in range(start, target.round):
+            span.append(self.inner.get(r).beacon())
+        span.append(target)
+        # host linkage prefix pass, then device signature sweep in chunks
+        prev = trusted
+        for b in span:
+            if prev is not None and b.previous_sig != prev.signature:
+                raise ValueError(f"round {b.round}: chain linkage broken")
+            prev = b
+        sweeper = self._sweep_verifier(len(span))
+        for i in range(0, len(span), BATCH):
+            chunk = span[i:i + BATCH]
+            ok = sweeper.verify_batch(
+                [b.round for b in chunk],
+                [b.signature for b in chunk],
+                [b.previous_sig for b in chunk])
+            if not ok.all():
+                bad = [b.round for b, good in zip(chunk, ok) if not good]
+                raise ValueError(f"invalid signatures at rounds {bad}")

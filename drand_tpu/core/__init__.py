@@ -1,0 +1,8 @@
+"""L6 daemon orchestration (reference: core/, SURVEY.md §2.7)."""
+
+from .beacon_process import BeaconProcess
+from .config import Config, default_config_folder
+from .daemon import DrandDaemon
+
+__all__ = ["BeaconProcess", "Config", "DrandDaemon",
+           "default_config_folder"]

@@ -1,0 +1,521 @@
+"""BeaconProcess: one chain's full lifecycle inside a daemon
+(reference: core/drand_beacon.go:31-614 + the DKG orchestration spread
+across core/drand_beacon_control.go:41-624).
+
+Owns keypair, group, share, the beacon Handler, the chain store and the
+sync plane; drives DKG/reshare sessions over the network through the
+EchoBroadcast board and the setup managers.
+"""
+
+import os
+import threading
+from typing import Iterator, List, Optional
+
+from ..beacon.node import (Handler, HandlerConfig, PartialBeaconPacket,
+                           device_verifier_factory, _host_verifier_factory)
+from ..beacon.sync import SyncChainServer, SyncManager
+from ..chain.beacon import Beacon
+from ..chain.errors import ErrNoBeaconStored
+from ..chain.info import Info
+from ..chain.memdb import MemDBStore
+from ..chain.sqlitedb import SqliteStore
+from ..crypto import dkg as D
+from ..key.group import Group
+from ..key.keys import Pair, Share
+from ..key.store import FileStore
+from ..log import Logger
+from ..metrics import (ThresholdMonitor, beacon_discrepancy_latency,
+                       group_size, group_threshold, last_beacon_round)
+from ..net import Peer, ProtocolClient
+from ..net import convert
+from ..protos import drand_pb2 as pb
+from .broadcast import EchoBroadcast
+from .config import CALL_MAX_TIMEOUT, Config
+from .dkg_runner import run_dkg
+from .setup import (SetupManager, SetupReceiver, hash_secret, sign_group)
+
+# DKG status enum (core/drand_status.go:36-101)
+DKG_NOT_STARTED, DKG_WAITING, DKG_IN_PROGRESS, DKG_DONE = 0, 1, 2, 3
+
+
+class BeaconProcess:
+    def __init__(self, cfg: Config, file_store: FileStore, beacon_id: str,
+                 pair: Pair, client: ProtocolClient, log: Logger):
+        self.cfg = cfg
+        self.fs = file_store
+        self.beacon_id = beacon_id or "default"
+        self.pair = pair
+        self.client = client
+        self.log = log.named(self.beacon_id)
+        self.clock = cfg.clock
+        self.group: Optional[Group] = None
+        self.share: Optional[Share] = None
+        self.handler: Optional[Handler] = None
+        self.syncm: Optional[SyncManager] = None
+        self.sync_server: Optional[SyncChainServer] = None
+        self.store = None
+        self.dkg_status = DKG_NOT_STARTED
+        self.reshare_status = DKG_NOT_STARTED
+        self.monitor: Optional[ThresholdMonitor] = None
+        # live DKG session plumbing (filled during a session)
+        self._setup_manager: Optional[SetupManager] = None
+        self._setup_receiver: Optional[SetupReceiver] = None
+        self._board: Optional[EchoBroadcast] = None
+        # bundles that raced ahead of board creation (a peer can start
+        # dealing the instant it has the group, before our board is up)
+        self._pending_dkg: List[pb.DKGPacket] = []
+        self._lock = threading.Lock()
+
+    # -- persistence (drand_beacon.go:110-162) ------------------------------
+
+    def load(self) -> bool:
+        """Restore group + share from disk; True when this beacon has state."""
+        self.group = self.fs.load_group()
+        if self.group is None:
+            return False
+        self.share = self.fs.load_share()
+        self.dkg_status = DKG_DONE if self.share is not None else DKG_NOT_STARTED
+        return self.share is not None
+
+    # -- store / handler plumbing -------------------------------------------
+
+    def _create_store(self):
+        """bolt-equivalent embedded store or memdb
+        (drand_beacon.go:340-373)."""
+        if self.cfg.db_engine == "memdb":
+            return MemDBStore(self.cfg.memdb_size)
+        db_dir = self.cfg.db_folder(self.beacon_id)
+        os.makedirs(db_dir, mode=0o700, exist_ok=True)
+        return SqliteStore(os.path.join(db_dir, "chain.db"))
+
+    def chain_info(self) -> Optional[Info]:
+        if self.group is None or self.group.public_key is None:
+            return None
+        return Info(public_key=self.group.public_key.key(),
+                    period=self.group.period,
+                    genesis_time=self.group.genesis_time,
+                    genesis_seed=self.group.get_genesis_seed(),
+                    scheme=self.group.scheme.id,
+                    beacon_id=self.beacon_id)
+
+    def _peers(self, group: Optional[Group] = None) -> List[Peer]:
+        g = group or self.group
+        return [Peer(n.identity.addr, n.identity.tls) for n in g.nodes
+                if n.identity.addr != self.pair.public.addr]
+
+    def _broadcast_partial(self, packet: PartialBeaconPacket) -> None:
+        """Fan the partial out to every peer, one thread each
+        (node.go:445-472); failures feed the threshold monitor."""
+        proto = pb.PartialBeaconPacket(
+            round=packet.round,
+            previous_signature=packet.previous_signature or b"",
+            partial_sig=packet.partial_sig,
+            metadata=convert.metadata(self.beacon_id))
+
+        def send(peer: Peer):
+            try:
+                self.client.partial_beacon(peer, proto)
+            except Exception as e:
+                if self.monitor is not None:
+                    self.monitor.report_failure(peer.address)
+                self.log.debug("partial send failed", dest=peer.address,
+                               err=str(e))
+
+        for peer in self._peers():
+            threading.Thread(target=send, args=(peer,), daemon=True).start()
+
+    def start_beacon(self, catchup: bool) -> None:
+        """Create store + handler + sync plane and start the round loop
+        (drand_beacon.go:240-268, newBeacon :375)."""
+        with self._lock:
+            if self.handler is not None:
+                return
+            assert self.group is not None and self.share is not None
+            self.store = self._create_store()
+            verifier_factory = (device_verifier_factory
+                                if self.cfg.use_device_verifier
+                                else _host_verifier_factory)
+            self.monitor = ThresholdMonitor(self.beacon_id, self.log,
+                                            self.group.threshold)
+            self.monitor.start()
+            handler_cfg = HandlerConfig(
+                group=self.group,
+                share=self.share,
+                index=self.share.private.index,
+                store=self.store,
+                clock=self.clock,
+                verifier_factory=verifier_factory,
+                broadcast=self._broadcast_partial,
+                on_sync_needed=self._on_sync_needed,
+                beacon_id=self.beacon_id)
+            self.handler = Handler(handler_cfg)
+            self.sync_server = SyncChainServer(self.handler.chain)
+            sync_verifier = None
+            if not self.cfg.use_device_verifier:
+                from ..crypto.hostverify import HostBatchVerifier
+                sync_verifier = HostBatchVerifier(
+                    self.group.scheme, self.group.public_key.key())
+            self.syncm = SyncManager(
+                chain=self.handler.chain,
+                scheme=self.group.scheme,
+                public_key_bytes=self.group.public_key.key(),
+                period=self.group.period,
+                clock=self.clock,
+                fetch=lambda peer, fr: self.client.sync_chain(
+                    peer, fr, self.beacon_id),
+                peers=self._peers(),
+                chunk=self.cfg.sync_chunk,
+                verifier=sync_verifier)
+            self.syncm.start()
+            self.handler.chain.cbstore.add_callback(
+                "metrics", self._metrics_callback)
+            group_size.labels(self.beacon_id).set(len(self.group))
+            group_threshold.labels(self.beacon_id).set(self.group.threshold)
+        if catchup:
+            self.handler.catchup()
+        else:
+            self.handler.start()
+        self.log.info("beacon started", catchup=catchup,
+                      genesis=self.group.genesis_time)
+
+    def _metrics_callback(self, b: Beacon) -> None:
+        from ..chain.timing import time_of_round
+        last_beacon_round.labels(self.beacon_id).set(b.round)
+        expected = time_of_round(self.group.period, self.group.genesis_time,
+                                 b.round)
+        beacon_discrepancy_latency.labels(self.beacon_id).set(
+            (self.clock.now() - expected) * 1000.0)
+
+    def _on_sync_needed(self, target_round: int) -> None:
+        if self.syncm is not None:
+            self.syncm.send_sync_request(target_round)
+
+    def stop(self) -> None:
+        with self._lock:
+            if self.syncm is not None:
+                self.syncm.stop()
+            if self.handler is not None:
+                self.handler.stop()
+            if self.monitor is not None:
+                self.monitor.stop()
+            if self._board is not None:
+                self._board.stop()
+            if self.store is not None:
+                self.store.close()
+            self.handler = None
+
+    # -- RPC ingress (routed here by the daemon services) --------------------
+
+    def process_partial(self, req: pb.PartialBeaconPacket) -> None:
+        if self.handler is None:
+            raise ValueError("beacon not running")
+        self.handler.process_partial_beacon(PartialBeaconPacket(
+            round=req.round,
+            previous_signature=req.previous_signature or None,
+            partial_sig=req.partial_sig,
+            beacon_id=self.beacon_id))
+
+    def serve_sync(self, remote_addr: str, from_round: int,
+                   stop: Optional[threading.Event] = None) -> Iterator[Beacon]:
+        if self.sync_server is None:
+            raise ValueError("beacon not running")
+        return self.sync_server.stream(remote_addr, from_round, stop=stop)
+
+    def get_beacon(self, round_: int) -> Beacon:
+        """round 0 = latest (core/drand_beacon_public.go:67-101)."""
+        if self.handler is None:
+            raise ErrNoBeaconStored("beacon not running")
+        if round_ == 0:
+            return self.handler.chain.last()
+        return self.handler.chain.store.get(round_)
+
+    # -- DKG: leader path (drand_beacon_control.go:41-117,275-411) ----------
+
+    def init_dkg_leader(self, n_nodes: int, threshold: int, period: int,
+                        catchup_period: int, secret: bytes,
+                        setup_timeout: float, scheme) -> Group:
+        self.dkg_status = DKG_WAITING
+        self._setup_manager = SetupManager(
+            self.log, scheme, self.beacon_id, n_nodes, secret,
+            self.pair.public)
+        try:
+            self._setup_manager.wait_participants(setup_timeout)
+            group = self._setup_manager.create_group(
+                threshold, period, catchup_period, self.clock.now(),
+                self.cfg.dkg_timeout)
+            self._push_dkg_info(group)
+            out_group = self._run_dkg_session(group, leader=True)
+        finally:
+            self._setup_manager = None
+        return out_group
+
+    def _push_dkg_info(self, group: Group,
+                       secret_proof: bytes = b"") -> None:
+        """Signed group to every participant (drand_beacon_control.go:
+        988-1083); all pushes must succeed for a fresh DKG."""
+        sig = sign_group(group, group.scheme, self.pair.key)
+        packet = pb.DKGInfoPacket(
+            new_group=convert.group_to_proto(group, self.beacon_id),
+            secret_proof=secret_proof,
+            dkg_timeout=self.cfg.dkg_timeout,
+            signature=sig,
+            metadata=convert.metadata(self.beacon_id))
+        errors = []
+        for peer in self._peers(group):
+            try:
+                self.client.push_dkg_info(peer, packet,
+                                          timeout=CALL_MAX_TIMEOUT)
+            except Exception as e:
+                errors.append((peer.address, e))
+        if errors:
+            raise RuntimeError(f"push_dkg_info failed: {errors}")
+
+    # -- DKG: follower path (drand_beacon_control.go:536-624) ---------------
+
+    def join_dkg(self, leader: Peer, secret: bytes,
+                 setup_timeout: float) -> Group:
+        self.dkg_status = DKG_WAITING
+        self._setup_receiver = SetupReceiver(
+            self.log, self._fetch_leader_identity(leader))
+        try:
+            sig_packet = pb.SignalDKGPacket(
+                node=convert.identity_to_proto(self.pair.public),
+                secret_proof=hash_secret(secret),
+                metadata=convert.metadata(self.beacon_id))
+            self._signal_with_retry(leader, sig_packet, setup_timeout)
+            group, _ = self._setup_receiver.wait_group(setup_timeout)
+            return self._run_dkg_session(group, leader=False)
+        finally:
+            self._setup_receiver = None
+
+    def _signal_with_retry(self, leader: Peer, packet, budget: float,
+                           backoff: float = 0.5) -> None:
+        """The leader may not have run InitDKG yet when we signal; keep
+        retrying within the setup budget (the reference CLI loops the same
+        way while the coordinator comes up)."""
+        import time as _time
+        deadline = _time.monotonic() + budget
+        while True:
+            try:
+                self.client.signal_dkg_participant(leader, packet,
+                                                   timeout=CALL_MAX_TIMEOUT)
+                return
+            except Exception:
+                if _time.monotonic() + backoff >= deadline:
+                    raise
+                _time.sleep(backoff)
+
+    def _fetch_leader_identity(self, leader: Peer, budget: float = 30.0):
+        import time as _time
+        deadline = _time.monotonic() + budget
+        while True:
+            try:
+                resp = self.client.get_identity(leader, self.beacon_id)
+                break
+            except Exception:
+                if _time.monotonic() + 0.5 >= deadline:
+                    raise
+                _time.sleep(0.5)
+        from ..crypto.schemes import get_scheme_by_id_with_default
+        scheme = get_scheme_by_id_with_default(resp.schemeName)
+        ident = convert.proto_to_identity(resp, scheme)
+        if not ident.valid_signature():
+            raise ValueError("leader identity signature invalid")
+        return ident
+
+    # -- shared DKG session (fresh) ------------------------------------------
+
+    def _dkg_nodes(self, group: Group) -> List[D.DkgNode]:
+        return [D.DkgNode(n.index, n.identity.key) for n in group.nodes]
+
+    def _run_dkg_session(self, group: Group, leader: bool) -> Group:
+        self.dkg_status = DKG_IN_PROGRESS
+        nonce = group.hash()
+        nodes = self._dkg_nodes(group)
+        board = EchoBroadcast(
+            self.client, self.log, self.beacon_id,
+            self.pair.public.addr, nonce, dealers=nodes, holders=nodes,
+            peers=[Peer(n.identity.addr, n.identity.tls)
+                   for n in group.nodes],
+            scheme=group.scheme)
+        self._install_board(board)
+        try:
+            if leader:
+                # grace beat so followers can bring their boards up before
+                # our deals hit the wire (the pending buffer catches any
+                # stragglers anyway)
+                self.clock.wait_until(
+                    self.clock.now() + self.cfg.dkg_kickoff_grace,
+                    threading.Event())
+            gen = D.DistKeyGenerator(D.DkgConfig(
+                scheme=group.scheme, longterm=self.pair.key, nonce=nonce,
+                new_nodes=nodes, threshold=group.threshold))
+            out = run_dkg(gen, board, self.clock, self.cfg.dkg_timeout,
+                          self.log)
+        finally:
+            self._clear_board(board)
+        return self._adopt_dkg_output(group, out)
+
+    def _adopt_dkg_output(self, group: Group, out: D.DkgOutput) -> Group:
+        """Filter QUAL, persist share + completed group, start the chain
+        (WaitDKG, core/drand_beacon.go:167-236)."""
+        from ..key.keys import DistPublic
+        group.public_key = DistPublic(list(out.commits))
+        self.group = group
+        self.share = (Share(scheme=group.scheme, private=out.share,
+                            commits=list(out.commits))
+                      if out.share is not None else None)
+        self.fs.save_group(group)
+        if self.share is not None:
+            self.fs.save_share(self.share)
+        self.dkg_status = DKG_DONE
+        if self.cfg.dkg_callback is not None:
+            self.cfg.dkg_callback(self.beacon_id, group)
+        return group
+
+    # -- resharing (drand_beacon_control.go:123-234,425-529) -----------------
+
+    def init_reshare_leader(self, old_group: Group, n_nodes: int,
+                            threshold: int, secret: bytes,
+                            setup_timeout: float) -> Group:
+        self.reshare_status = DKG_IN_PROGRESS
+        self._setup_manager = SetupManager(
+            self.log, old_group.scheme, self.beacon_id, n_nodes, secret,
+            self.pair.public)
+        try:
+            self._setup_manager.wait_participants(setup_timeout)
+            new_group = self._setup_manager.create_reshare_group(
+                old_group, threshold, self.clock.now(),
+                reshare_offset=self.cfg.reshare_offset)
+            self._push_dkg_info(new_group)
+            return self._run_reshare_session(old_group, new_group)
+        finally:
+            self._setup_manager = None
+
+    def join_reshare(self, leader: Peer, old_group: Group, secret: bytes,
+                     setup_timeout: float) -> Group:
+        self.reshare_status = DKG_IN_PROGRESS
+        self._setup_receiver = SetupReceiver(
+            self.log, self._fetch_leader_identity(leader))
+        try:
+            sig_packet = pb.SignalDKGPacket(
+                node=convert.identity_to_proto(self.pair.public),
+                secret_proof=hash_secret(secret),
+                previous_group_hash=old_group.hash(),
+                metadata=convert.metadata(self.beacon_id))
+            self._signal_with_retry(leader, sig_packet, setup_timeout)
+            new_group, _ = self._setup_receiver.wait_group(setup_timeout)
+            if new_group.get_genesis_seed() != old_group.get_genesis_seed():
+                raise ValueError("reshare group does not extend our chain")
+            return self._run_reshare_session(old_group, new_group)
+        finally:
+            self._setup_receiver = None
+
+    def _run_reshare_session(self, old_group: Group,
+                             new_group: Group) -> Group:
+        nonce = new_group.hash()
+        old_nodes = self._dkg_nodes(old_group)
+        new_nodes = self._dkg_nodes(new_group)
+        union_peers = {n.identity.addr: Peer(n.identity.addr, n.identity.tls)
+                       for g in (old_group, new_group) for n in g.nodes}
+        board = EchoBroadcast(
+            self.client, self.log, self.beacon_id,
+            self.pair.public.addr, nonce,
+            dealers=old_nodes, holders=new_nodes,
+            peers=list(union_peers.values()), scheme=new_group.scheme)
+        self._install_board(board)
+        try:
+            if self._setup_manager is not None:    # we are the leader
+                self.clock.wait_until(
+                    self.clock.now() + self.cfg.dkg_kickoff_grace,
+                    threading.Event())
+            gen = D.DistKeyGenerator(D.DkgConfig(
+                scheme=new_group.scheme, longterm=self.pair.key, nonce=nonce,
+                new_nodes=new_nodes, threshold=new_group.threshold,
+                old_nodes=old_nodes, old_threshold=old_group.threshold,
+                share=self.share.private if self.share else None,
+                public_coeffs=(list(old_group.public_key.coefficients)
+                               if old_group.public_key else None)))
+            out = run_dkg(gen, board, self.clock, self.cfg.dkg_timeout,
+                          self.log)
+        finally:
+            self._clear_board(board)
+        new_group = self._adopt_reshare_output(old_group, new_group, out)
+        return new_group
+
+    def _adopt_reshare_output(self, old_group: Group, new_group: Group,
+                              out: D.DkgOutput) -> Group:
+        from ..key.keys import DistPublic
+        new_group.public_key = DistPublic(list(out.commits))
+        new_share = (Share(scheme=new_group.scheme, private=out.share,
+                           commits=list(out.commits))
+                     if out.share is not None else None)
+        self.fs.save_group(new_group)
+        if new_share is not None:
+            self.fs.save_share(new_share)
+        self.reshare_status = DKG_DONE
+        if self.handler is not None:
+            # running member: swap shares at transition time
+            # (node.go:257-281); leavers get (group, None) and stop.
+            self.handler.transition(new_group, new_share)
+            self.group = new_group if new_share is not None else self.group
+            self.share = new_share or self.share
+        elif new_share is not None:
+            # newcomer: adopt state now, start syncing, join at transition
+            self.group = new_group
+            self.share = new_share
+            self._start_at_transition(new_group)
+        return new_group
+
+    def _start_at_transition(self, group: Group) -> None:
+        never = threading.Event()
+
+        def waiter():
+            self.clock.wait_until(group.transition_time, never)
+            self.start_beacon(catchup=True)
+        threading.Thread(target=waiter, daemon=True,
+                         name=f"transition-{self.beacon_id}").start()
+
+    # -- setup-plane ingress (routed by daemon services) ---------------------
+
+    def signal_dkg_participant(self, req: pb.SignalDKGPacket) -> None:
+        if self._setup_manager is None:
+            raise ValueError("no DKG setup in progress")
+        scheme = self._setup_manager.scheme
+        ident = convert.proto_to_identity(req.node, scheme)
+        self._setup_manager.received_key(ident, req.secret_proof)
+
+    def push_dkg_info(self, req: pb.DKGInfoPacket) -> None:
+        if self._setup_receiver is None:
+            raise ValueError("not waiting for DKG info")
+        group = convert.proto_to_group(req.new_group)
+        self._setup_receiver.push_dkg_info(group, req.signature,
+                                           req.dkg_timeout)
+
+    def broadcast_dkg(self, req: pb.DKGPacket) -> None:
+        with self._lock:
+            if self._board is None:
+                # board not up yet (setup still finishing): park the packet;
+                # _install_board replays it.  Bad/stale packets are dropped
+                # by the board's signature + session checks at replay time.
+                if len(self._pending_dkg) < 4096:
+                    self._pending_dkg.append(req)
+                return
+            board = self._board
+        board.received(req)
+
+    def _install_board(self, board: EchoBroadcast) -> None:
+        with self._lock:
+            self._board = board
+            pending, self._pending_dkg = self._pending_dkg, []
+        for req in pending:
+            try:
+                board.received(req)
+            except Exception:
+                pass
+
+    def _clear_board(self, board: EchoBroadcast) -> None:
+        with self._lock:
+            self._board = None
+            self._pending_dkg = []
+        board.stop()

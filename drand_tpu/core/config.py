@@ -1,0 +1,54 @@
+"""Daemon configuration (reference: core/config.go:51-297 functional
+options; defaults core/constants.go:13-50)."""
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..beacon.clock import Clock, RealClock
+
+DEFAULT_CONFIG_FOLDER_NAME = ".drand"
+DEFAULT_DB_FOLDER = "db"
+DEFAULT_BEACON_PERIOD = 60          # seconds (constants.go:26)
+DEFAULT_CONTROL_PORT = 8888         # constants.go:29
+DEFAULT_DKG_TIMEOUT = 10            # seconds, FastSync (constants.go:35)
+DEFAULT_GENESIS_OFFSET = 1          # seconds (constants.go:44)
+DEFAULT_RESHARING_OFFSET = 30       # seconds (constants.go:50)
+MAX_WAIT_PREPARE_DKG = 24 * 7 * 2 * 3600   # constants.go:39
+CALL_MAX_TIMEOUT = 10               # seconds, setup calls (constants.go:52)
+
+
+def default_config_folder() -> str:
+    return os.path.join(os.path.expanduser("~"), DEFAULT_CONFIG_FOLDER_NAME)
+
+
+@dataclass
+class Config:
+    """All daemon knobs, with the reference's defaults.  Python keyword
+    arguments replace Go's functional options (config.go:130-297)."""
+
+    folder: str = field(default_factory=default_config_folder)
+    db_engine: str = "sqlite"           # sqlite | memdb (bolt-equivalents)
+    memdb_size: int = 2000
+    private_listen: str = "127.0.0.1:0"  # node-to-node gRPC bind
+    public_listen: str = ""              # REST edge bind ("" = disabled)
+    control_port: int = DEFAULT_CONTROL_PORT
+    metrics_port: int = 0                # 0 = disabled
+    tls_cert: Optional[str] = None
+    tls_key: Optional[str] = None
+    trusted_certs: tuple = ()
+    insecure: bool = True                # no TLS (test networks)
+    dkg_timeout: int = DEFAULT_DKG_TIMEOUT
+    dkg_kickoff_grace: float = 5.0       # leader wait before phase 1
+    reshare_offset: int = DEFAULT_RESHARING_OFFSET
+    clock: Clock = field(default_factory=RealClock)
+    # called with (beacon_id, group) after a successful DKG — the daemon
+    # uses it to register public HTTP handlers (drand_daemon.go:61-71)
+    dkg_callback: Optional[Callable] = None
+    use_device_verifier: bool = True     # TPU-batched aggregation verify
+    sync_chunk: int = 512
+
+    def db_folder(self, beacon_id: str) -> str:
+        from ..common import DEFAULT_BEACON_ID
+        return os.path.join(self.folder, "multibeacon",
+                            beacon_id or DEFAULT_BEACON_ID, DEFAULT_DB_FOLDER)

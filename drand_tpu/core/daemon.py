@@ -1,0 +1,457 @@
+"""DrandDaemon: the multi-beacon host process (core/drand_daemon.go:20-333).
+
+One process serves many independent chains: every RPC carries a beaconID
+(or chain hash) in its metadata and is routed to the matching BeaconProcess
+(drand_daemon_helper.go:77).  The daemon owns the private gRPC gateway, the
+localhost control listener, the optional public REST edge and metrics
+server, and the on-disk multibeacon layout.
+"""
+
+import os
+import threading
+from typing import Dict, Optional
+
+import grpc
+
+from ..chain.errors import ErrNoBeaconSaved, ErrNoBeaconStored
+from ..common import DEFAULT_BEACON_ID, MULTI_BEACON_FOLDER
+from ..crypto.schemes import (get_scheme_by_id_with_default, list_schemes)
+from ..key.group import Group
+from ..key.keys import new_keypair
+from ..key.store import FileStore, list_beacon_ids
+from ..log import Logger
+from ..metrics import MetricsServer, drand_node_db
+from ..net import ControlListener, Peer, PrivateGateway
+from ..net import convert
+from ..protos import drand_pb2 as pb
+from .beacon_process import BeaconProcess
+from .config import Config
+
+
+class DrandDaemon:
+    def __init__(self, cfg: Config, log: Optional[Logger] = None):
+        self.cfg = cfg
+        self.log = (log or Logger()).named("daemon")
+        self.processes: Dict[str, BeaconProcess] = {}
+        self.chain_hashes: Dict[str, str] = {}      # hex hash -> beacon_id
+        self._lock = threading.Lock()
+        self._exit = threading.Event()
+
+        self.gateway = PrivateGateway(
+            cfg.private_listen,
+            protocol_impl=ProtocolService(self),
+            public_impl=PublicService(self),
+            tls_cert=None if cfg.insecure else cfg.tls_cert,
+            tls_key=None if cfg.insecure else cfg.tls_key)
+        self.control = ControlListener(ControlService(self),
+                                       port=cfg.control_port)
+        self.metrics: Optional[MetricsServer] = None
+        if cfg.metrics_port:
+            self.metrics = MetricsServer(cfg.metrics_port,
+                                         peer_metrics=self._peer_metrics)
+        self.http_server = None          # attached by the REST edge (L8)
+        drand_node_db.labels(cfg.db_engine).set(1)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self.gateway.start_all()
+        self.control.start()
+        if self.metrics is not None:
+            self.metrics.start()
+        self.log.info("daemon started",
+                      private=self.gateway.listen_addr,
+                      control=self.control.port)
+
+    def stop(self) -> None:
+        for bp in list(self.processes.values()):
+            bp.stop()
+        self.gateway.stop_all()
+        self.control.stop()
+        if self.metrics is not None:
+            self.metrics.stop()
+        if self.http_server is not None:
+            self.http_server.stop()
+        self._exit.set()
+
+    def wait_exit(self, timeout: Optional[float] = None) -> bool:
+        return self._exit.wait(timeout)
+
+    # -- beacon process management (drand_daemon.go:161-298) -----------------
+
+    def instantiate_beacon_process(self, beacon_id: str) -> BeaconProcess:
+        beacon_id = beacon_id or DEFAULT_BEACON_ID
+        fs = FileStore(self.cfg.folder, beacon_id)
+        try:
+            pair = fs.load_keypair()
+        except FileNotFoundError:
+            pair = new_keypair(self.gateway.listen_addr,
+                               get_scheme_by_id_with_default(""),
+                               tls=not self.cfg.insecure)
+            fs.save_keypair(pair)
+        if not pair.public.valid_signature():
+            raise ValueError(
+                "keypair possession signature invalid "
+                "(run `drand util self-sign`)")
+        bp = BeaconProcess(self.cfg, fs, beacon_id, pair,
+                           self.gateway.client, self.log)
+        with self._lock:
+            self.processes[beacon_id] = bp
+        return bp
+
+    def load_beacons_from_disk(self) -> None:
+        """Resume every beacon found under <folder>/multibeacon
+        (drand_daemon.go:254-298)."""
+        for beacon_id in list_beacon_ids(self.cfg.folder):
+            bp = self.instantiate_beacon_process(beacon_id)
+            if bp.load():
+                bp.start_beacon(catchup=True)
+                self._register_chain_hash(bp)
+                self.log.info("beacon loaded from disk", beacon_id=beacon_id)
+            else:
+                self.log.info("beacon has no share yet; waiting for DKG",
+                              beacon_id=beacon_id)
+
+    def _register_chain_hash(self, bp: BeaconProcess) -> None:
+        info = bp.chain_info()
+        if info is not None:
+            with self._lock:
+                self.chain_hashes[info.hash_string()] = bp.beacon_id
+
+    # -- routing (drand_daemon_helper.go:77) ---------------------------------
+
+    def bp_for(self, metadata) -> BeaconProcess:
+        bid = metadata.beaconID if metadata is not None else ""
+        if not bid and metadata is not None and metadata.chain_hash:
+            bid = self.chain_hashes.get(metadata.chain_hash.hex(), "")
+        bid = bid or DEFAULT_BEACON_ID
+        with self._lock:
+            bp = self.processes.get(bid)
+        if bp is None:
+            raise KeyError(f"no beacon process for id {bid!r}")
+        return bp
+
+    def _peer_metrics(self, addr: str) -> bytes:
+        """Federation: fetch a group member's metrics over gRPC
+        (metrics.go:408-492) — here via its Home endpoint's metrics twin."""
+        from ..metrics import scrape
+        return scrape("group")
+
+
+def _route(daemon: DrandDaemon, context, metadata):
+    try:
+        return daemon.bp_for(metadata)
+    except KeyError as e:
+        context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+
+
+class ProtocolService:
+    """drand.Protocol impl (core/drand_beacon_public.go + daemon routing)."""
+
+    def __init__(self, daemon: DrandDaemon):
+        self.daemon = daemon
+
+    def get_identity(self, req, context):
+        bp = _route(self.daemon, context, req.metadata)
+        ident = bp.pair.public
+        return pb.IdentityResponse(
+            address=ident.addr, key=ident.key, tls=ident.tls,
+            signature=ident.signature or b"",
+            metadata=convert.metadata(bp.beacon_id),
+            schemeName=ident.scheme.id)
+
+    def signal_dkg_participant(self, req, context):
+        bp = _route(self.daemon, context, req.metadata)
+        try:
+            bp.signal_dkg_participant(req)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.Empty()
+
+    def push_dkg_info(self, req, context):
+        bp = _route(self.daemon, context, req.metadata)
+        try:
+            bp.push_dkg_info(req)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.Empty()
+
+    def broadcast_dkg(self, req, context):
+        bp = _route(self.daemon, context, req.metadata)
+        try:
+            bp.broadcast_dkg(req)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.Empty()
+
+    def partial_beacon(self, req, context):
+        bp = _route(self.daemon, context, req.metadata)
+        try:
+            bp.process_partial(req)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.Empty()
+
+    def sync_chain(self, req, context):
+        bp = _route(self.daemon, context, req.metadata)
+        stop = threading.Event()
+        context.add_callback(stop.set)
+        for beacon in bp.serve_sync(context.peer(), req.from_round,
+                                    stop=stop):
+            yield convert.beacon_to_proto(beacon, bp.beacon_id)
+
+    def status(self, req, context):
+        bp = _route(self.daemon, context, req.metadata)
+        return _status_response(self.daemon, bp, req)
+
+
+class PublicService:
+    """drand.Public impl (core/drand_beacon_public.go:67-235)."""
+
+    def __init__(self, daemon: DrandDaemon):
+        self.daemon = daemon
+
+    def public_rand(self, req, context):
+        bp = _route(self.daemon, context, req.metadata)
+        try:
+            beacon = bp.get_beacon(req.round)
+        except (ErrNoBeaconStored, ErrNoBeaconSaved) as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return convert.beacon_to_rand(beacon, bp.beacon_id)
+
+    def public_rand_stream(self, req, context):
+        """Streams every new beacon from req.round (0 = next) on
+        (drand_beacon_public.go:122-150, via the sync stream)."""
+        bp = _route(self.daemon, context, req.metadata)
+        stop = threading.Event()
+        context.add_callback(stop.set)
+        from_round = req.round
+        if from_round == 0:
+            try:
+                from_round = bp.get_beacon(0).round + 1
+            except (ErrNoBeaconStored, ErrNoBeaconSaved):
+                from_round = 1
+        for beacon in bp.serve_sync(context.peer(), from_round, stop=stop):
+            yield convert.beacon_to_rand(beacon, bp.beacon_id)
+
+    def chain_info(self, req, context):
+        bp = _route(self.daemon, context, req.metadata)
+        info = bp.chain_info()
+        if info is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "no group/DKG yet")
+        return convert.info_to_proto(info)
+
+    def home(self, req, context):
+        return pb.HomeResponse(
+            status="drand up and running",
+            metadata=convert.metadata())
+
+
+def _status_response(daemon: DrandDaemon, bp: BeaconProcess,
+                     req) -> pb.StatusResponse:
+    """Status incl. optional connectivity probes
+    (drand_beacon_control.go:819-921)."""
+    resp = pb.StatusResponse(
+        dkg=pb.DkgStatusPart(status=bp.dkg_status),
+        reshare=pb.DkgStatusPart(status=bp.reshare_status))
+    running = bp.handler is not None and bp.handler.running
+    resp.beacon.CopyFrom(pb.BeaconStatusPart(
+        status=0 if running else 1, is_running=running,
+        is_stopped=not running, is_started=running, is_serving=running))
+    empty, last_round, length = True, 0, 0
+    if bp.handler is not None:
+        try:
+            last = bp.handler.chain.last()
+            empty, last_round = False, last.round
+            length = len(bp.handler.chain.store)
+        except ErrNoBeaconStored:
+            pass
+    resp.chain_store.CopyFrom(pb.ChainStoreStatusPart(
+        is_empty=empty, last_round=last_round, length=length))
+    for a in req.check_conn:
+        try:
+            daemon.gateway.client.home(Peer(a.address, a.tls))
+            resp.connections[a.address] = True
+        except Exception:
+            resp.connections[a.address] = False
+    return resp
+
+
+class ControlService:
+    """drand.Control impl: the localhost CLI plane
+    (core/drand_beacon_control.go)."""
+
+    def __init__(self, daemon: DrandDaemon):
+        self.daemon = daemon
+
+    def _bp(self, context, metadata, create: bool = False) -> BeaconProcess:
+        try:
+            return self.daemon.bp_for(metadata)
+        except KeyError:
+            if create:
+                bid = (metadata.beaconID or DEFAULT_BEACON_ID
+                       if metadata is not None else DEFAULT_BEACON_ID)
+                return self.daemon.instantiate_beacon_process(bid)
+            context.abort(grpc.StatusCode.NOT_FOUND, "unknown beacon id")
+
+    def ping_pong(self, req, context):
+        return pb.Pong(metadata=convert.metadata())
+
+    def status(self, req, context):
+        bp = self._bp(context, req.metadata)
+        return _status_response(self.daemon, bp, req)
+
+    def list_schemes(self, req, context):
+        return pb.ListSchemesResponse(ids=list_schemes(),
+                                      metadata=convert.metadata())
+
+    def list_beacon_ids(self, req, context):
+        with self.daemon._lock:
+            ids = sorted(self.daemon.processes)
+        return pb.ListBeaconIDsResponse(ids=ids, metadata=convert.metadata())
+
+    def init_dkg(self, req, context):
+        """Leader or follower DKG kickoff (drand_beacon_control.go:41-117).
+        Runs the whole session synchronously; the CLI blocks until the
+        group is final (matching `drand share` semantics)."""
+        bp = self._bp(context, req.metadata, create=True)
+        info = req.info
+        scheme = get_scheme_by_id_with_default(req.schemeID)
+        try:
+            if info.leader:
+                group = bp.init_dkg_leader(
+                    n_nodes=info.nodes, threshold=info.threshold,
+                    period=req.beacon_period_seconds or 60,
+                    catchup_period=req.catchup_period_seconds,
+                    secret=info.secret,
+                    setup_timeout=info.timeout_seconds or 60,
+                    scheme=scheme)
+            else:
+                group = bp.join_dkg(
+                    leader=Peer(info.leader_address), secret=info.secret,
+                    setup_timeout=info.timeout_seconds or 60)
+        except Exception as e:
+            context.abort(grpc.StatusCode.ABORTED, f"dkg failed: {e}")
+        bp.start_beacon(catchup=False)
+        self.daemon._register_chain_hash(bp)
+        return convert.group_to_proto(group, bp.beacon_id)
+
+    def init_reshare(self, req, context):
+        bp = self._bp(context, req.metadata, create=True)
+        info = req.info
+        old_group = bp.group
+        if req.old_group_path:
+            with open(req.old_group_path) as f:
+                old_group = Group.from_toml(f.read())
+        if old_group is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "no previous group for resharing")
+        try:
+            if info.leader:
+                group = bp.init_reshare_leader(
+                    old_group, n_nodes=info.nodes,
+                    threshold=info.threshold, secret=info.secret,
+                    setup_timeout=info.timeout_seconds or 60)
+            else:
+                group = bp.join_reshare(
+                    leader=Peer(info.leader_address), old_group=old_group,
+                    secret=info.secret,
+                    setup_timeout=info.timeout_seconds or 60)
+        except Exception as e:
+            context.abort(grpc.StatusCode.ABORTED, f"reshare failed: {e}")
+        self.daemon._register_chain_hash(bp)
+        return convert.group_to_proto(group, bp.beacon_id)
+
+    def public_key(self, req, context):
+        bp = self._bp(context, req.metadata)
+        return pb.PublicKeyResponse(pub_key=bp.pair.public.key,
+                                    metadata=convert.metadata(bp.beacon_id))
+
+    def private_key(self, req, context):
+        bp = self._bp(context, req.metadata)
+        return pb.PrivateKeyResponse(
+            pri_key=bp.pair.key.to_bytes(32, "big"),
+            metadata=convert.metadata(bp.beacon_id))
+
+    def chain_info(self, req, context):
+        bp = self._bp(context, req.metadata)
+        info = bp.chain_info()
+        if info is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "no chain info yet")
+        return convert.info_to_proto(info)
+
+    def group_file(self, req, context):
+        bp = self._bp(context, req.metadata)
+        if bp.group is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "no group yet")
+        return convert.group_to_proto(bp.group, bp.beacon_id)
+
+    def shutdown(self, req, context):
+        threading.Thread(target=self.daemon.stop, daemon=True).start()
+        return pb.ShutdownResponse(metadata=convert.metadata())
+
+    def load_beacon(self, req, context):
+        bp = self._bp(context, req.metadata, create=True)
+        if not bp.load():
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "beacon has no stored state")
+        bp.start_beacon(catchup=True)
+        self.daemon._register_chain_hash(bp)
+        return pb.LoadBeaconResponse(metadata=convert.metadata())
+
+    def start_follow_chain(self, req, context):
+        """Observer sync into this daemon's store with progress stream
+        (drand_beacon_control.go:1097-1227)."""
+        bp = self._bp(context, req.metadata, create=True)
+        from .follow import follow_chain
+        stop = threading.Event()
+        context.add_callback(stop.set)
+        try:
+            for current, target in follow_chain(
+                    self.daemon, bp, list(req.nodes), req.is_tls,
+                    req.up_to, req.chain_hash, stop):
+                yield pb.SyncProgress(current=current, target=target,
+                                      metadata=convert.metadata(bp.beacon_id))
+        except Exception as e:
+            context.abort(grpc.StatusCode.ABORTED, f"follow failed: {e}")
+
+    def start_check_chain(self, req, context):
+        """Validate (and optionally repair) the local chain
+        (drand_beacon_control.go:1230-1320)."""
+        bp = self._bp(context, req.metadata)
+        if bp.syncm is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "beacon not running")
+        upto = req.up_to or (bp.get_beacon(0).round)
+        progress = []
+        faulty = bp.syncm.check_past_beacons(
+            upto, progress=lambda c, t: progress.append((c, t)))
+        for c, t in progress:
+            yield pb.SyncProgress(current=c, target=t)
+        if req.nodes and faulty:
+            peers = [Peer(n, req.is_tls) for n in req.nodes]
+            bp.syncm.correct_past_beacons(bp.store, faulty, peers)
+        yield pb.SyncProgress(current=upto - len(faulty), target=upto)
+
+    def backup_database(self, req, context):
+        bp = self._bp(context, req.metadata)
+        if bp.store is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "beacon not running")
+        with open(req.output_file, "wb") as f:
+            bp.store.save_to(f)
+        return pb.BackupDBResponse(metadata=convert.metadata(bp.beacon_id))
+
+    def remote_status(self, req, context):
+        bp = self._bp(context, req.metadata)
+        out = pb.RemoteStatusResponse(metadata=convert.metadata())
+        for a in req.addresses:
+            node = pb.RemoteStatusNode(address=a.address)
+            try:
+                st = self.daemon.gateway.client.status(
+                    Peer(a.address, a.tls), bp.beacon_id)
+                node.status.CopyFrom(st)
+            except Exception:
+                pass
+            out.statuses.append(node)
+        return out

@@ -1,0 +1,50 @@
+"""Phased DKG driver: DistKeyGenerator state machine x EchoBroadcast board
+(reference: the kyber TimePhaser + dkg.Protocol loop wired in
+core/drand_beacon_control.go:333-411 and core/broadcast.go).
+
+FastSync phasing: each phase ends when every expected bundle arrived or its
+timeout elapsed — one response round suffices when nobody misbehaves.
+"""
+
+from typing import Optional
+
+from ..crypto import dkg as D
+from ..log import Logger
+
+
+def run_dkg(gen: D.DistKeyGenerator, board, clock, phase_timeout: int,
+            log: Logger) -> D.DkgOutput:
+    """Drive one node through a DKG/reshare session; returns DkgOutput.
+
+    `board` is an EchoBroadcast (or harness fake) exposing deal/response/
+    justification queues + to_network() + collect()."""
+    n_dealers = len(gen.dealers)
+    n_holders = len(gen.holders)
+
+    # Phase 1 — deals (dealers only produce; everyone collects).
+    my_deal = gen.generate_deals()
+    if my_deal is not None:
+        board.to_network(my_deal)
+    deadline = clock.now() + phase_timeout
+    deals = board.collect(board.deals, n_dealers, deadline, clock)
+    log.info("dkg: deal phase done", got=len(deals), want=n_dealers)
+
+    # Phase 2 — responses (share holders only produce; everyone collects).
+    my_resp = gen.process_deal_bundles(deals)
+    if my_resp is not None:
+        board.to_network(my_resp)
+    deadline = clock.now() + phase_timeout
+    resps = board.collect(board.responses, n_holders, deadline, clock)
+    log.info("dkg: response phase done", got=len(resps), want=n_holders)
+
+    output, my_just = gen.process_response_bundles(resps)
+    if output is not None:
+        return output
+
+    # Phase 3 — justifications (only dealers under complaint produce).
+    if my_just is not None:
+        board.to_network(my_just)
+    deadline = clock.now() + phase_timeout
+    justs = board.collect(board.justifications, n_dealers, deadline, clock)
+    log.info("dkg: justification phase done", got=len(justs))
+    return gen.process_justification_bundles(justs)

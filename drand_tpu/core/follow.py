@@ -1,0 +1,115 @@
+"""Observer-mode chain following (drand_beacon_control.go:1097-1227).
+
+`drand sync --follow` on a node that is NOT a group member: fetch the chain
+info from the given peers (pinned by chain hash), build a fresh store with
+the append/scheme decorators, and batch-verify-sync from the peers while
+streaming progress back to the control client.
+"""
+
+import threading
+from typing import Iterator, List, Tuple
+
+from ..beacon.stores import AppendStore, CallbackStore, SchemeStore
+from ..beacon.sync import SyncManager
+from ..chain.beacon import genesis_beacon
+from ..chain.errors import ErrNoBeaconStored
+from ..chain.timing import current_round
+from ..crypto.schemes import scheme_from_name
+from ..net import Peer
+
+
+class FollowFacade:
+    """The slice of ChainStore that SyncManager + SyncChainServer need,
+    without a vault/aggregator (we hold no share in observer mode)."""
+
+    def __init__(self, backend, chained: bool, genesis_seed: bytes):
+        sch = SchemeStore(backend, chained)
+        self._append = AppendStore(sch)
+        self.cbstore = CallbackStore(self._append)
+        self._backend = backend
+        try:
+            backend.last()
+        except ErrNoBeaconStored:
+            backend.put(genesis_beacon(genesis_seed))
+
+    @property
+    def store(self):
+        return self.cbstore
+
+    def last(self):
+        return self.cbstore.last()
+
+    def put(self, beacon) -> None:
+        self.cbstore.put(beacon)
+
+    def stop(self) -> None:
+        self.cbstore.stop()
+
+
+def follow_chain(daemon, bp, nodes: List[str], is_tls: bool, up_to: int,
+                 chain_hash: str, stop: threading.Event
+                 ) -> Iterator[Tuple[int, int]]:
+    """Generator of (current, target) progress pairs."""
+    peers = [Peer(n, is_tls) for n in nodes]
+    client = daemon.gateway.client
+
+    # Chain info from the first peer that answers; pin against chain_hash.
+    info = None
+    for peer in peers:
+        try:
+            from ..net import convert
+            info = convert.proto_to_info(client.chain_info(peer,
+                                                           bp.beacon_id))
+            break
+        except Exception:
+            continue
+    if info is None:
+        raise RuntimeError("no peer delivered chain info")
+    if chain_hash and info.hash_string() != chain_hash:
+        raise ValueError(f"chain hash mismatch: want {chain_hash}, "
+                         f"got {info.hash_string()}")
+
+    scheme = scheme_from_name(info.scheme)
+    store = bp._create_store()
+    facade = FollowFacade(store, scheme.chained, info.genesis_seed)
+    verifier = None
+    if not bp.cfg.use_device_verifier:
+        from ..crypto.hostverify import HostBatchVerifier
+        verifier = HostBatchVerifier(scheme, info.public_key)
+    syncm = SyncManager(
+        chain=facade, scheme=scheme, public_key_bytes=info.public_key,
+        period=info.period, clock=bp.clock,
+        fetch=lambda peer, fr: client.sync_chain(peer, fr, bp.beacon_id),
+        peers=peers, chunk=bp.cfg.sync_chunk, verifier=verifier)
+
+    target = up_to or current_round(int(bp.clock.now()), info.period,
+                                    info.genesis_time)
+    done = threading.Event()
+    err: list = []
+
+    def run():
+        try:
+            syncm.sync(target, peers)
+        except Exception as e:
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True, name="follow-sync")
+    t.start()
+    last_sent = -1
+    while not done.wait(0.2):
+        if stop.is_set():
+            syncm.stop()
+            break
+        cur = facade.last().round
+        if cur != last_sent:
+            last_sent = cur
+            yield cur, target
+    cur = facade.last().round
+    if cur != last_sent:
+        yield cur, target
+    facade.stop()
+    store.close()
+    if err:
+        raise err[0]

@@ -410,3 +410,4 @@ def recover_batch(scheme: Scheme, indices, partial_sigs) -> list:
         return [S.g2_to_bytes(pt) for pt in host_pts]
     host_pts = _affine_g1_to_host(x, y)
     return [S.g1_to_bytes(pt) for pt in host_pts]
+

@@ -128,11 +128,18 @@ class Curve:
         return (p[0], self.f.neg(p[1]))
 
     def mul(self, p, k):
-        """Scalar multiplication k*p (k any int)."""
+        """Scalar multiplication k*p (k any int; native fast path when the
+        C library is built, pure Python otherwise)."""
         if p is None or k == 0:
             return None
         if k < 0:
             return self.mul(self.neg(p), -k)
+        from . import native
+        if native.available():
+            # scalars are reduced mod r at the boundary; callers only ever
+            # multiply by exponents meaningful mod the group order
+            return (native.g1_mul if self.name == "G1"
+                    else native.g2_mul)(p, k)
         f = self.f
         acc = (f.one, f.one, f.zero)
         base = self.to_jacobian(p)
@@ -144,7 +151,11 @@ class Curve:
         return self.to_affine(acc)
 
     def msm(self, points, scalars):
-        """Naive multi-scalar mul on host (small inputs only)."""
+        """Multi-scalar mul on host (native single-call when available)."""
+        from . import native
+        if native.available() and points:
+            return (native.g1_msm if self.name == "G1"
+                    else native.g2_msm)(list(points), list(scalars))
         f = self.f
         acc = (f.one, f.one, f.zero)
         for pt, k in zip(points, scalars):
@@ -153,6 +164,12 @@ class Curve:
         return self.to_affine(acc)
 
     def in_subgroup(self, p):
+        from . import native
+        if native.available():
+            # the native mul reduces scalars mod r, so the mul-by-r probe
+            # is done natively with the full-width order
+            return (native.g1_in_subgroup if self.name == "G1"
+                    else native.g2_in_subgroup)(p)
         return self.mul(p, R) is None
 
 

@@ -1,0 +1,195 @@
+"""ctypes binding of the native BLS12-381 library (native/bls12381.cc).
+
+The native layer is the microsecond host path — the role kilc/bls12-381's
+x86-64 assembly plays under the reference (SURVEY.md §2.9).  Every wrapper
+here has the same signature and semantics as its pure-Python counterpart
+and is used opportunistically: when the shared library is absent (fresh
+checkout before `make -C native`) callers fall back to the Python tower.
+
+Points cross the boundary as raw big-endian affine coordinates (no square
+roots at the boundary); signatures stay in wire (compressed) form.
+"""
+
+import ctypes
+import os
+from typing import List, Optional, Sequence, Tuple
+
+_LIB = None
+_TRIED = False
+
+_SO_PATH = os.path.join(os.path.dirname(__file__), "..", "..", "..",
+                        "native", "libdrand_tpu_native.so")
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if not _TRIED:
+        _TRIED = True
+        path = os.environ.get("DRAND_TPU_NATIVE", os.path.abspath(_SO_PATH))
+        if os.path.exists(path) \
+                and os.environ.get("DRAND_TPU_NO_NATIVE") != "1":
+            try:
+                cand = ctypes.CDLL(path)
+                if cand.ntv_version() >= 1:
+                    _LIB = cand
+            except OSError:
+                _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+# -- point codecs (int tuples <-> raw affine bytes) --------------------------
+
+def _g1_to_aff(p) -> bytes:
+    if p is None:
+        return b"\x00" * 96
+    return p[0].to_bytes(48, "big") + p[1].to_bytes(48, "big")
+
+
+def _g1_from_aff(b: bytes):
+    if b == b"\x00" * 96:
+        return None
+    return (int.from_bytes(b[:48], "big"), int.from_bytes(b[48:], "big"))
+
+
+def _g2_to_aff(p) -> bytes:
+    if p is None:
+        return b"\x00" * 192
+    (x0, x1), (y0, y1) = p
+    return (x0.to_bytes(48, "big") + x1.to_bytes(48, "big")
+            + y0.to_bytes(48, "big") + y1.to_bytes(48, "big"))
+
+
+def _g2_from_aff(b: bytes):
+    if b == b"\x00" * 192:
+        return None
+    v = [int.from_bytes(b[i * 48:(i + 1) * 48], "big") for i in range(4)]
+    return ((v[0], v[1]), (v[2], v[3]))
+
+
+def _sk(k: int) -> bytes:
+    from .params import R
+    return (k % R).to_bytes(32, "big")
+
+
+# -- group ops ----------------------------------------------------------------
+
+def g1_mul(p, k: int):
+    out = ctypes.create_string_buffer(96)
+    if lib().ntv_g1_mul_aff(_g1_to_aff(p), _sk(k), out) != 0:
+        raise ValueError("native g1_mul failed")
+    return _g1_from_aff(out.raw)
+
+
+def g2_mul(p, k: int):
+    out = ctypes.create_string_buffer(192)
+    if lib().ntv_g2_mul_aff(_g2_to_aff(p), _sk(k), out) != 0:
+        raise ValueError("native g2_mul failed")
+    return _g2_from_aff(out.raw)
+
+
+def g1_add(a, b):
+    out = ctypes.create_string_buffer(96)
+    if lib().ntv_g1_add_aff(_g1_to_aff(a), _g1_to_aff(b), out) != 0:
+        raise ValueError("native g1_add failed")
+    return _g1_from_aff(out.raw)
+
+
+def g2_add(a, b):
+    out = ctypes.create_string_buffer(192)
+    if lib().ntv_g2_add_aff(_g2_to_aff(a), _g2_to_aff(b), out) != 0:
+        raise ValueError("native g2_add failed")
+    return _g2_from_aff(out.raw)
+
+
+def g1_msm(points: Sequence, scalars: Sequence[int]):
+    pts = b"".join(_g1_to_aff(p) for p in points)
+    sks = b"".join(_sk(k) for k in scalars)
+    out = ctypes.create_string_buffer(96)
+    if lib().ntv_g1_msm_aff(pts, sks, len(points), out) != 0:
+        raise ValueError("native g1_msm failed")
+    return _g1_from_aff(out.raw)
+
+
+def g2_msm(points: Sequence, scalars: Sequence[int]):
+    pts = b"".join(_g2_to_aff(p) for p in points)
+    sks = b"".join(_sk(k) for k in scalars)
+    out = ctypes.create_string_buffer(192)
+    if lib().ntv_g2_msm_aff(pts, sks, len(points), out) != 0:
+        raise ValueError("native g2_msm failed")
+    return _g2_from_aff(out.raw)
+
+
+# -- hash to curve / sign / verify -------------------------------------------
+
+def hash_to_g1(msg: bytes, dst: bytes):
+    out = ctypes.create_string_buffer(96)
+    if lib().ntv_hash_to_g1_aff(msg, len(msg), dst, len(dst), out) != 0:
+        raise ValueError("native hash_to_g1 failed")
+    return _g1_from_aff(out.raw)
+
+
+def hash_to_g2(msg: bytes, dst: bytes):
+    out = ctypes.create_string_buffer(192)
+    if lib().ntv_hash_to_g2_aff(msg, len(msg), dst, len(dst), out) != 0:
+        raise ValueError("native hash_to_g2 failed")
+    return _g2_from_aff(out.raw)
+
+
+def sign_g1(secret: int, msg: bytes, dst: bytes) -> bytes:
+    """Compressed G1 signature (48B wire form)."""
+    out = ctypes.create_string_buffer(48)
+    if lib().ntv_sign_g1(_sk(secret), msg, len(msg), dst, len(dst),
+                         out) != 0:
+        raise ValueError("native sign_g1 failed")
+    return out.raw
+
+
+def sign_g2(secret: int, msg: bytes, dst: bytes) -> bytes:
+    out = ctypes.create_string_buffer(96)
+    if lib().ntv_sign_g2(_sk(secret), msg, len(msg), dst, len(dst),
+                         out) != 0:
+        raise ValueError("native sign_g2 failed")
+    return out.raw
+
+
+def verify_g2sig(pub_g1_point, msg: bytes, dst: bytes, sig: bytes) -> bool:
+    """pk on G1 (point tuple), sig 96B compressed.  Signature bytes come
+    straight off the network: length MUST be checked before the FFI call —
+    the C side reads a fixed 96 bytes."""
+    if not isinstance(sig, (bytes, bytearray)) or len(sig) != 96:
+        return False
+    rc = lib().ntv_verify_g2sig_affpk(_g1_to_aff(pub_g1_point), msg,
+                                      len(msg), dst, len(dst), bytes(sig))
+    return rc == 1
+
+
+def verify_g1sig(pub_g2_point, msg: bytes, dst: bytes, sig: bytes) -> bool:
+    if not isinstance(sig, (bytes, bytearray)) or len(sig) != 48:
+        return False
+    rc = lib().ntv_verify_g1sig_affpk(_g2_to_aff(pub_g2_point), msg,
+                                      len(msg), dst, len(dst), bytes(sig))
+    return rc == 1
+
+
+def g1_validate(comp: bytes) -> bool:
+    if len(comp) != 48:
+        return False
+    return lib().ntv_g1_validate(bytes(comp)) == 0
+
+
+def g2_validate(comp: bytes) -> bool:
+    if len(comp) != 96:
+        return False
+    return lib().ntv_g2_validate(bytes(comp)) == 0
+
+
+def g1_in_subgroup(p) -> bool:
+    return lib().ntv_g1_in_subgroup_aff(_g1_to_aff(p)) == 1
+
+
+def g2_in_subgroup(p) -> bool:
+    return lib().ntv_g2_in_subgroup_aff(_g2_to_aff(p)) == 1

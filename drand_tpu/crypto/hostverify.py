@@ -1,0 +1,22 @@
+"""Host-CPU beacon verification (jax-free).
+
+`HostBatchVerifier` is a drop-in for `batch.BatchBeaconVerifier.verify_batch`
+on paths where a device round-trip (and the jax import itself) is wrong:
+tiny batches, latency-sensitive client gets, daemons running with
+`use_device_verifier=False`.  Uses the native C library when built."""
+
+import numpy as np
+
+from .schemes import Scheme
+
+
+class HostBatchVerifier:
+    def __init__(self, scheme: Scheme, public_key_bytes: bytes):
+        self.scheme = scheme
+        self.pub_point = scheme.key_group.from_bytes(public_key_bytes)
+
+    def verify_batch(self, rounds, sigs, prev_sigs=None) -> np.ndarray:
+        prev_sigs = prev_sigs or [None] * len(rounds)
+        out = [self.scheme.verify_beacon(self.pub_point, r, p, s)
+               for r, s, p in zip(rounds, sigs, prev_sigs)]
+        return np.array(out, dtype=bool)

@@ -33,6 +33,20 @@ UNCHAINED_SCHEME_ID = "pedersen-bls-unchained"
 SHORT_SIG_SCHEME_ID = "bls-unchained-on-g1"
 
 
+def _h2c_g1(msg, dst):
+    from .host import native
+    if native.available():
+        return native.hash_to_g1(msg, dst)
+    return H2C.hash_to_curve_g1(msg, dst)
+
+
+def _h2c_g2(msg, dst):
+    from .host import native
+    if native.available():
+        return native.hash_to_g2(msg, dst)
+    return H2C.hash_to_curve_g2(msg, dst)
+
+
 class GroupG1:
     """kyber.Group-equivalent handle for G1."""
     name = "bls12-381.G1"
@@ -40,7 +54,7 @@ class GroupG1:
     curve = C.G1
     to_bytes = staticmethod(S.g1_to_bytes)
     from_bytes = staticmethod(S.g1_from_bytes)
-    hash_to_curve = staticmethod(H2C.hash_to_curve_g1)
+    hash_to_curve = staticmethod(_h2c_g1)
 
 
 class GroupG2:
@@ -49,7 +63,7 @@ class GroupG2:
     curve = C.G2
     to_bytes = staticmethod(S.g2_to_bytes)
     from_bytes = staticmethod(S.g2_from_bytes)
-    hash_to_curve = staticmethod(H2C.hash_to_curve_g2)
+    hash_to_curve = staticmethod(_h2c_g2)
 
 
 @dataclass(frozen=True)
@@ -72,18 +86,29 @@ class Scheme:
             h.update(round_.to_bytes(8, "big"))
         return h.digest()
 
-    # -- host sign/verify ---------------------------------------------------
+    # -- host sign/verify (native C fast path, pure-Python fallback) --------
     def sign(self, secret: int, msg: bytes) -> bytes:
+        from .host import native
+        if native.available():
+            return (native.sign_g2 if self.sig_group is GroupG2
+                    else native.sign_g1)(secret, msg, self.dst)
         hp = self.sig_group.hash_to_curve(msg, self.dst)
         return self.sig_group.to_bytes(self.sig_group.curve.mul(hp, secret))
 
     def verify(self, pub_point, msg: bytes, sig: bytes) -> bool:
         """Verify one signature on the host (latency path)."""
+        if pub_point is None:
+            return False
+        from .host import native
+        if native.available():
+            if self.sig_group is GroupG2:
+                return native.verify_g2sig(pub_point, msg, self.dst, sig)
+            return native.verify_g1sig(pub_point, msg, self.dst, sig)
         try:
             sp = self.sig_group.from_bytes(sig)
         except (ValueError, AssertionError):
             return False
-        if sp is None or pub_point is None:
+        if sp is None:
             return False
         hp = self.sig_group.hash_to_curve(msg, self.dst)
         if self.sig_group is GroupG2:

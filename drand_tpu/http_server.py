@@ -1,0 +1,228 @@
+"""REST edge: the public HTTP API (reference: http/server.go:44-605).
+
+Routes (chi-router parity, server.go:87-98):
+    /{chainHash}/public/{round}      /{chainHash}/public/latest
+    /{chainHash}/info                /chains       /health
+plus default-chain aliases without the hash prefix.
+
+`/public/{round}` long-polls when the next round is requested: waiters are
+parked and released the moment the beacon is stored (server.go:164-241,
+getRand :279-343).  Responses carry `Expires` headers keyed to the round
+schedule so CDNs cache correctly.
+"""
+
+import json
+import threading
+import time
+from email.utils import formatdate
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from .chain.beacon import Beacon
+from .chain.errors import ErrNoBeaconSaved, ErrNoBeaconStored
+from .chain.timing import time_of_round
+from .log import Logger
+from .metrics import api_call_counter, http_latency
+
+LONG_POLL_TIMEOUT = 60.0
+
+
+def _beacon_json(b: Beacon) -> bytes:
+    obj = {"round": b.round, "randomness": b.randomness().hex(),
+           "signature": b.signature.hex()}
+    if b.previous_sig:
+        obj["previous_signature"] = b.previous_sig.hex()
+    return json.dumps(obj, separators=(",", ":")).encode()
+
+
+class _BeaconHandler:
+    """Per-chain state: latest round + parked long-poll waiters."""
+
+    def __init__(self, bp):
+        self.bp = bp
+        self.latest_round = 0
+        self.pending: List[Tuple[int, threading.Event, list]] = []
+        self.lock = threading.Lock()
+        self._registered = False
+        self.ensure_callback()
+
+    def ensure_callback(self) -> None:
+        """Register the waiter-release callback once the beacon engine is
+        up (it may start only after a later DKG) (server.go:164-241)."""
+        if not self._registered and self.bp.handler is not None:
+            self.bp.handler.chain.cbstore.add_callback(
+                "http-longpoll", self._on_beacon)
+            self._registered = True
+            # seed the head so next-round requests park instead of 404ing
+            # (the reference's watch loop does the equivalent initial Get)
+            try:
+                head = self.bp.get_beacon(0).round
+            except (ErrNoBeaconStored, ErrNoBeaconSaved):
+                head = 0
+            with self.lock:
+                self.latest_round = max(self.latest_round, head)
+
+    def _on_beacon(self, b: Beacon) -> None:
+        with self.lock:
+            self.latest_round = max(self.latest_round, b.round)
+            still = []
+            for round_, ev, slot in self.pending:
+                if round_ <= b.round:
+                    slot.append(b if round_ == b.round else None)
+                    ev.set()
+                else:
+                    still.append((round_, ev, slot))
+            self.pending = still
+
+    def get(self, round_: int, info) -> Optional[Beacon]:
+        try:
+            return self.bp.get_beacon(round_)
+        except (ErrNoBeaconStored, ErrNoBeaconSaved):
+            pass
+        if round_ == 0:
+            return None
+        with self.lock:
+            block = self.latest_round != 0 \
+                and round_ == self.latest_round + 1
+            if block:
+                ev = threading.Event()
+                slot: list = []
+                self.pending.append((round_, ev, slot))
+        if block:
+            if ev.wait(LONG_POLL_TIMEOUT) and slot and slot[0] is not None:
+                return slot[0]
+            try:
+                return self.bp.get_beacon(round_)
+            except (ErrNoBeaconStored, ErrNoBeaconSaved):
+                return None
+        # never serve futures (getRand server.go:328-332)
+        return None
+
+
+class RestServer:
+    """The daemon's public REST face.  `daemon` may host many chains; every
+    chain is addressable by hash, the default one also without it."""
+
+    def __init__(self, daemon, listen: str = "127.0.0.1:0"):
+        self.daemon = daemon
+        self.log = daemon.log.named("http")
+        host, _, port = listen.rpartition(":")
+        self._handlers: Dict[str, _BeaconHandler] = {}
+        self._hlock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                t0 = time.perf_counter()
+                try:
+                    code, body, headers = outer._route(self.path)
+                except Exception as e:
+                    code, body, headers = 500, str(e).encode(), {}
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+                http_latency.labels(self.path.split("/")[-1] or "root") \
+                    .observe(time.perf_counter() - t0)
+
+        self.httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
+                                         Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- routing (server.go:87-98) ------------------------------------------
+
+    def _bp_for_hash(self, chain_hash: str):
+        bid = self.daemon.chain_hashes.get(chain_hash)
+        if bid is None:
+            raise KeyError(f"unknown chain {chain_hash}")
+        return self.daemon.processes[bid]
+
+    def _bh(self, bp) -> _BeaconHandler:
+        with self._hlock:
+            bh = self._handlers.get(bp.beacon_id)
+            if bh is None:
+                bh = self._handlers[bp.beacon_id] = _BeaconHandler(bp)
+            bh.ensure_callback()
+            return bh
+
+    def _route(self, path: str):
+        parts = [p for p in path.split("/") if p]
+        if parts == ["health"]:
+            return self._health()
+        if parts == ["chains"]:
+            return 200, json.dumps(
+                sorted(self.daemon.chain_hashes)).encode(), {}
+        # default-chain alias vs /{chainHash}/... prefix
+        if parts and len(parts[0]) == 64:
+            try:
+                bp = self._bp_for_hash(parts[0])
+            except KeyError:
+                return 404, b'{"error":"unknown chain"}', {}
+            parts = parts[1:]
+        else:
+            bp = self.daemon.processes.get("default")
+            if bp is None:
+                return 404, b'{"error":"no default chain"}', {}
+        info = bp.chain_info()
+        if info is None:
+            return 503, b'{"error":"no group yet"}', {}
+
+        if parts == ["info"]:
+            api_call_counter.labels("info").inc()
+            return 200, info.to_json(), {}
+        if len(parts) == 2 and parts[0] == "public":
+            api_call_counter.labels("public").inc()
+            round_ = 0 if parts[1] == "latest" else int(parts[1])
+            beacon = self._bh(bp).get(round_, info)
+            if beacon is None:
+                return 404, b'{"error":"round not available"}', {}
+            return 200, _beacon_json(beacon), self._cache_headers(
+                info, beacon.round, latest=(round_ == 0))
+        return 404, b'{"error":"no such route"}', {}
+
+    def _health(self):
+        """200 when the default chain's head is current (server.go health)."""
+        bp = self.daemon.processes.get("default")
+        status, head, expected = 503, 0, 0
+        if bp is not None and bp.handler is not None:
+            info = bp.chain_info()
+            try:
+                head = bp.get_beacon(0).round
+            except (ErrNoBeaconStored, ErrNoBeaconSaved):
+                head = 0
+            from .chain.timing import current_round
+            expected = current_round(int(time.time()), info.period,
+                                     info.genesis_time)
+            if head >= expected - 1:
+                status = 200
+        body = json.dumps({"status": status == 200, "current": head,
+                           "expected": expected}).encode()
+        return status, body, {}
+
+    def _cache_headers(self, info, round_: int, latest: bool) -> dict:
+        """CDN `Expires` at the next round boundary (server.go headers)."""
+        if latest:
+            nxt = time_of_round(info.period, info.genesis_time, round_ + 1)
+            return {"Expires": formatdate(nxt, usegmt=True),
+                    "Cache-Control": f"public, max-age={info.period}"}
+        return {"Cache-Control": "public, max-age=604800, immutable"}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="rest-edge")
+        self._thread.start()
+        self.log.info("REST edge serving", port=self.port)
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

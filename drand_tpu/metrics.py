@@ -1,0 +1,209 @@
+"""Metrics + observability (reference: metrics/metrics.go, 535 LoC).
+
+Prometheus series matching the reference's names so existing dashboards
+work unchanged: `beacon_discrepancy_latency` (ms between the expected round
+time and storage, metrics.go:83-88 / chain/beacon/store.go:156-163),
+`last_beacon_round`, `group_size`, `group_threshold`, `dkg_state` /
+`reshare_state` (+ timestamps), `drand_node_db`, `error_sending_partial`.
+
+The metrics HTTP server also exposes pprof-equivalent profiling and the
+cross-node federation route `/peer/<addr>/metrics` that proxies a group
+member's metrics through the gRPC connection we already hold
+(metrics.go:408-492) — operators scrape the whole group via one node.
+
+`ThresholdMonitor` (metrics/threshold_monitor.go:12-105): counts distinct
+peers with failed partial sends in a sliding one-minute window and
+escalates log severity when failures cross threshold/2 and threshold.
+"""
+
+import threading
+from typing import Callable, Dict, Optional
+
+from prometheus_client import (CollectorRegistry, Counter, Gauge, Histogram,
+                               generate_latest)
+
+from .log import Logger
+
+# Four registries, per the reference split (metrics.go:45-51).
+PRIVATE = CollectorRegistry()
+HTTP = CollectorRegistry()
+GROUP = CollectorRegistry()
+CLIENT = CollectorRegistry()
+
+beacon_discrepancy_latency = Gauge(
+    "beacon_discrepancy_latency",
+    "Difference between the expected round time and the storage time (ms)",
+    ["beacon_id"], registry=GROUP)
+last_beacon_round = Gauge(
+    "last_beacon_round", "Last locally stored beacon round",
+    ["beacon_id"], registry=GROUP)
+group_size = Gauge(
+    "group_size", "Number of nodes in the group", ["beacon_id"],
+    registry=GROUP)
+group_threshold = Gauge(
+    "group_threshold", "Threshold of the group", ["beacon_id"],
+    registry=GROUP)
+dkg_state = Gauge(
+    "dkg_state", "DKG state (0 not started .. 4 done)", ["beacon_id"],
+    registry=GROUP)
+dkg_state_timestamp = Gauge(
+    "dkg_state_timestamp", "When the DKG state last changed", ["beacon_id"],
+    registry=GROUP)
+reshare_state = Gauge(
+    "reshare_state", "Reshare state", ["beacon_id"], registry=GROUP)
+reshare_state_timestamp = Gauge(
+    "reshare_state_timestamp", "When the reshare state last changed",
+    ["beacon_id"], registry=GROUP)
+drand_node_db = Gauge(
+    "drand_node_db", "Storage engine in use", ["db"], registry=PRIVATE)
+error_sending_partial = Counter(
+    "error_sending_partial", "Failed partial beacon sends",
+    ["beacon_id", "address"], registry=GROUP)
+api_call_counter = Counter(
+    "api_call_counter", "Public API calls", ["api_method"], registry=HTTP)
+http_latency = Histogram(
+    "http_response_latency_seconds", "REST edge latency", ["route"],
+    registry=HTTP)
+client_http_heartbeat = Counter(
+    "client_http_heartbeat", "HTTP client watch liveness", ["url"],
+    registry=CLIENT)
+# TPU-specific: the device batch-verification pipeline.
+batch_verify_rounds = Counter(
+    "tpu_batch_verify_rounds_total", "Beacon rounds verified on device",
+    ["scheme"], registry=PRIVATE)
+batch_verify_seconds = Histogram(
+    "tpu_batch_verify_seconds", "Device batch-verify wall time",
+    ["scheme"], registry=PRIVATE)
+
+
+def scrape(which: str = "group") -> bytes:
+    reg = {"private": PRIVATE, "http": HTTP, "group": GROUP,
+           "client": CLIENT}[which]
+    return generate_latest(reg)
+
+
+def scrape_all() -> bytes:
+    return b"".join(generate_latest(r)
+                    for r in (PRIVATE, HTTP, GROUP, CLIENT))
+
+
+class ThresholdMonitor:
+    """Escalating alerts when partial-send failures approach the threshold
+    (metrics/threshold_monitor.go:12-105)."""
+
+    def __init__(self, beacon_id: str, log: Logger, threshold: int,
+                 period: float = 60.0):
+        self.beacon_id = beacon_id
+        self.log = log
+        self.threshold = threshold
+        self.period = period
+        self._failed: Dict[str, bool] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name=f"thr-mon-{self.beacon_id}")
+            self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period):
+            with self._lock:
+                failing = sorted(self._failed)
+                self._failed = {}
+                thr = self.threshold
+            if len(failing) >= thr:
+                self.log.error("failed connections crossed threshold in the "
+                               "last minute", threshold=thr,
+                               failures=len(failing), nodes=",".join(failing))
+            elif len(failing) >= thr // 2:
+                self.log.warn("failed connections crossed half threshold in "
+                              "the last minute", threshold=thr,
+                              failures=len(failing), nodes=",".join(failing))
+
+    def report_failure(self, addr: str) -> None:
+        error_sending_partial.labels(self.beacon_id, addr).inc()
+        with self._lock:
+            self._failed[addr] = True
+
+    def update_threshold(self, new_threshold: int) -> None:
+        with self._lock:
+            self.threshold = new_threshold
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+
+class MetricsServer:
+    """Plain-HTTP metrics endpoint with profiling and peer federation
+    (metrics.go:365-399).
+
+    Routes: `/metrics` (all registries), `/metrics/<registry>`,
+    `/debug/gc` (manual GC trigger, metrics.go:390-393), `/debug/pprof`
+    (thread stack dump — Python's nearest pprof analogue), and
+    `/peer/<addr>/metrics` when a peer-handler is installed."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 peer_metrics: Optional[Callable[[str], bytes]] = None):
+        import http.server
+
+        self.peer_metrics = peer_metrics
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                try:
+                    body, ctype = outer._route(self.path)
+                except KeyError:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def _route(self, path: str):
+        text = "text/plain; version=0.0.4"
+        if path == "/metrics":
+            return scrape_all(), text
+        if path.startswith("/metrics/"):
+            return scrape(path.split("/", 2)[2]), text
+        if path == "/debug/gc":
+            import gc
+            gc.collect()
+            return b"GC run\n", "text/plain"
+        if path == "/debug/pprof":
+            import faulthandler
+            import io
+            buf = io.StringIO()
+            faulthandler.dump_traceback(file=buf)
+            return buf.getvalue().encode(), "text/plain"
+        if path.startswith("/peer/") and path.endswith("/metrics") \
+                and self.peer_metrics is not None:
+            addr = path[len("/peer/"):-len("/metrics")]
+            return self.peer_metrics(addr), text
+        raise KeyError(path)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="metrics-http")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

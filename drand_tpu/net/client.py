@@ -42,6 +42,26 @@ class CertManager:
         return grpc.ssl_channel_credentials(root_certificates=root)
 
 
+class _BeaconStream:
+    """Iterator over a SyncChain gRPC call that keeps `cancel()` reachable
+    (a bare generator would hide the call object in its frame)."""
+
+    def __init__(self, call):
+        self._call = call
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Beacon:
+        return convert.proto_to_beacon(next(self._call))
+
+    def cancel(self) -> None:
+        try:
+            self._call.cancel()
+        except Exception:
+            pass
+
+
 class ProtocolClient:
     """Dial-side of the Protocol + Public services, one channel per peer."""
 
@@ -104,13 +124,14 @@ class ProtocolClient:
                                             timeout=timeout or self.timeout)
 
     def sync_chain(self, peer: Peer, from_round: int,
-                   beacon_id: str = "") -> Iterator[Beacon]:
+                   beacon_id: str = "") -> "_BeaconStream":
         """Server-stream of BeaconPackets starting at from_round
-        (client_grpc.go:211-248)."""
+        (client_grpc.go:211-248).  The returned iterator forwards
+        `cancel()` to the underlying gRPC call so sync watchdogs can tear
+        down a black-holed stream."""
         req = pb.SyncRequest(from_round=from_round,
                              metadata=convert.metadata(beacon_id))
-        for packet in self._protocol(peer).sync_chain(req):
-            yield convert.proto_to_beacon(packet)
+        return _BeaconStream(self._protocol(peer).sync_chain(req))
 
     def status(self, peer: Peer, beacon_id: str = "",
                check_conn: Sequence[Peer] = ()) -> pb.StatusResponse:

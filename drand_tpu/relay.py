@@ -1,0 +1,348 @@
+"""Relays: re-serve a drand chain from any client stack.
+
+Reference surface:
+  * HTTP relay (cmd/relay/main.go:1-184): standalone REST frontend over a
+    client.Client — same routes as the daemon's edge, but backed by remote
+    sources.
+  * Gossip relay (lp2p/relaynode.go:34-179): watches a source and
+    republishes every round over a one-to-many transport with full BLS
+    validation before relaying (lp2p/client/validator.go:18-68).  libp2p
+    isn't available in this environment, so the fan-out transport is the
+    gRPC Public service (`PublicRandStream`) — consumers use the ordinary
+    GrpcTransport client against the relay.
+  * S3 relay (cmd/relay-s3/main.go:43-199): uploads every round as a
+    public JSON object + a `latest` pointer.  The object-store interface is
+    pluggable: a local-directory backend ships here (and is what tests
+    exercise); an S3 backend slots in where boto3 exists.
+"""
+
+import json
+import os
+import threading
+import time
+from typing import Iterator, Optional
+
+from .chain.beacon import Beacon
+from .chain.errors import ErrNoBeaconStored
+from .client.interface import Client, Result
+from .client.verify import verify_beacon_with_info
+from .log import Logger
+
+
+# ---------------------------------------------------------------------------
+# Validating watch: the gossip validator semantic (validator.go:18-68)
+# ---------------------------------------------------------------------------
+
+class ValidatingWatch:
+    """Wraps a client's watch: drops future rounds, duplicates, and
+    anything that fails full BLS verification — the relay never
+    republishes junk."""
+
+    def __init__(self, client: Client, log: Logger):
+        self.client = client
+        self.log = log
+        self.info = client.info()
+        self._seen_max = 0
+
+    def watch(self, stop: Optional[threading.Event] = None
+              ) -> Iterator[Result]:
+        from .chain.timing import current_round
+        for res in self.client.watch(stop):
+            now_round = current_round(int(time.time()), self.info.period,
+                                      self.info.genesis_time)
+            if res.round > now_round + 1:
+                self.log.warn("dropping future round", round=res.round)
+                continue
+            if res.round <= self._seen_max:
+                continue
+            if not verify_beacon_with_info(self.info, res.beacon()):
+                self.log.warn("dropping invalid beacon", round=res.round)
+                continue
+            self._seen_max = res.round
+            yield res
+
+
+# ---------------------------------------------------------------------------
+# gRPC fan-out relay (the gossipsub-equivalent distribution node)
+# ---------------------------------------------------------------------------
+
+class GrpcRelayNode:
+    """Watches a source client and re-serves the chain over the Public
+    gRPC service with live streaming fan-out (relaynode.go:34-101
+    semantics on the gRPC transport)."""
+
+    def __init__(self, client: Client, listen: str = "127.0.0.1:0",
+                 log: Optional[Logger] = None, buffer: int = 256):
+        from .net import Listener, services
+
+        self.log = (log or Logger()).named("relay")
+        self.client = client
+        self.info = client.info()
+        self.valid = ValidatingWatch(client, self.log)
+        self._cache = {}                 # round -> Result (bounded)
+        self._buffer = buffer
+        self._latest = 0
+        self._lock = threading.Lock()
+        self._new = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self.listener = Listener(listen, [(services.PUBLIC, _RelayPublic(self))])
+        host = listen.rsplit(":", 1)[0]
+        self.address = f"{host}:{self.listener.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self.listener.start()
+        self._thread = threading.Thread(target=self._pump, daemon=True,
+                                        name="relay-pump")
+        self._thread.start()
+        self.log.info("gRPC relay serving", addr=self.address)
+
+    def _pump(self) -> None:
+        while not self._stop.is_set():
+            try:
+                for res in self.valid.watch(self._stop):
+                    with self._lock:
+                        self._cache[res.round] = res
+                        self._latest = max(self._latest, res.round)
+                        while len(self._cache) > self._buffer:
+                            del self._cache[min(self._cache)]
+                        self._new.notify_all()
+                    if self._stop.is_set():
+                        return
+            except Exception as e:
+                self.log.warn("relay watch failed; retrying", err=str(e))
+            self._stop.wait(1.0)
+
+    def get(self, round_: int) -> Result:
+        with self._lock:
+            if round_ == 0 and self._latest:
+                return self._cache[self._latest]
+            if round_ in self._cache:
+                return self._cache[round_]
+        return self.client.get(round_)
+
+    def wait_next(self, after: int, timeout: float = 1.0) -> Optional[Result]:
+        with self._lock:
+            if self._latest > after:
+                return self._cache[self._latest]
+            self._new.wait(timeout)
+            if self._latest > after:
+                return self._cache[self._latest]
+            return None
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.listener.stop()
+        self.client.close()
+
+
+class _RelayPublic:
+    """drand.Public impl backed by the relay cache/source."""
+
+    def __init__(self, node: GrpcRelayNode):
+        self.node = node
+
+    def _rand(self, res: Result):
+        from .net import convert
+        return convert.beacon_to_rand(res.beacon(),
+                                      self.node.info.beacon_id)
+
+    def public_rand(self, req, context):
+        import grpc
+        try:
+            return self._rand(self.node.get(req.round))
+        except Exception as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+
+    def public_rand_stream(self, req, context):
+        stop = threading.Event()
+        context.add_callback(stop.set)
+        sent = req.round - 1 if req.round else self.node._latest - 1
+        while not stop.is_set() and not self.node._stop.is_set():
+            res = self.node.wait_next(sent, timeout=0.5)
+            if res is not None and res.round > sent:
+                sent = res.round
+                yield self._rand(res)
+
+    def chain_info(self, req, context):
+        from .net import convert
+        return convert.info_to_proto(self.node.info)
+
+    def home(self, req, context):
+        from .protos import drand_pb2 as pb
+        return pb.HomeResponse(status="drand relay up")
+
+
+# ---------------------------------------------------------------------------
+# Object-store relay (the S3 relay shape)
+# ---------------------------------------------------------------------------
+
+class ObjectStore:
+    """Minimal put-object interface (cmd/relay-s3's S3 usage)."""
+
+    def put(self, key: str, data: bytes, content_type: str) -> None:
+        raise NotImplementedError
+
+
+class DirObjectStore(ObjectStore):
+    """Local-directory backend (tests, or any FUSE/rclone-mounted bucket)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def put(self, key: str, data: bytes, content_type: str) -> None:
+        path = os.path.join(self.root, key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path + ".tmp", "wb") as f:
+            f.write(data)
+        os.replace(path + ".tmp", path)
+
+
+class S3ObjectStore(ObjectStore):
+    """AWS S3 backend; requires boto3 (absent here — constructor raises,
+    matching the gated-dependency rule)."""
+
+    def __init__(self, bucket: str, region: str = "us-east-1"):
+        try:
+            import boto3  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "S3ObjectStore requires boto3, which is not available in "
+                "this environment; use DirObjectStore or add boto3") from e
+        import boto3
+        self.bucket = bucket
+        self.s3 = boto3.client("s3", region_name=region)
+
+    def put(self, key: str, data: bytes, content_type: str) -> None:
+        self.s3.put_object(Bucket=self.bucket, Key=key, Body=data,
+                           ACL="public-read", ContentType=content_type)
+
+
+class ObjectStoreRelay:
+    """Uploads every verified round as `<chain-hash>/public/<round>` JSON
+    plus a `latest` pointer (cmd/relay-s3/main.go:43-199)."""
+
+    def __init__(self, client: Client, store: ObjectStore,
+                 log: Optional[Logger] = None):
+        self.client = client
+        self.store = store
+        self.log = (log or Logger()).named("s3-relay")
+        self.info = client.info()
+        self.prefix = self.info.hash().hex()
+        self.valid = ValidatingWatch(client, self.log)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _obj(self, res: Result) -> bytes:
+        obj = {"round": res.round, "randomness": res.randomness.hex(),
+               "signature": res.signature.hex()}
+        if res.previous_signature:
+            obj["previous_signature"] = res.previous_signature.hex()
+        return json.dumps(obj, separators=(",", ":")).encode()
+
+    def upload(self, res: Result) -> None:
+        data = self._obj(res)
+        self.store.put(f"{self.prefix}/public/{res.round}", data,
+                       "application/json")
+        self.store.put(f"{self.prefix}/public/latest", data,
+                       "application/json")
+
+    def sync(self, from_round: int, to_round: int) -> int:
+        """Backfill rounds [from, to] (the `sync` subcommand)."""
+        n = 0
+        for r in range(from_round, to_round + 1):
+            res = self.client.get(r)
+            if verify_beacon_with_info(self.info, res.beacon()):
+                self.upload(res)
+                n += 1
+        return n
+
+    def start(self) -> None:
+        self.store.put(f"{self.prefix}/info", self.info.to_json(),
+                       "application/json")
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    for res in self.valid.watch(self._stop):
+                        self.upload(res)
+                        if self._stop.is_set():
+                            return
+                except Exception as e:
+                    self.log.warn("relay watch failed; retrying", err=str(e))
+                self._stop.wait(1.0)
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="s3-relay")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+# ---------------------------------------------------------------------------
+# HTTP relay (cmd/relay): REST frontend over a client stack
+# ---------------------------------------------------------------------------
+
+class HttpRelay:
+    """Serves /info /public/{round}|latest /health from a client stack."""
+
+    def __init__(self, client: Client, listen: str = "127.0.0.1:0",
+                 log: Optional[Logger] = None):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.client = client
+        self.info = client.info()
+        self.log = (log or Logger()).named("http-relay")
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                try:
+                    code, body = outer._route(self.path)
+                except Exception as e:
+                    code, body = 500, json.dumps({"error": str(e)}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+        host, _, port = listen.rpartition(":")
+        self.httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
+                                         Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def _route(self, path: str):
+        parts = [p for p in path.split("/") if p]
+        if parts and len(parts[0]) == 64:
+            if parts[0] != self.info.hash().hex():
+                return 404, b'{"error":"unknown chain"}'
+            parts = parts[1:]
+        if parts == ["info"]:
+            return 200, self.info.to_json()
+        if parts == ["health"]:
+            return 200, b'{"status":true}'
+        if len(parts) == 2 and parts[0] == "public":
+            round_ = 0 if parts[1] == "latest" else int(parts[1])
+            res = self.client.get(round_)
+            obj = {"round": res.round, "randomness": res.randomness.hex(),
+                   "signature": res.signature.hex()}
+            if res.previous_signature:
+                obj["previous_signature"] = res.previous_signature.hex()
+            return 200, json.dumps(obj, separators=(",", ":")).encode()
+        return 404, b'{"error":"no such route"}'
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="http-relay")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.client.close()

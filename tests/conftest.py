@@ -25,4 +25,8 @@ if os.environ.get("PALLAS_AXON_POOL_IPS"):
     from jax.extend.backend import clear_backends
 
     jax.config.update("jax_platforms", "cpu")
+    # jax was imported at interpreter start (sitecustomize) — its env
+    # snapshot predates the setdefaults above, so set the cache directly.
+    jax.config.update("jax_compilation_cache_dir", "/tmp/drand_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
     clear_backends()

@@ -1,0 +1,263 @@
+"""End-to-end daemon tests over real gRPC on localhost.
+
+Covers VERDICT item 9's done criterion ("real processes on localhost
+exchange partials and serve PublicRand") and the networked DKG + reshare
+orchestration (core/drand_beacon_control.go paths) that the fake-clock unit
+tests can't reach.
+
+Host-path crypto is deliberately used (use_device_verifier=False): a pure
+CPU pairing is ~0.6 s here, which the 4 s period absorbs; the TPU verifier
+is exercised by tests/test_batch.py and bench.py.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from drand_tpu.core.config import Config
+from drand_tpu.core.daemon import DrandDaemon
+from drand_tpu.net import ControlClient, Peer, ProtocolClient
+from drand_tpu.net import convert
+from drand_tpu.protos import drand_pb2 as pb
+
+SECRET = b"e2e-secret"
+
+
+def _mk_daemon(tmp_path, i, **kw):
+    cfg = Config(folder=str(tmp_path / f"n{i}"), control_port=0,
+                 private_listen="127.0.0.1:0", dkg_timeout=2,
+                 dkg_kickoff_grace=0.8, use_device_verifier=False,
+                 db_engine="memdb", reshare_offset=4, **kw)
+    d = DrandDaemon(cfg)
+    d.start()
+    return d
+
+
+def _run_dkg(daemons, n, thr, period=4, beacon_id="default"):
+    leader_addr = daemons[0].gateway.listen_addr
+    results = [None] * len(daemons)
+    errors = []
+
+    def leader():
+        cc = ControlClient(daemons[0].control.port)
+        req = pb.InitDKGPacket(
+            info=pb.SetupInfo(leader=True, nodes=n, threshold=thr,
+                              timeout_seconds=30, secret=SECRET),
+            beacon_period_seconds=period,
+            metadata=convert.metadata(beacon_id))
+        try:
+            results[0] = cc.stub.init_dkg(req, timeout=120)
+        except Exception as e:
+            errors.append(e)
+
+    def follower(i):
+        time.sleep(0.5)
+        cc = ControlClient(daemons[i].control.port)
+        req = pb.InitDKGPacket(
+            info=pb.SetupInfo(leader=False, leader_address=leader_addr,
+                              timeout_seconds=30, secret=SECRET),
+            metadata=convert.metadata(beacon_id))
+        try:
+            results[i] = cc.stub.init_dkg(req, timeout=120)
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=leader)] + [
+        threading.Thread(target=follower, args=(i,))
+        for i in range(1, len(daemons))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=150)
+    assert not errors, errors
+    assert all(r is not None for r in results)
+    groups = [convert.proto_to_group(r) for r in results]
+    assert len({g.hash() for g in groups}) == 1, "group divergence"
+    return groups[0]
+
+
+def _wait_round(client, addr, round_, timeout=90, beacon_id="default"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            r = client.public_rand(Peer(addr), 0, beacon_id)
+            if r.round >= round_:
+                return r
+        except Exception:
+            pass
+        time.sleep(0.5)
+    raise AssertionError(f"round {round_} not reached on {addr}")
+
+
+@pytest.fixture()
+def trio(tmp_path):
+    daemons = [_mk_daemon(tmp_path, i) for i in range(3)]
+    yield daemons
+    for d in daemons:
+        d.stop()
+
+
+def test_dkg_beacons_and_sync(trio):
+    """3-node networked DKG -> identical chains -> status/chain-info RPCs."""
+    group = _run_dkg(trio, n=3, thr=2)
+    assert group.threshold == 2 and len(group) == 3
+    assert group.public_key is not None
+
+    pc = ProtocolClient()
+    _wait_round(pc, trio[0].gateway.listen_addr, 2)
+
+    # the same round must carry the identical signature on every node
+    sigs = set()
+    for d in trio:
+        r = _wait_round(pc, d.gateway.listen_addr, 2)
+        got = pc.public_rand(Peer(d.gateway.listen_addr), 2)
+        sigs.add(got.signature)
+        assert got.randomness  # SHA256(sig) served
+    assert len(sigs) == 1
+
+    # chain info is consistent and hash-pinned
+    infos = {pc.chain_info(Peer(d.gateway.listen_addr)).hash
+             for d in trio}
+    assert len(infos) == 1
+
+    # status RPC reports a running beacon with a non-empty store
+    st = pc.status(Peer(trio[0].gateway.listen_addr))
+    assert st.beacon.is_running and not st.chain_store.is_empty
+
+    # connectivity probes (drand_beacon_control.go:819-921)
+    st = pc.status(Peer(trio[0].gateway.listen_addr),
+                   check_conn=[Peer(trio[1].gateway.listen_addr),
+                               Peer("127.0.0.1:1")])
+    conns = dict(st.connections)
+    assert conns[trio[1].gateway.listen_addr] is True
+    assert conns["127.0.0.1:1"] is False
+
+
+def test_sync_chain_stream(trio):
+    """SyncChain serves a verified replay stream (protocol plane)."""
+    _run_dkg(trio, n=3, thr=2)
+    pc = ProtocolClient()
+    addr = trio[0].gateway.listen_addr
+    _wait_round(pc, addr, 3)
+    got = []
+    for b in pc.sync_chain(Peer(addr), 1):
+        got.append(b.round)
+        if len(got) >= 3:
+            break
+    assert got == [1, 2, 3]
+
+
+@pytest.mark.slow
+def test_cli_two_real_processes(tmp_path):
+    """Two OS processes: a daemon started via the CLI and CLI clients
+    pinging/stopping it (cmd/drand-cli surface)."""
+    folder = tmp_path / "proc0"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "drand_tpu.cli", "start",
+         "--folder", str(folder), "--control", "0",
+         "--private-listen", "127.0.0.1:0", "--db", "memdb", "--no-tpu"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd="/root/repo", env=env)
+    try:
+        # scrape the control port from the banner line
+        line = ""
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if "control=" in line:
+                break
+        assert "control=" in line, f"daemon never came up: {line!r}"
+        control_port = int(line.rsplit("control=", 1)[1].strip())
+
+        out = subprocess.run(
+            [sys.executable, "-m", "drand_tpu.cli", "util", "ping",
+             "--control", str(control_port)],
+            capture_output=True, text=True, cwd="/root/repo", env=env,
+            timeout=30)
+        assert out.returncode == 0 and "pong" in out.stdout
+
+        out = subprocess.run(
+            [sys.executable, "-m", "drand_tpu.cli", "util", "list-schemes",
+             "--control", str(control_port)],
+            capture_output=True, text=True, cwd="/root/repo", env=env,
+            timeout=30)
+        assert "pedersen-bls-chained" in out.stdout
+
+        out = subprocess.run(
+            [sys.executable, "-m", "drand_tpu.cli", "stop",
+             "--control", str(control_port)],
+            capture_output=True, text=True, cwd="/root/repo", env=env,
+            timeout=30)
+        assert out.returncode == 0
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+@pytest.mark.slow
+def test_reshare_add_node(tmp_path):
+    """3-node network reshares to 4 nodes (one newcomer); the chain keeps
+    its genesis seed + public key and continues past the transition
+    (drand_beacon_control.go:425-529, node.go:257-281)."""
+    daemons = [_mk_daemon(tmp_path, i) for i in range(4)]
+    try:
+        old_group = _run_dkg(daemons[:3], n=3, thr=2)
+        pc = ProtocolClient()
+        _wait_round(pc, daemons[0].gateway.listen_addr, 1)
+
+        # leader writes the old group file for the newcomer (--from path)
+        old_path = tmp_path / "old_group.toml"
+        old_path.write_text(old_group.to_toml())
+
+        leader_addr = daemons[0].gateway.listen_addr
+        results = [None] * 4
+        errors = []
+
+        def reshare(i, leader):
+            cc = ControlClient(daemons[i].control.port)
+            info = pb.SetupInfo(
+                leader=leader, leader_address="" if leader else leader_addr,
+                nodes=4, threshold=3, timeout_seconds=40, secret=SECRET)
+            req = pb.InitResharePacket(
+                info=info,
+                old_group_path=str(old_path) if i == 3 else "",
+                metadata=convert.metadata("default"))
+            try:
+                if not leader:
+                    time.sleep(0.5)
+                results[i] = cc.stub.init_reshare(req, timeout=150)
+            except Exception as e:
+                errors.append((i, e))
+
+        threads = [threading.Thread(target=reshare, args=(i, i == 0))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not errors, errors
+        new_groups = [convert.proto_to_group(r) for r in results]
+        assert len({g.hash() for g in new_groups}) == 1
+        new_group = new_groups[0]
+        assert len(new_group) == 4 and new_group.threshold == 3
+        # chain identity preserved
+        assert new_group.get_genesis_seed() == old_group.get_genesis_seed()
+        assert new_group.public_key.key() == old_group.public_key.key()
+
+        # beacons continue past the transition; newcomer serves the chain
+        transition_round = (new_group.transition_time
+                            - new_group.genesis_time) // new_group.period + 1
+        target = transition_round + 1
+        r = _wait_round(pc, daemons[0].gateway.listen_addr, target,
+                        timeout=120)
+        assert r.round >= target
+        _wait_round(pc, daemons[3].gateway.listen_addr, target, timeout=120)
+    finally:
+        for d in daemons:
+            d.stop()

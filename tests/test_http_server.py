@@ -1,0 +1,130 @@
+"""REST edge (drand_tpu/http_server.py): routes, long-poll, health."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from drand_tpu.chain.errors import ErrNoBeaconSaved, ErrNoBeaconStored
+from drand_tpu.http_server import RestServer
+from drand_tpu.log import Logger
+
+from harness import BeaconScenario
+
+
+class _ShimDaemon:
+    """The slice of DrandDaemon that RestServer consumes."""
+
+    def __init__(self, bp):
+        self.processes = {"default": bp}
+        info = bp.chain_info()
+        self.chain_hashes = {info.hash_string(): "default"}
+        self.log = Logger("test")
+
+
+class _ShimBP:
+    def __init__(self, scenario: BeaconScenario, index: int = 0):
+        self.scenario = scenario
+        self.handler = scenario.handlers[index]
+        self.beacon_id = "default"
+
+    def chain_info(self):
+        from drand_tpu.chain.info import Info
+        g = self.scenario.group
+        return Info(public_key=self.scenario.public_key, period=g.period,
+                    genesis_time=g.genesis_time,
+                    genesis_seed=g.get_genesis_seed(),
+                    scheme=self.scenario.scheme.id, beacon_id="default")
+
+    def get_beacon(self, round_):
+        if round_ == 0:
+            return self.handler.chain.last()
+        return self.handler.chain.store.get(round_)
+
+
+@pytest.fixture(scope="module")
+def served():
+    sc = BeaconScenario(n=3, thr=2, period=30)
+    sc.start_all()
+    sc.advance_to_genesis()
+    sc.wait_round(0, 1)
+    sc.advance_round()
+    sc.wait_round(0, 2)
+    bp = _ShimBP(sc)
+    server = RestServer(_ShimDaemon(bp), "127.0.0.1:0")
+    server.start()
+    yield sc, server, bp
+    server.stop()
+    sc.stop_all()
+
+
+def _get(server, path):
+    url = f"http://127.0.0.1:{server.port}{path}"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read()), dict(r.headers)
+
+
+def test_info_and_chains(served):
+    sc, server, bp = served
+    info, _ = _get(server, "/info")
+    assert info["public_key"] == sc.public_key.hex()
+    chains, _ = _get(server, "/chains")
+    assert bp.chain_info().hash_string() in chains
+
+
+def test_public_round_and_latest(served):
+    sc, server, _ = served
+    obj, headers = _get(server, "/public/1")
+    assert obj["round"] == 1
+    assert "immutable" in headers.get("Cache-Control", "")
+    latest, headers = _get(server, "/public/latest")
+    assert latest["round"] >= 2
+    assert "Expires" in headers
+    # chain-hash prefixed alias
+    h = served[2].chain_info().hash_string()
+    obj2, _ = _get(server, f"/{h}/public/1")
+    assert obj2 == obj
+
+
+def test_future_round_404(served):
+    _, server, _ = served
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(server, "/public/999")
+    assert e.value.code == 404
+
+
+def test_long_poll_releases_on_next_round(served):
+    sc, server, bp = served
+    head = bp.get_beacon(0).round
+    result = {}
+
+    def waiter():
+        try:
+            result["obj"], _ = _get(server, f"/public/{head + 1}")
+        except Exception as e:
+            result["err"] = e
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    t.join(1.0)
+    assert t.is_alive(), "long-poll should be parked"
+    sc.advance_round()          # the network produces the next round
+    t.join(30)
+    assert not t.is_alive()
+    assert result["obj"]["round"] == head + 1
+
+
+def test_health(served):
+    sc, server, _ = served
+    # the fake clock lags real time, so health reports catching-up (503)
+    url = f"http://127.0.0.1:{server.port}/health"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            body = json.loads(r.read())
+            assert body["status"] is True
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+        body = json.loads(e.read())
+        assert body["current"] >= 1

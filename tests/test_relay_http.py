@@ -1,0 +1,104 @@
+"""L8 edge: relays + REST server routes over a mock chain."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from drand_tpu.relay import (DirObjectStore, GrpcRelayNode, HttpRelay,
+                             ObjectStoreRelay, S3ObjectStore,
+                             ValidatingWatch)
+from drand_tpu.client import GrpcTransport
+from drand_tpu.log import Logger
+
+from test_client import MockChain, MockSource
+
+
+@pytest.fixture(scope="module")
+def chain():
+    return MockChain(n=5)
+
+
+def test_validating_watch_drops_invalid(chain):
+    from drand_tpu.chain.beacon import Beacon
+    src = MockSource(chain)
+    # corrupt round 3 in a copy of the chain
+    src.chain = MockChain.__new__(MockChain)
+    src.chain.beacons = dict(chain.beacons)
+    good = chain.beacons[3]
+    src.chain.beacons[3] = Beacon(round=3,
+                                  signature=chain.beacons[4].signature,
+                                  previous_sig=good.previous_sig)
+    src.chain.info = chain.info
+    vw = ValidatingWatch(src, Logger())
+    rounds = [r.round for r in vw.watch(threading.Event())]
+    assert 3 not in rounds
+    assert set(rounds) == {1, 2, 4, 5}
+
+
+def test_object_store_relay(chain, tmp_path):
+    store = DirObjectStore(str(tmp_path / "bucket"))
+    relay = ObjectStoreRelay(MockSource(chain), store)
+    n = relay.sync(1, 5)
+    assert n == 5
+    prefix = chain.info.hash().hex()
+    obj = json.loads((tmp_path / "bucket" / prefix / "public" / "3").read_text())
+    assert obj["round"] == 3
+    assert obj["randomness"] == chain.beacons[3].randomness().hex()
+    # live upload path writes latest too
+    relay.upload(relay.client.get(5))
+    latest = json.loads(
+        (tmp_path / "bucket" / prefix / "public" / "latest").read_text())
+    assert latest["round"] == 5
+
+
+def test_s3_store_gated():
+    with pytest.raises(RuntimeError, match="boto3"):
+        S3ObjectStore("bucket")
+
+
+def test_http_relay_routes(chain):
+    relay = HttpRelay(MockSource(chain))
+    relay.start()
+    try:
+        base = f"http://127.0.0.1:{relay.port}"
+        info = json.loads(urllib.request.urlopen(f"{base}/info").read())
+        assert info["hash"] == chain.info.hash().hex()
+        obj = json.loads(urllib.request.urlopen(f"{base}/public/2").read())
+        assert obj["round"] == 2
+        latest = json.loads(
+            urllib.request.urlopen(f"{base}/public/latest").read())
+        assert latest["round"] == 5
+        # chain-hash-prefixed route
+        obj = json.loads(urllib.request.urlopen(
+            f"{base}/{chain.info.hash().hex()}/public/1").read())
+        assert obj["round"] == 1
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/{'ab'*32}/public/1")
+    finally:
+        relay.stop()
+
+
+def test_grpc_relay_fanout(chain):
+    relay = GrpcRelayNode(MockSource(chain))
+    relay.start()
+    try:
+        client = GrpcTransport(relay.address)
+        # relay serves chain info from its source
+        assert client.info().hash() == chain.info.hash()
+        # cache warms as the pump validates the watch
+        deadline = threading.Event()
+        got = None
+        for _ in range(100):
+            try:
+                got = client.get(0)
+                if got.round >= 5:
+                    break
+            except Exception:
+                pass
+            deadline.wait(0.1)
+        assert got is not None and got.round == 5
+        assert got.randomness == chain.beacons[got.round].randomness()
+    finally:
+        relay.stop()
