@@ -248,6 +248,11 @@ def cmd_util(args) -> int:
             pb.BackupDBRequest(output_file=args.out, metadata=_md(args)))
         print(f"backup written to {args.out}")
         return 0
+    if args.util == "migrate":
+        from .migration import migrate
+        did = migrate(args.folder, args.id or "default")
+        print("migrated" if did else "nothing to migrate")
+        return 0
     if args.util in ("reset", "del-beacon"):
         from .key.store import FileStore
         import shutil
@@ -339,7 +344,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p)
     p.add_argument("util", choices=[
         "check", "ping", "list-schemes", "status", "remote-status",
-        "self-sign", "backup", "reset", "del-beacon"])
+        "self-sign", "backup", "reset", "del-beacon", "migrate"])
     p.add_argument("addresses", nargs="*", default=[])
     p.add_argument("--tls", action="store_true")
     p.add_argument("--out", default="backup.db")

@@ -115,6 +115,15 @@ class VerifyingClient(Client):
         scheme, verifier = self._ensure_crypto()
         with self._lock:
             trusted = self._trusted
+        if trusted is not None and target.round <= trusted.round:
+            # historical round at or before the trust point: the chain walk
+            # doesn't apply (it only extends the frontier); verify the
+            # signature directly
+            if not verifier.verify_batch([target.round], [target.signature],
+                                         [target.previous_sig]).all():
+                raise ValueError(
+                    f"round {target.round}: invalid signature")
+            return
         start = trusted.round + 1 if trusted is not None else 1
         span: list = []
         for r in range(start, target.round):

@@ -139,6 +139,9 @@ class DrandDaemon:
 
 
 def _route(daemon: DrandDaemon, context, metadata):
+    if not convert.version_compatible(metadata):
+        context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                      "incompatible node protocol version")
     try:
         return daemon.bp_for(metadata)
     except KeyError as e:
@@ -416,18 +419,37 @@ class ControlService:
             context.abort(grpc.StatusCode.ABORTED, f"follow failed: {e}")
 
     def start_check_chain(self, req, context):
-        """Validate (and optionally repair) the local chain
-        (drand_beacon_control.go:1230-1320)."""
+        """Validate (and optionally repair) the local chain with LIVE
+        progress streaming (drand_beacon_control.go:1230-1320)."""
+        import queue as _q
         bp = self._bp(context, req.metadata)
         if bp.syncm is None:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                           "beacon not running")
         upto = req.up_to or (bp.get_beacon(0).round)
-        progress = []
-        faulty = bp.syncm.check_past_beacons(
-            upto, progress=lambda c, t: progress.append((c, t)))
-        for c, t in progress:
-            yield pb.SyncProgress(current=c, target=t)
+        events: "_q.Queue" = _q.Queue()
+        result = {}
+
+        def run():
+            try:
+                result["faulty"] = bp.syncm.check_past_beacons(
+                    upto, progress=lambda c, t: events.put((c, t)))
+            except Exception as e:
+                result["error"] = e
+            finally:
+                events.put(None)
+
+        t = threading.Thread(target=run, daemon=True, name="check-chain")
+        t.start()
+        while True:
+            ev = events.get()
+            if ev is None:
+                break
+            yield pb.SyncProgress(current=ev[0], target=ev[1])
+        if "error" in result:
+            context.abort(grpc.StatusCode.ABORTED,
+                          f"check failed: {result['error']}")
+        faulty = result.get("faulty", [])
         if req.nodes and faulty:
             peers = [Peer(n, req.is_tls) for n in req.nodes]
             bp.syncm.correct_past_beacons(bp.store, faulty, peers)

@@ -98,18 +98,22 @@ def follow_chain(daemon, bp, nodes: List[str], is_tls: bool, up_to: int,
     t = threading.Thread(target=run, daemon=True, name="follow-sync")
     t.start()
     last_sent = -1
-    while not done.wait(0.2):
-        if stop.is_set():
-            syncm.stop()
-            break
+    try:
+        while not done.wait(0.2):
+            if stop.is_set():
+                break
+            cur = facade.last().round
+            if cur != last_sent:
+                last_sent = cur
+                yield cur, target
         cur = facade.last().round
         if cur != last_sent:
-            last_sent = cur
             yield cur, target
-    cur = facade.last().round
-    if cur != last_sent:
-        yield cur, target
-    facade.stop()
-    store.close()
+    finally:
+        # the control client may disconnect mid-stream (GeneratorExit at a
+        # yield): the sync and stores must be torn down on every exit path
+        syncm.stop()
+        facade.stop()
+        store.close()
     if err:
         raise err[0]

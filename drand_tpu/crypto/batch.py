@@ -239,10 +239,36 @@ class BatchBeaconVerifier:
 
         return jax.tree.map(cut, enc)
 
+    def _shard_round_axis(self, enc, bits):
+        """Shard the round/batch axis over every visible device (the DP/SP
+        axis of this domain, SURVEY.md §5.7).  XLA inserts the collectives
+        for the cross-shard point-sum reduction; single-device runs are
+        unchanged (no-op sharding)."""
+        devs = jax.devices()
+        pad = self._leaf_len(enc)
+        if len(devs) < 2 or pad % len(devs) != 0:
+            return enc, bits
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(devs), ("round",))
+        sh = NamedSharding(mesh, P("round"))
+
+        def put(t):
+            return jax.device_put(t, sh) if t.shape[0] == pad else t
+
+        enc = jax.tree.map(put, enc)
+        bits = jax.device_put(bits, NamedSharding(mesh, P(None, "round")))
+        return enc, bits
+
+    @staticmethod
+    def _leaf_len(enc):
+        return jax.tree.leaves(enc)[0].shape[0]
+
     def _rlc_ok(self, enc, n) -> bool:
         """One RLC check over an encoded range; True iff all n rounds verify."""
         sig_jac, u0, u1 = enc
         bits = _rlc_scalars(n, _pad_len(n))
+        (sig_jac, u0, u1), bits = self._shard_round_axis((sig_jac, u0, u1),
+                                                         bits)
         pipe = _rlc_pipeline_g2sig() if self.g2sig else _rlc_pipeline_g1sig()
         sub_ok, ok = pipe(sig_jac, u0, u1, bits, self.pk_aff, self.fixed_aff)
         return bool(ok) and np.asarray(sub_ok)[:n].all()

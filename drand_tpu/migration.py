@@ -1,0 +1,32 @@
+"""Disk-layout migration: single-beacon v1 folders -> multibeacon
+(reference: core/migration/migration.go:15-119, CLI `util migrate`).
+
+v1 layout:   <folder>/{key,groups,db}
+multibeacon: <folder>/multibeacon/<beaconID>/{key,groups,db}
+"""
+
+import os
+import shutil
+
+from .common import DEFAULT_BEACON_ID, MULTI_BEACON_FOLDER
+
+_V1_DIRS = ("key", "groups", "db")
+
+
+def needs_migration(folder: str) -> bool:
+    return any(os.path.isdir(os.path.join(folder, d)) for d in _V1_DIRS) \
+        and not os.path.isdir(os.path.join(folder, MULTI_BEACON_FOLDER))
+
+
+def migrate(folder: str, beacon_id: str = DEFAULT_BEACON_ID) -> bool:
+    """Move v1 dirs under multibeacon/<id>/; returns True when work was done.
+    Safe to re-run (no-op when already migrated)."""
+    if not needs_migration(folder):
+        return False
+    target = os.path.join(folder, MULTI_BEACON_FOLDER, beacon_id)
+    os.makedirs(target, mode=0o700, exist_ok=True)
+    for d in _V1_DIRS:
+        src = os.path.join(folder, d)
+        if os.path.isdir(src):
+            shutil.move(src, os.path.join(target, d))
+    return True

@@ -20,6 +20,18 @@ def metadata(beacon_id: str = "", chain_hash: bytes = b"") -> pb.Metadata:
                        chain_hash=chain_hash)
 
 
+def version_compatible(md) -> bool:
+    """Reject peers with an incompatible protocol major version
+    (core/drand_daemon_interceptors.go:19-89; a zero version — legacy or
+    absent metadata — is accepted like the reference's prerelease rule)."""
+    if md is None or not md.HasField("node_version"):
+        return True
+    v = md.node_version
+    if v.major == 0 and v.minor == 0:
+        return True
+    return v.major == VERSION.major
+
+
 # -- beacons ----------------------------------------------------------------
 
 def beacon_to_proto(b: Beacon, beacon_id: str = "") -> pb.BeaconPacket:

@@ -318,9 +318,15 @@ def g2_in_subgroup(p):
 
 
 def g1_in_subgroup(p):
-    """P in G1 <=> phi(P) == [-x^2]P (batch)."""
+    """P in G1 <=> phi(P) == [-x^2]P (batch).
+
+    [-x^2]P is computed as -[|x|][|x|]P: two chained |x| ladders cost
+    128 doubles + 12 adds (HW(|x|) = 6) instead of the ~60 adds of a flat
+    127-bit chain."""
     lhs = g1_phi(p)
-    rhs = G1_DEV.scalar_mul_fixed(p, -(BLS_X * BLS_X))
+    xP = G1_DEV.scalar_mul_fixed(p, -BLS_X)
+    x2P = G1_DEV.scalar_mul_fixed(xP, -BLS_X)
+    rhs = G1_DEV.neg(x2P)
     return G1_DEV.eq_points(lhs, rhs)
 
 

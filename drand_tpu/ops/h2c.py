@@ -143,10 +143,13 @@ def _sswu_g1(u):
     gx1 = g(x1)
     x2 = L.mont_mul(tv1, x1)
     gx2 = g(x2)
-    sq1 = fp_is_square(gx1)
+    # One stacked sqrt scan covers both candidates; the Legendre test is
+    # free as y1^2 == gx1 (pow scans are latency-bound, so 2x width costs
+    # nothing while a second scan would double the wall time).
+    ys = fp_sqrt(jnp.stack([gx1, gx2]))
+    sq1 = L.eq(L.mont_sqr(ys[0]), gx1)
     x = L.select(sq1, x1, x2)
-    gx = L.select(sq1, gx1, gx2)
-    y = fp_sqrt(gx)
+    y = L.select(sq1, ys[0], ys[1])
     flip = fp_sgn0(u) != fp_sgn0(y)
     y = L.select(flip, L.neg_mod(y), y)
     return x, y
@@ -172,10 +175,14 @@ def _sswu_g2(u):
     gx1 = g(x1)
     x2 = T.fp2_mul(tv1, x1)
     gx2 = g(x2)
-    sq1 = fp2_is_square(gx1)
+    # stacked dual-candidate sqrt (see _sswu_g1) — drops the Legendre pow
+    gboth = jax.tree.map(lambda a, b: jnp.stack([a, b]), gx1, gx2)
+    ys = fp2_sqrt(gboth)
+    y1 = jax.tree.map(lambda t: t[0], ys)
+    y2 = jax.tree.map(lambda t: t[1], ys)
+    sq1 = T.fp2_eq(T.fp2_sqr(y1), gx1)
     x = T.fp2_select(sq1, x1, x2)
-    gx = T.fp2_select(sq1, gx1, gx2)
-    y = fp2_sqrt(gx)
+    y = T.fp2_select(sq1, y1, y2)
     flip = fp2_sgn0(u) != fp2_sgn0(y)
     y = T.fp2_select(flip, T.fp2_neg(y), y)
     return x, y
@@ -250,15 +257,25 @@ def hash_msgs_to_field_g2(msgs, dst=DST_G2):
 
 
 def hash_to_g2_jac(u0, u1):
-    """Device: two field-element batches -> G2 Jacobian point batch (in-group)."""
-    q0 = map_to_g2_jac(u0)
-    q1 = map_to_g2_jac(u1)
+    """Device: two field-element batches -> G2 Jacobian point batch (in-group).
+
+    The two SSWU maps run as ONE stacked pass: the pow scans inside are
+    latency-bound, so doubling their width is free while running the map
+    twice doubles wall time."""
+    u = jax.tree.map(lambda a, b: jnp.concatenate([a, b], 0), u0, u1)
+    q = map_to_g2_jac(u)
+    n = _leaf_shape(u0)[0]
+    q0 = jax.tree.map(lambda t: t[:n], q)
+    q1 = jax.tree.map(lambda t: t[n:], q)
     r = DC.G2_DEV.add(q0, q1)
     return DC.g2_clear_cofactor(r)
 
 
 def hash_to_g1_jac(u0, u1):
-    q0 = map_to_g1_jac(u0)
-    q1 = map_to_g1_jac(u1)
+    u = jnp.concatenate([u0, u1], 0)
+    q = map_to_g1_jac(u)
+    n = u0.shape[0]
+    q0 = jax.tree.map(lambda t: t[:n], q)
+    q1 = jax.tree.map(lambda t: t[n:], q)
     r = DC.G1_DEV.add(q0, q1)
     return DC.g1_clear_cofactor(r)

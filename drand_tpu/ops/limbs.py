@@ -58,11 +58,18 @@ def limbs_to_int(a) -> int:
 P_LIMBS = jnp.asarray(int_to_limbs(P))
 
 
+def _shift1(x):
+    """Shift limb axis up by one (carry into the next limb)."""
+    return jnp.pad(x[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+
+
 def _carry_scan(cols):
     """Normalize (..., n) uint32 columns to canonical limbs; returns (limbs, carry).
 
     Sequential over the 24-limb axis (a 24-step `lax.scan`), vectorized over
-    all leading batch axes.  Column values may be up to 2^31.
+    all leading batch axes.  Column values may be up to 2^31.  (A log-depth
+    associative-scan variant was measured: it doubles XLA compile time of
+    the big pairing programs for no runtime win — the scan body is tiny.)
     """
     x = jnp.moveaxis(cols, -1, 0)
     carry0 = jnp.zeros(cols.shape[:-1], U32)
@@ -190,9 +197,147 @@ def mont_reduce(t):
     return _cond_sub_p(limbs, carry)
 
 
+def _mont_mul_vpu(a, b):
+    """Montgomery product via the sequential fori-loop kernels (VPU path)."""
+    return mont_reduce(_conv_columns(a, b))
+
+
+# ---------------------------------------------------------------------------
+# MXU engine: the 384-bit multiply + Montgomery reduction as constant-operand
+# bf16 matmuls with exact f32 accumulation.
+#
+# Limbs are split to 48 base-2^8 digits; the schoolbook convolution
+#   c_k = sum_{i+j=k} a_i b_j
+# is an outer product (VPU, exact int32) contracted with the constant 0/1
+# anti-diagonal tensor S (2304 x 96) — a real matmul the MXU executes.  The
+# outer values (< 2^16) exceed bf16's exact range, so they are split into
+# lo/hi bytes and recombined after two exact bf16 matmuls (every partial
+# product <= 255*1, every column sum <= 48*255 < 2^24: exact in the f32
+# accumulator — verified empirically on hardware).
+#
+# Montgomery reduction uses the two-big-mul REDC:
+#     m = (T mod R) * (-p^-1 mod R) mod R ;  res = (T + m*p) / R  < 2p
+# so the whole modular multiply is three convolutions (two of them against
+# constants) plus carry normalization.  Carries use three vector
+# relax passes (columns < 2^23 -> digits <= 256) and one log-depth
+# associative scan for the final binary ripple — no O(limbs) sequential
+# scan anywhere.
+# ---------------------------------------------------------------------------
+
+ND8 = 2 * NLIMB          # 48 digits of 8 bits per 384-bit element
+I32 = jnp.int32
+
+
+def _np_digits8(x: int, n: int = ND8) -> np.ndarray:
+    return np.array([(x >> (8 * i)) & 0xFF for i in range(n)], dtype=np.int32)
+
+
+def _build_conv_S() -> np.ndarray:
+    s = np.zeros((ND8 * ND8, 2 * ND8), dtype=np.float32)
+    for i in range(ND8):
+        for j in range(ND8):
+            s[i * ND8 + j, i + j] = 1.0
+    return s
+
+
+_CONV_S = jnp.asarray(_build_conv_S(), dtype=jnp.bfloat16)
+# -p^-1 mod 2^384 and p, as 8-bit digit vectors
+_NP8 = jnp.asarray(_np_digits8((-pow(P, -1, 1 << 384)) % (1 << 384)))
+_P8 = jnp.asarray(_np_digits8(P))
+
+
+def _split8(a24):
+    """(..., 24) uint32 16-bit limbs -> (..., 48) int32 8-bit digits."""
+    a = a24.astype(I32)
+    lo = a & 0xFF
+    hi = (a >> 8) & 0xFF
+    return jnp.stack([lo, hi], axis=-1).reshape(a.shape[:-1] + (ND8,))
+
+
+def _pack16(d48):
+    """(..., 48) digits (< 256) -> (..., 24) uint32 16-bit limbs."""
+    d = d48.reshape(d48.shape[:-1] + (NLIMB, 2))
+    return (d[..., 0] + (d[..., 1] << 8)).astype(U32)
+
+
+def _conv8(a8, b8):
+    """Digit convolution -> (..., 96) int32 columns (each < 2^22)."""
+    shape = jnp.broadcast_shapes(a8.shape[:-1], b8.shape[:-1])
+    a8 = jnp.broadcast_to(a8, shape + (ND8,))
+    b8 = jnp.broadcast_to(b8, shape + (ND8,))
+    outer = (a8[..., :, None] * b8[..., None, :]).reshape(shape + (ND8 * ND8,))
+    lo = (outer & 0xFF).astype(jnp.bfloat16)
+    hi = (outer >> 8).astype(jnp.bfloat16)
+    dims = (((lo.ndim - 1,), (0,)), ((), ()))
+    clo = jax.lax.dot_general(lo, _CONV_S, dims,
+                              preferred_element_type=jnp.float32)
+    chi = jax.lax.dot_general(hi, _CONV_S, dims,
+                              preferred_element_type=jnp.float32)
+    return clo.astype(I32) + (chi.astype(I32) << 8)
+
+
+def _carry_digits(cols):
+    """Exact base-2^8 digits of sum(cols_k * 2^8k); cols int32 < 2^23.
+
+    Three vector relax passes bound every value by 256, then one log-depth
+    associative scan resolves the remaining binary ripple."""
+    def relax(c):
+        d = c & 0xFF
+        cy = c >> 8
+        return d + _shift1(cy)
+
+    c = relax(relax(relax(cols)))            # values <= 256
+    g = (c >= 256)
+    p_ = (c == 255)
+
+    def op(l, r):
+        gl, pl = l
+        gr, pr = r
+        return (gr | (pr & gl), pr & pl)
+
+    G, _ = jax.lax.associative_scan(op, (g, p_), axis=-1)
+    # carry INTO position i is the aggregated generate of the prefix [0, i)
+    carry_in = jnp.pad(G[..., :-1], [(0, 0)] * (G.ndim - 1) + [(1, 0)])
+    return (c + carry_in.astype(I32)) & 0xFF
+
+
+def _mont_mul_mxu(a, b):
+    a8 = _split8(a)
+    b8 = _split8(b)
+    t_cols = _conv8(a8, b8)                       # T = a*b (columns)
+    t_lo = _carry_digits(t_cols[..., :ND8])       # T mod R as digits
+    m_cols = _conv8(t_lo, _NP8)
+    m8 = _carry_digits(m_cols[..., :ND8])         # m = T*N' mod R
+    u_cols = _conv8(m8, _P8)                      # m*p
+    s_digits = _carry_digits(t_cols + u_cols)     # T + m*p (low 48 digits = 0)
+    res = _pack16(s_digits[..., ND8:])            # (T + m*p) / R  < 2p
+    zero_carry = jnp.zeros(res.shape[:-1], U32)
+    return _cond_sub_p(res, zero_carry)
+
+
+import os as _os
+
+_ENGINE = _os.environ.get("DRAND_TPU_LIMB_ENGINE", "auto")
+
+
+def _use_mxu() -> bool:
+    """Engine selection at trace time.
+
+    The MXU engine wins the isolated-mul microbenchmark at large widths
+    (2.8 G muls/s vs 2.4 on a v5e) but XLA's compile time for the big
+    pairing programs regresses badly with it (matmuls inside deep scan
+    bodies), so it stays opt-in (DRAND_TPU_LIMB_ENGINE=mxu) until the
+    kernels move into Pallas where the schedule is explicit."""
+    if _ENGINE == "mxu":
+        return True
+    return False
+
+
 def mont_mul(a, b):
     """Montgomery product  a·b·R^-1 mod p  on canonical limb tensors."""
-    return mont_reduce(_conv_columns(a, b))
+    if _use_mxu():
+        return _mont_mul_mxu(a, b)
+    return _mont_mul_vpu(a, b)
 
 
 def mont_sqr(a):

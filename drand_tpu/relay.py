@@ -122,13 +122,24 @@ class GrpcRelayNode:
         return self.client.get(round_)
 
     def wait_next(self, after: int, timeout: float = 1.0) -> Optional[Result]:
+        """Smallest cached round > `after` (so a stream consumer sees every
+        round the relay holds, in order); falls to the latest only when the
+        bounded cache already evicted the requested range."""
+        def pick():
+            if self._latest <= after:
+                return None
+            nxt = after + 1
+            if nxt in self._cache:
+                return self._cache[nxt]
+            later = [r for r in self._cache if r > after]
+            return self._cache[min(later)] if later else None
+
         with self._lock:
-            if self._latest > after:
-                return self._cache[self._latest]
-            self._new.wait(timeout)
-            if self._latest > after:
-                return self._cache[self._latest]
-            return None
+            got = pick()
+            if got is None:
+                self._new.wait(timeout)
+                got = pick()
+            return got
 
     def stop(self) -> None:
         self._stop.set()

@@ -212,3 +212,12 @@ def test_grpc_transport_against_daemon(chain):
         c.close()
     finally:
         lis.stop()
+
+
+def test_verifying_client_strict_historical_get(chain):
+    """After trusting a later round, strict mode must still serve earlier
+    rounds (no spurious linkage failure walking 'backwards')."""
+    vc = VerifyingClient(MockSource(chain), info=chain.info, strict=True)
+    assert vc.get(5).round == 5          # trust point at round 5
+    assert vc.get(2).round == 2          # historical get succeeds
+    assert vc.get(5).round == 5          # repeated get at the trust point

@@ -261,3 +261,53 @@ def test_reshare_add_node(tmp_path):
     finally:
         for d in daemons:
             d.stop()
+
+
+@pytest.mark.slow
+def test_follow_chain_observer(tmp_path):
+    """A non-member daemon follows the chain in observer mode via the
+    control plane (StartFollowChain, drand_beacon_control.go:1097-1227)."""
+    daemons = [_mk_daemon(tmp_path, i) for i in range(3)]
+    observer = _mk_daemon(tmp_path, 9)
+    try:
+        _run_dkg(daemons, n=3, thr=2)
+        pc = ProtocolClient()
+        _wait_round(pc, daemons[0].gateway.listen_addr, 3)
+
+        cc = ControlClient(observer.control.port)
+        req = pb.StartSyncRequest(
+            nodes=[d.gateway.listen_addr for d in daemons],
+            up_to=3, beaconID="default",
+            metadata=convert.metadata("default"))
+        progress = [p for p in cc.stub.start_follow_chain(req)]
+        assert progress, "no progress events"
+        assert progress[-1].current >= 3
+    finally:
+        observer.stop()
+        for d in daemons:
+            d.stop()
+
+
+@pytest.mark.slow
+def test_multibeacon_routing(tmp_path):
+    """One daemon trio hosts two independent chains; RPCs route by
+    beaconID (drand_daemon.go:20-41, drand_daemon_helper.go:77)."""
+    daemons = [_mk_daemon(tmp_path, i) for i in range(3)]
+    try:
+        g1 = _run_dkg(daemons, n=3, thr=2, period=3, beacon_id="alpha")
+        g2 = _run_dkg(daemons, n=3, thr=2, period=4, beacon_id="beta")
+        assert g1.hash() != g2.hash()
+        pc = ProtocolClient()
+        addr = daemons[0].gateway.listen_addr
+        _wait_round(pc, addr, 1, beacon_id="alpha")
+        _wait_round(pc, addr, 1, beacon_id="beta")
+        ia = pc.chain_info(Peer(addr), "alpha")
+        ib = pc.chain_info(Peer(addr), "beta")
+        assert ia.hash != ib.hash
+        assert ia.period == 3 and ib.period == 4
+        ra = pc.public_rand(Peer(addr), 1, "alpha")
+        rb = pc.public_rand(Peer(addr), 1, "beta")
+        assert ra.signature != rb.signature
+    finally:
+        for d in daemons:
+            d.stop()

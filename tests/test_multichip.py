@@ -40,3 +40,24 @@ def test_sharded_mont_mul_uses_all_devices(mesh):
     sh = NamedSharding(mesh, P("round"))
     a = jax.device_put(L.encode_mont([1] * 8), sh)
     assert len({s.device for s in a.addressable_shards}) == 8
+
+
+def test_sharded_verify_batch(mesh):
+    """verify_batch shards its round axis over the mesh transparently and
+    still localizes a corrupted round (the DP/SP axis of SURVEY.md §5.7)."""
+    from drand_tpu.crypto import batch, schemes
+
+    sch = schemes.scheme_from_name(schemes.SHORT_SIG_SCHEME_ID)
+    sec, pub = sch.keypair(seed=b"mc-verify")
+    ver = batch.BatchBeaconVerifier(sch, sch.public_bytes(pub))
+    n = 8
+    rounds = list(range(1, n + 1))
+    msgs = [sch.digest_beacon(r, None) for r in rounds]
+    sigs = [sch.sign(sec, m) for m in msgs]
+    ok = ver.verify_batch(rounds, sigs)
+    assert ok.all()
+    # corrupt two rounds: swapped signatures verify for the wrong messages
+    sigs[3], sigs[4] = sigs[4], sigs[3]
+    ok = ver.verify_batch(rounds, sigs)
+    assert not ok[3] and not ok[4]
+    assert ok[[0, 1, 2, 5, 6, 7]].all()
