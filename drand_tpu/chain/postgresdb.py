@@ -1,0 +1,146 @@
+"""PostgreSQL chain store (reference: chain/postgresdb/pgdb/pgdb.go,
+schema/schema.sql:1-29).
+
+Gated dependency: psycopg2 is not part of this environment's baked-in set,
+so the constructor raises a clear error when it's absent — the sqlite and
+memdb backends cover the embedded cases (SURVEY.md §2.4).  The schema
+mirrors the reference's trimmed format: `previous_sig` is not stored and is
+reconstructed from round-1 on read for chained schemes (the migration-1.04
+behavior, pgdb.go / chain/beacon.go:90-97).
+"""
+
+from typing import Optional
+
+from .beacon import Beacon
+from .errors import ErrNoBeaconSaved, ErrNoBeaconStored
+from .store import Cursor, Store
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS beacons (
+    beacon_id INT NOT NULL,
+    round     BIGINT NOT NULL,
+    signature BYTEA NOT NULL,
+    PRIMARY KEY (beacon_id, round)
+);
+CREATE TABLE IF NOT EXISTS beacon_ids (
+    id   SERIAL PRIMARY KEY,
+    name TEXT UNIQUE NOT NULL
+);
+"""
+
+
+class PostgresStore(Store):
+    def __init__(self, dsn: str, beacon_id: str = "default",
+                 require_previous: bool = False):
+        try:
+            import psycopg2  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "PostgresStore requires psycopg2, which is not available in "
+                "this environment; use the sqlite or memdb backends "
+                "(core.Config.db_engine)") from e
+        import psycopg2
+        self.conn = psycopg2.connect(dsn)
+        self.require_previous = require_previous
+        with self.conn, self.conn.cursor() as cur:
+            cur.execute(_SCHEMA)
+            cur.execute(
+                "INSERT INTO beacon_ids (name) VALUES (%s) "
+                "ON CONFLICT (name) DO NOTHING", (beacon_id,))
+            cur.execute("SELECT id FROM beacon_ids WHERE name = %s",
+                        (beacon_id,))
+            self.bid = cur.fetchone()[0]
+
+    def __len__(self) -> int:
+        with self.conn.cursor() as cur:
+            cur.execute("SELECT count(*) FROM beacons WHERE beacon_id=%s",
+                        (self.bid,))
+            return cur.fetchone()[0]
+
+    def put(self, beacon: Beacon) -> None:
+        with self.conn, self.conn.cursor() as cur:
+            cur.execute(
+                "INSERT INTO beacons (beacon_id, round, signature) "
+                "VALUES (%s, %s, %s) ON CONFLICT DO NOTHING",
+                (self.bid, beacon.round, beacon.signature))
+
+    def _fill_previous(self, round_: int, signature: bytes) -> Beacon:
+        prev = None
+        if self.require_previous and round_ > 0:
+            with self.conn.cursor() as cur:
+                cur.execute(
+                    "SELECT signature FROM beacons "
+                    "WHERE beacon_id=%s AND round=%s", (self.bid, round_ - 1))
+                row = cur.fetchone()
+                prev = bytes(row[0]) if row else None
+        return Beacon(round=round_, signature=signature, previous_sig=prev)
+
+    def last(self) -> Beacon:
+        with self.conn.cursor() as cur:
+            cur.execute(
+                "SELECT round, signature FROM beacons WHERE beacon_id=%s "
+                "ORDER BY round DESC LIMIT 1", (self.bid,))
+            row = cur.fetchone()
+        if row is None:
+            raise ErrNoBeaconStored("empty postgres store")
+        return self._fill_previous(row[0], bytes(row[1]))
+
+    def get(self, round_: int) -> Beacon:
+        with self.conn.cursor() as cur:
+            cur.execute(
+                "SELECT signature FROM beacons "
+                "WHERE beacon_id=%s AND round=%s", (self.bid, round_))
+            row = cur.fetchone()
+        if row is None:
+            raise ErrNoBeaconSaved(f"round {round_} not in postgres store")
+        return self._fill_previous(round_, bytes(row[0]))
+
+    def delete(self, round_: int) -> None:
+        with self.conn, self.conn.cursor() as cur:
+            cur.execute("DELETE FROM beacons WHERE beacon_id=%s AND round=%s",
+                        (self.bid, round_))
+
+    def close(self) -> None:
+        self.conn.close()
+
+    def cursor(self) -> Cursor:
+        return _PgCursor(self)
+
+
+class _PgCursor(Cursor):
+    def __init__(self, store: PostgresStore):
+        self.store = store
+        self._round: Optional[int] = None
+
+    def _row(self, sql, args):
+        with self.store.conn.cursor() as cur:
+            cur.execute(sql, args)
+            row = cur.fetchone()
+        if row is None:
+            return None
+        self._round = row[0]
+        return self.store._fill_previous(row[0], bytes(row[1]))
+
+    def first(self):
+        return self._row(
+            "SELECT round, signature FROM beacons WHERE beacon_id=%s "
+            "ORDER BY round ASC LIMIT 1", (self.store.bid,))
+
+    def next(self):
+        if self._round is None:
+            return self.first()
+        return self._row(
+            "SELECT round, signature FROM beacons WHERE beacon_id=%s AND "
+            "round > %s ORDER BY round ASC LIMIT 1",
+            (self.store.bid, self._round))
+
+    def seek(self, round_: int):
+        return self._row(
+            "SELECT round, signature FROM beacons WHERE beacon_id=%s AND "
+            "round >= %s ORDER BY round ASC LIMIT 1",
+            (self.store.bid, round_))
+
+    def last(self):
+        return self._row(
+            "SELECT round, signature FROM beacons WHERE beacon_id=%s "
+            "ORDER BY round DESC LIMIT 1", (self.store.bid,))

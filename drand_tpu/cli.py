@@ -68,6 +68,7 @@ def cmd_start(args) -> int:
         control_port=args.control,
         metrics_port=args.metrics or 0,
         db_engine=args.db,
+        pg_dsn=getattr(args, "pg_dsn", ""),
         insecure=not (args.tls_cert and args.tls_key),
         tls_cert=args.tls_cert, tls_key=args.tls_key,
         dkg_timeout=args.dkg_timeout,
@@ -288,7 +289,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--public-listen", default="",
                    help="REST edge bind address (empty = off)")
     p.add_argument("--metrics", type=int, default=0)
-    p.add_argument("--db", default="sqlite", choices=["sqlite", "memdb"])
+    p.add_argument("--db", default="sqlite",
+                   choices=["sqlite", "memdb", "postgres"])
+    p.add_argument("--pg-dsn", default=_env("pg_dsn", ""),
+                   help="postgres connection string (--db postgres)")
     p.add_argument("--tls-cert")
     p.add_argument("--tls-key")
     p.add_argument("--dkg-timeout", type=int, default=10)

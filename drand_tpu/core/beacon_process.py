@@ -80,10 +80,15 @@ class BeaconProcess:
     # -- store / handler plumbing -------------------------------------------
 
     def _create_store(self):
-        """bolt-equivalent embedded store or memdb
-        (drand_beacon.go:340-373)."""
+        """Storage backend switch (drand_beacon.go:340-373):
+        sqlite (bolt-equivalent embedded, default) | memdb | postgres."""
         if self.cfg.db_engine == "memdb":
             return MemDBStore(self.cfg.memdb_size)
+        if self.cfg.db_engine == "postgres":
+            from ..chain.postgresdb import PostgresStore
+            return PostgresStore(self.cfg.pg_dsn, self.beacon_id)
+        if self.cfg.db_engine != "sqlite":
+            raise ValueError(f"unknown db engine {self.cfg.db_engine!r}")
         db_dir = self.cfg.db_folder(self.beacon_id)
         os.makedirs(db_dir, mode=0o700, exist_ok=True)
         return SqliteStore(os.path.join(db_dir, "chain.db"))
@@ -259,6 +264,7 @@ class BeaconProcess:
             secret_proof=secret_proof,
             dkg_timeout=self.cfg.dkg_timeout,
             signature=sig,
+            kickoff_grace_ms=int(self.cfg.dkg_kickoff_grace * 1000),
             metadata=convert.metadata(self.beacon_id))
         errors = []
         for peer in self._peers(group):
@@ -283,8 +289,11 @@ class BeaconProcess:
                 secret_proof=hash_secret(secret),
                 metadata=convert.metadata(self.beacon_id))
             self._signal_with_retry(leader, sig_packet, setup_timeout)
-            group, _ = self._setup_receiver.wait_group(setup_timeout)
-            return self._run_dkg_session(group, leader=False)
+            group, timeout_s, grace_s = self._setup_receiver.wait_group(
+                setup_timeout)
+            return self._run_dkg_session(
+                group, leader=False, phase_timeout=timeout_s,
+                first_phase_extra=grace_s + 1.0)
         finally:
             self._setup_receiver = None
 
@@ -328,7 +337,9 @@ class BeaconProcess:
     def _dkg_nodes(self, group: Group) -> List[D.DkgNode]:
         return [D.DkgNode(n.index, n.identity.key) for n in group.nodes]
 
-    def _run_dkg_session(self, group: Group, leader: bool) -> Group:
+    def _run_dkg_session(self, group: Group, leader: bool,
+                         phase_timeout: int = 0,
+                         first_phase_extra: float = 0.0) -> Group:
         self.dkg_status = DKG_IN_PROGRESS
         nonce = group.hash()
         nodes = self._dkg_nodes(group)
@@ -343,15 +354,17 @@ class BeaconProcess:
             if leader:
                 # grace beat so followers can bring their boards up before
                 # our deals hit the wire (the pending buffer catches any
-                # stragglers anyway)
+                # stragglers anyway); followers learn this value from the
+                # DKGInfoPacket and pad their deal deadline past it
                 self.clock.wait_until(
                     self.clock.now() + self.cfg.dkg_kickoff_grace,
                     threading.Event())
             gen = D.DistKeyGenerator(D.DkgConfig(
                 scheme=group.scheme, longterm=self.pair.key, nonce=nonce,
                 new_nodes=nodes, threshold=group.threshold))
-            out = run_dkg(gen, board, self.clock, self.cfg.dkg_timeout,
-                          self.log)
+            out = run_dkg(gen, board, self.clock,
+                          phase_timeout or self.cfg.dkg_timeout, self.log,
+                          first_phase_extra=first_phase_extra)
         finally:
             self._clear_board(board)
         return self._adopt_dkg_output(group, out)
@@ -404,15 +417,19 @@ class BeaconProcess:
                 previous_group_hash=old_group.hash(),
                 metadata=convert.metadata(self.beacon_id))
             self._signal_with_retry(leader, sig_packet, setup_timeout)
-            new_group, _ = self._setup_receiver.wait_group(setup_timeout)
+            new_group, timeout_s, grace_s = self._setup_receiver.wait_group(
+                setup_timeout)
             if new_group.get_genesis_seed() != old_group.get_genesis_seed():
                 raise ValueError("reshare group does not extend our chain")
-            return self._run_reshare_session(old_group, new_group)
+            return self._run_reshare_session(
+                old_group, new_group, phase_timeout=timeout_s,
+                first_phase_extra=grace_s + 1.0)
         finally:
             self._setup_receiver = None
 
-    def _run_reshare_session(self, old_group: Group,
-                             new_group: Group) -> Group:
+    def _run_reshare_session(self, old_group: Group, new_group: Group,
+                             phase_timeout: int = 0,
+                             first_phase_extra: float = 0.0) -> Group:
         nonce = new_group.hash()
         old_nodes = self._dkg_nodes(old_group)
         new_nodes = self._dkg_nodes(new_group)
@@ -436,8 +453,9 @@ class BeaconProcess:
                 share=self.share.private if self.share else None,
                 public_coeffs=(list(old_group.public_key.coefficients)
                                if old_group.public_key else None)))
-            out = run_dkg(gen, board, self.clock, self.cfg.dkg_timeout,
-                          self.log)
+            out = run_dkg(gen, board, self.clock,
+                          phase_timeout or self.cfg.dkg_timeout, self.log,
+                          first_phase_extra=first_phase_extra)
         finally:
             self._clear_board(board)
         new_group = self._adopt_reshare_output(old_group, new_group, out)
@@ -489,8 +507,9 @@ class BeaconProcess:
         if self._setup_receiver is None:
             raise ValueError("not waiting for DKG info")
         group = convert.proto_to_group(req.new_group)
-        self._setup_receiver.push_dkg_info(group, req.signature,
-                                           req.dkg_timeout)
+        self._setup_receiver.push_dkg_info(
+            group, req.signature, req.dkg_timeout,
+            kickoff_grace_s=req.kickoff_grace_ms / 1000.0)
 
     def broadcast_dkg(self, req: pb.DKGPacket) -> None:
         with self._lock:

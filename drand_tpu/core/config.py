@@ -28,8 +28,9 @@ class Config:
     arguments replace Go's functional options (config.go:130-297)."""
 
     folder: str = field(default_factory=default_config_folder)
-    db_engine: str = "sqlite"           # sqlite | memdb (bolt-equivalents)
+    db_engine: str = "sqlite"           # sqlite | memdb | postgres
     memdb_size: int = 2000
+    pg_dsn: str = ""                    # postgres connection string
     private_listen: str = "127.0.0.1:0"  # node-to-node gRPC bind
     public_listen: str = ""              # REST edge bind ("" = disabled)
     control_port: int = DEFAULT_CONTROL_PORT
@@ -39,7 +40,7 @@ class Config:
     trusted_certs: tuple = ()
     insecure: bool = True                # no TLS (test networks)
     dkg_timeout: int = DEFAULT_DKG_TIMEOUT
-    dkg_kickoff_grace: float = 5.0       # leader wait before phase 1
+    dkg_kickoff_grace: float = 1.0       # leader wait before phase 1
     reshare_offset: int = DEFAULT_RESHARING_OFFSET
     clock: Clock = field(default_factory=RealClock)
     # called with (beacon_id, group) after a successful DKG — the daemon

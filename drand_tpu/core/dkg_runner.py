@@ -13,11 +13,18 @@ from ..log import Logger
 
 
 def run_dkg(gen: D.DistKeyGenerator, board, clock, phase_timeout: int,
-            log: Logger) -> D.DkgOutput:
+            log: Logger, first_phase_extra: float = 0.0) -> D.DkgOutput:
     """Drive one node through a DKG/reshare session; returns DkgOutput.
 
     `board` is an EchoBroadcast (or harness fake) exposing deal/response/
-    justification queues + to_network() + collect()."""
+    justification queues + to_network() + collect().
+
+    `first_phase_extra` pads the DEAL deadline only: the leader sits out a
+    kickoff grace before dealing, so followers must not let their first
+    phase expire inside that window — expiring early would finalize with a
+    smaller QUAL than the rest of the group and fork the collective key
+    (the group hash does not cover post-DKG commits, so such a fork is
+    silent until beacon verification fails)."""
     n_dealers = len(gen.dealers)
     n_holders = len(gen.holders)
 
@@ -25,7 +32,7 @@ def run_dkg(gen: D.DistKeyGenerator, board, clock, phase_timeout: int,
     my_deal = gen.generate_deals()
     if my_deal is not None:
         board.to_network(my_deal)
-    deadline = clock.now() + phase_timeout
+    deadline = clock.now() + phase_timeout + first_phase_extra
     deals = board.collect(board.deals, n_dealers, deadline, clock)
     log.info("dkg: deal phase done", got=len(deals), want=n_dealers)
 

@@ -120,17 +120,23 @@ class SetupReceiver:
         self.leader = leader_identity
         self._group: Optional[Group] = None
         self._timeout_s: int = 0
+        self._grace_s: float = 0.0
         self.done = threading.Event()
 
     def push_dkg_info(self, group: Group, signature: bytes,
-                      dkg_timeout: int) -> None:
+                      dkg_timeout: int, kickoff_grace_s: float = 0.0) -> None:
         if not verify_group_signature(group, self.leader.key, signature):
             raise ValueError("leader signature invalid on group")
         self._group = group
         self._timeout_s = dkg_timeout
+        self._grace_s = kickoff_grace_s
         self.done.set()
 
     def wait_group(self, timeout: float):
+        """Returns (group, dkg phase timeout, leader kickoff grace).  The
+        grace comes from the wire: followers must pad their deal-phase
+        deadline with the LEADER's value, not their own config — local
+        config skew would silently fork QUAL (dkg_runner.py)."""
         if not self.done.wait(timeout):
             raise TimeoutError("no DKG info received from leader")
-        return self._group, self._timeout_s
+        return self._group, self._timeout_s, self._grace_s

@@ -193,3 +193,24 @@ def g1_in_subgroup(p) -> bool:
 
 def g2_in_subgroup(p) -> bool:
     return lib().ntv_g2_in_subgroup_aff(_g2_to_aff(p)) == 1
+
+
+def g1_decompress(comp: bytes, check_subgroup: bool = True):
+    """Wire 48B -> affine point tuple; raises ValueError on invalid input."""
+    if len(comp) != 48:
+        raise ValueError("G1 compressed point must be 48 bytes")
+    out = ctypes.create_string_buffer(96)
+    if lib().ntv_g1_decompress_aff(bytes(comp), int(check_subgroup),
+                                   out) != 0:
+        raise ValueError("invalid G1 point encoding")
+    return _g1_from_aff(out.raw)
+
+
+def g2_decompress(comp: bytes, check_subgroup: bool = True):
+    if len(comp) != 96:
+        raise ValueError("G2 compressed point must be 96 bytes")
+    out = ctypes.create_string_buffer(192)
+    if lib().ntv_g2_decompress_aff(bytes(comp), int(check_subgroup),
+                                   out) != 0:
+        raise ValueError("invalid G2 point encoding")
+    return _g2_from_aff(out.raw)

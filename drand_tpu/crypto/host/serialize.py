@@ -40,6 +40,9 @@ def g1_to_bytes(p):
 
 
 def g1_from_bytes(b: bytes, check_subgroup=True):
+    from . import native
+    if native.available():
+        return native.g1_decompress(bytes(b), check_subgroup)
     assert len(b) == 48, "G1 compressed point must be 48 bytes"
     flags = b[0]
     assert flags & 0x80, "only compressed points supported"
@@ -73,6 +76,9 @@ def g2_to_bytes(p):
 
 
 def g2_from_bytes(b: bytes, check_subgroup=True):
+    from . import native
+    if native.available():
+        return native.g2_decompress(bytes(b), check_subgroup)
     assert len(b) == 96, "G2 compressed point must be 96 bytes"
     flags = b[0]
     assert flags & 0x80, "only compressed points supported"

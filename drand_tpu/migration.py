@@ -14,19 +14,28 @@ _V1_DIRS = ("key", "groups", "db")
 
 
 def needs_migration(folder: str) -> bool:
-    return any(os.path.isdir(os.path.join(folder, d)) for d in _V1_DIRS) \
-        and not os.path.isdir(os.path.join(folder, MULTI_BEACON_FOLDER))
+    """v1 dirs still present — regardless of whether a multibeacon layout
+    already exists (a daemon may have created it before the operator ran
+    migrate, or a previous run may have moved only some dirs)."""
+    return any(os.path.isdir(os.path.join(folder, d)) for d in _V1_DIRS)
 
 
 def migrate(folder: str, beacon_id: str = DEFAULT_BEACON_ID) -> bool:
     """Move v1 dirs under multibeacon/<id>/; returns True when work was done.
-    Safe to re-run (no-op when already migrated)."""
+    Safe to re-run (no-op when already migrated); refuses to clobber data
+    that already exists at the destination."""
     if not needs_migration(folder):
         return False
     target = os.path.join(folder, MULTI_BEACON_FOLDER, beacon_id)
     os.makedirs(target, mode=0o700, exist_ok=True)
     for d in _V1_DIRS:
         src = os.path.join(folder, d)
-        if os.path.isdir(src):
-            shutil.move(src, os.path.join(target, d))
+        if not os.path.isdir(src):
+            continue
+        dst = os.path.join(target, d)
+        if os.path.exists(dst):
+            raise RuntimeError(
+                f"migration target {dst} already exists; resolve the "
+                f"conflict manually (v1 data left at {src})")
+        shutil.move(src, dst)
     return True

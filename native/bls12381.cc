@@ -2069,3 +2069,25 @@ int ntv_g2_in_subgroup_aff(const uint8_t p[192]) {
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// wire-form decompression to raw affine (used by the batch verifier's host
+// packing: Python-side sqrt per signature was the hot spot)
+int ntv_g1_decompress_aff(const uint8_t comp[48], int check_subgroup,
+                          uint8_t out[96]) {
+  g1p p;
+  if (!g1_decompress(p, comp, check_subgroup)) return 1;
+  g1_to_aff(out, p);
+  return 0;
+}
+
+int ntv_g2_decompress_aff(const uint8_t comp[96], int check_subgroup,
+                          uint8_t out[192]) {
+  g2p p;
+  if (!g2_decompress(p, comp, check_subgroup)) return 1;
+  g2_to_aff(out, p);
+  return 0;
+}
+
+}  // extern "C"

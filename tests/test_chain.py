@@ -222,3 +222,16 @@ def test_sqlite_persistence(tmp_path):
     s2 = SqliteStore(path)
     assert len(s2) == 4 and s2.last().round == 3
     s2.close()
+
+
+def test_postgres_store_gated():
+    """The postgres backend is a gated dependency here (SURVEY.md §2.4):
+    constructing it without psycopg2 must fail with a clear pointer to the
+    embedded backends, not an ImportError mid-flight."""
+    import importlib.util
+    import pytest
+    if importlib.util.find_spec("psycopg2") is not None:
+        pytest.skip("psycopg2 installed; gate does not apply")
+    from drand_tpu.chain.postgresdb import PostgresStore
+    with pytest.raises(RuntimeError, match="psycopg2"):
+        PostgresStore("dbname=drand")

@@ -77,6 +77,10 @@ def _run_dkg(daemons, n, thr, period=4, beacon_id="default"):
     assert all(r is not None for r in results)
     groups = [convert.proto_to_group(r) for r in results]
     assert len({g.hash() for g in groups}) == 1, "group divergence"
+    # the group hash does NOT cover the post-DKG commits: a QUAL fork forges
+    # ahead silently unless the collective keys are compared explicitly
+    keys = {g.public_key.key() for g in groups}
+    assert len(keys) == 1, "collective key fork (QUAL divergence)"
     return groups[0]
 
 

@@ -134,3 +134,28 @@ def test_python_fallback_equivalence(monkeypatch):
     assert not native.available()
     sig_py = sch.sign(sec, msg)
     assert sig_py == sig_native
+
+
+def test_native_decompress_parity(monkeypatch):
+    """Wire decompression: the native path and the pure-Python path agree
+    on valid, invalid and infinity encodings (same inputs, both paths)."""
+    import drand_tpu.crypto.host.serialize as S
+    from drand_tpu.crypto.host import curve as C
+    pt = C.G1.mul(C.G1.gen, 424242)
+    b1 = S.g1_to_bytes(pt)
+    pt2 = C.G2.mul(C.G2.gen, 77)
+    b2 = S.g2_to_bytes(pt2)
+    inf1 = S.g1_to_bytes(None)
+    native_res = (S.g1_from_bytes(b1), S.g2_from_bytes(b2),
+                  S.g1_from_bytes(inf1))
+    with pytest.raises((ValueError, AssertionError)):
+        S.g1_from_bytes(bytes(48))
+    # disable the native hook and repeat on the SAME inputs
+    monkeypatch.setattr(native, "_LIB", None)
+    monkeypatch.setattr(native, "_TRIED", True)
+    assert not native.available()
+    py_res = (S.g1_from_bytes(b1), S.g2_from_bytes(b2),
+              S.g1_from_bytes(inf1))
+    with pytest.raises((ValueError, AssertionError)):
+        S.g1_from_bytes(bytes(48))
+    assert native_res == py_res == (pt, pt2, None)
