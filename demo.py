@@ -102,6 +102,8 @@ def run_dkg(nodes, threshold: int, period: int):
         t.start()
     for t in threads:
         t.join(timeout=200)
+    missing = [i for i, r in enumerate(results) if r is None]
+    assert not missing, f"DKG did not complete on nodes {missing}"
     group = convert.proto_to_group(results[0])
     print(f"* group created; hash {group.hash().hex()[:16]}…, "
           f"genesis in {group.genesis_time - int(time.time())}s")
@@ -155,7 +157,7 @@ def main() -> int:
                     if os.environ.get("DEMO_DEBUG"):
                         print("    info:", info.to_json().decode())
                         print("    beacon:", beacon.to_json().decode())
-                if not killed and seen == 2 and len(nodes) > args.threshold:
+                if not killed and seen >= 2 and len(nodes) > args.threshold:
                     print(f"* killing node 1 (threshold {args.threshold} of "
                           f"{args.nodes} still met)")
                     nodes[1].stop()

@@ -179,6 +179,7 @@ class Handler:
             if not self.clock.wait_until(self.clock.now() + self.catchup_period,
                                          self._stop):
                 return
+            self._maybe_transition()
             try:
                 last = self.chain.last()
             except ErrNoBeaconStored:
@@ -187,12 +188,26 @@ class Handler:
                 self.broadcast_next_partial(last)
 
     def _maybe_transition(self) -> None:
+        """Share swap at the transition ROUND boundary in chain space
+        (node.go:257-281): rounds below the transition round must be signed
+        with the OLD share even if the wall clock is already past the
+        transition time (a lagging chain first catches its old-key segment
+        up; swapping early would sign that segment with the new key and
+        stall the chain forever)."""
         with self._lock:
             pending = self._transition_group
             if pending is None:
                 return
             new_group, new_share = pending
-            if int(self.clock.now()) < new_group.transition_time:
+            transition_round = current_round(
+                new_group.transition_time, new_group.period,
+                new_group.genesis_time)
+            try:
+                next_to_sign = self.chain.last().round + 1
+            except ErrNoBeaconStored:
+                next_to_sign = 1
+            if int(self.clock.now()) < new_group.transition_time \
+                    or next_to_sign < transition_round:
                 return
             self._transition_group = None
         if new_share is None:

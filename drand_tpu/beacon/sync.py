@@ -147,7 +147,11 @@ class SyncManager:
                 if self._stop.is_set():
                     return False
                 buf.append(b)
-                if len(buf) >= self.chunk:
+                # flush on a full chunk OR once the target is covered: the
+                # serving side live-follows forever (sync_manager.go:468),
+                # so waiting for a full chunk would buffer one round per
+                # period indefinitely and never store anything
+                if len(buf) >= self.chunk or b.round >= target_round:
                     head = self._verify_and_store(head, buf)
                     buf = []
                     if head is None:

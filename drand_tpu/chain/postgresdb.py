@@ -41,6 +41,9 @@ class PostgresStore(Store):
                 "(core.Config.db_engine)") from e
         import psycopg2
         self.conn = psycopg2.connect(dsn)
+        # reads must not pin an open transaction (VACUUM blockage /
+        # idle_in_transaction timeouts on long-lived daemons)
+        self.conn.autocommit = True
         self.require_previous = require_previous
         with self.conn, self.conn.cursor() as cur:
             cur.execute(_SCHEMA)

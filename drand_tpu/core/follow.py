@@ -23,14 +23,16 @@ class FollowFacade:
     without a vault/aggregator (we hold no share in observer mode)."""
 
     def __init__(self, backend, chained: bool, genesis_seed: bytes):
-        sch = SchemeStore(backend, chained)
-        self._append = AppendStore(sch)
-        self.cbstore = CallbackStore(self._append)
-        self._backend = backend
+        # the genesis beacon must exist BEFORE the append decorator snapshots
+        # the chain head (the Handler does the same, node.go:79)
         try:
             backend.last()
         except ErrNoBeaconStored:
             backend.put(genesis_beacon(genesis_seed))
+        sch = SchemeStore(backend, chained)
+        self._append = AppendStore(sch)
+        self.cbstore = CallbackStore(self._append)
+        self._backend = backend
 
     @property
     def store(self):

@@ -239,6 +239,10 @@ class BatchBeaconVerifier:
 
         return jax.tree.map(cut, enc)
 
+    # below this batch width sharding is pure overhead: the SPMD-partitioned
+    # pairing program compiles far slower and tiny shards leave devices idle
+    SHARD_MIN_PAD = 512
+
     def _shard_round_axis(self, enc, bits):
         """Shard the round/batch axis over every visible device (the DP/SP
         axis of this domain, SURVEY.md §5.7).  XLA inserts the collectives
@@ -246,7 +250,8 @@ class BatchBeaconVerifier:
         unchanged (no-op sharding)."""
         devs = jax.devices()
         pad = self._leaf_len(enc)
-        if len(devs) < 2 or pad % len(devs) != 0:
+        if len(devs) < 2 or pad < self.SHARD_MIN_PAD \
+                or pad % len(devs) != 0:
             return enc, bits
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
         mesh = Mesh(np.array(devs), ("round",))

@@ -185,11 +185,14 @@ class MetricsServer:
             gc.collect()
             return b"GC run\n", "text/plain"
         if path == "/debug/pprof":
-            import faulthandler
-            import io
-            buf = io.StringIO()
-            faulthandler.dump_traceback(file=buf)
-            return buf.getvalue().encode(), "text/plain"
+            import sys
+            import traceback
+            frames = sys._current_frames()
+            out = []
+            for tid, frame in frames.items():
+                out.append(f"Thread {tid}:\n"
+                           + "".join(traceback.format_stack(frame)))
+            return "\n".join(out).encode(), "text/plain"
         if path.startswith("/peer/") and path.endswith("/metrics") \
                 and self.peer_metrics is not None:
             addr = path[len("/peer/"):-len("/metrics")]

@@ -28,14 +28,15 @@ def migrate(folder: str, beacon_id: str = DEFAULT_BEACON_ID) -> bool:
         return False
     target = os.path.join(folder, MULTI_BEACON_FOLDER, beacon_id)
     os.makedirs(target, mode=0o700, exist_ok=True)
-    for d in _V1_DIRS:
-        src = os.path.join(folder, d)
-        if not os.path.isdir(src):
-            continue
-        dst = os.path.join(target, d)
-        if os.path.exists(dst):
-            raise RuntimeError(
-                f"migration target {dst} already exists; resolve the "
-                f"conflict manually (v1 data left at {src})")
+    moves = [(os.path.join(folder, d), os.path.join(target, d))
+             for d in _V1_DIRS if os.path.isdir(os.path.join(folder, d))]
+    # check every destination BEFORE moving anything: failing halfway
+    # would leave a layout neither reader understands
+    conflicts = [dst for _, dst in moves if os.path.exists(dst)]
+    if conflicts:
+        raise RuntimeError(
+            f"migration targets already exist: {conflicts}; resolve the "
+            f"conflicts manually (v1 data left in place)")
+    for src, dst in moves:
         shutil.move(src, dst)
     return True

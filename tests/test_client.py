@@ -33,7 +33,8 @@ class MockChain:
                          genesis_time=genesis, genesis_seed=b"\x07" * 32,
                          scheme=scheme_id)
         self.beacons = {}
-        prev = None
+        # chained chains anchor round 1 on the genesis seed (store.go:95-101)
+        prev = self.info.genesis_seed if self.scheme.chained else None
         for r in range(1, n + 1):
             msg = self.scheme.digest_beacon(
                 r, prev if self.scheme.chained else None)

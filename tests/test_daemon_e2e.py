@@ -31,7 +31,7 @@ def _mk_daemon(tmp_path, i, **kw):
     cfg = Config(folder=str(tmp_path / f"n{i}"), control_port=0,
                  private_listen="127.0.0.1:0", dkg_timeout=2,
                  dkg_kickoff_grace=0.8, use_device_verifier=False,
-                 db_engine="memdb", reshare_offset=4, **kw)
+                 db_engine="memdb", reshare_offset=10, **kw)
     d = DrandDaemon(cfg)
     d.start()
     return d
@@ -259,9 +259,9 @@ def test_reshare_add_node(tmp_path):
                             - new_group.genesis_time) // new_group.period + 1
         target = transition_round + 1
         r = _wait_round(pc, daemons[0].gateway.listen_addr, target,
-                        timeout=120)
+                        timeout=150)
         assert r.round >= target
-        _wait_round(pc, daemons[3].gateway.listen_addr, target, timeout=120)
+        _wait_round(pc, daemons[3].gateway.listen_addr, target, timeout=150)
     finally:
         for d in daemons:
             d.stop()

@@ -50,6 +50,7 @@ def test_sharded_verify_batch(mesh):
     sch = schemes.scheme_from_name(schemes.SHORT_SIG_SCHEME_ID)
     sec, pub = sch.keypair(seed=b"mc-verify")
     ver = batch.BatchBeaconVerifier(sch, sch.public_bytes(pub))
+    ver.SHARD_MIN_PAD = 8      # force the sharded path at test width
     n = 8
     rounds = list(range(1, n + 1))
     msgs = [sch.digest_beacon(r, None) for r in rounds]

@@ -88,3 +88,21 @@ def test_correct_past_beacons_rejects_bad_peer(chain):
     assert remaining == [8]          # forged round is NOT written
     with pytest.raises(Exception):
         store.get(8)
+
+
+def test_sync_from_live_follow_stream(chain):
+    """Catch-up against a stream that never ends (the serving side
+    live-follows, sync_manager.go:468): fewer-than-chunk rounds must still
+    flush and store once the target is covered."""
+    import itertools
+    store, facade = _facade_with(chain, [])
+
+    def live_fetch(peer, from_round):
+        for r in range(from_round, N + 1):
+            yield chain.beacons[r]
+        while True:                  # live follow: stream never ends
+            yield chain.beacons[N]
+
+    syncm = _manager(chain, facade, live_fetch)
+    syncm.sync(N, ["peer0"])         # must return, not buffer forever
+    assert facade.last().round == N
