@@ -45,7 +45,7 @@ class FollowFacade:
         self.cbstore.put(beacon)
 
     def stop(self) -> None:
-        self.cbstore.stop()
+        self.cbstore.close()
 
 
 def follow_chain(daemon, bp, nodes: List[str], is_tls: bool, up_to: int,
