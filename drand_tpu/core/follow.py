@@ -113,9 +113,9 @@ def follow_chain(daemon, bp, nodes: List[str], is_tls: bool, up_to: int,
             yield cur, target
     finally:
         # the control client may disconnect mid-stream (GeneratorExit at a
-        # yield): the sync and stores must be torn down on every exit path
+        # yield): the sync and stores must be torn down on every exit path;
+        # facade.stop() closes the decorator chain down to the backend
         syncm.stop()
         facade.stop()
-        store.close()
     if err:
         raise err[0]
