@@ -35,11 +35,15 @@ class FieldFns:
     stages of independent products to exploit it."""
 
     def __init__(self, add, sub, mul, mul_many, sqr, neg, inv, is_zero, eq,
-                 select, zeros, ones):
+                 select, zeros, ones, batch_shape=None):
         self.add, self.sub, self.mul, self.mul_many = add, sub, mul, mul_many
         self.sqr, self.neg = sqr, neg
         self.inv, self.is_zero, self.eq, self.select = inv, is_zero, eq, select
         self.zeros, self.ones = zeros, ones
+        # Batch shape of a field-element leaf.  Default layout keeps limbs on
+        # the minor axis; the Pallas engine (pallas_field.py) overrides this
+        # with a lane-major (limbs, batch) layout.
+        self.batch_shape = batch_shape or (lambda leaf: leaf.shape[:-1])
 
 
 FP_FNS = FieldFns(
@@ -56,10 +60,6 @@ FP2_FNS = FieldFns(
     inv=T.fp2_inv, is_zero=T.fp2_is_zero, eq=T.fp2_eq, select=T.fp2_select,
     zeros=T.fp2_zeros, ones=T.fp2_ones,
 )
-
-
-def _batch_shape_fp(leaf):
-    return leaf.shape[:-1]
 
 
 class DevCurve:
@@ -151,7 +151,7 @@ class DevCurve:
         same_x = f.eq(U1, U2) & ~inf1 & ~inf2
         same_y = f.eq(S1, S2)
         dbl = (dX3, dY3, dZ3)
-        infp = self.infinity(_batch_shape_fp(self._leaf(X1)))
+        infp = self.infinity(self.f.batch_shape(self._leaf(X1)))
         out = self._select(same_x & same_y, dbl, out)
         out = self._select(same_x & ~same_y, infp, out)
         out = self._select(inf1, q, out)
@@ -208,8 +208,13 @@ class DevCurve:
 
         p: Jacobian point with batch shape S;  bits: (nbits,) + S uint32.
         One `lax.scan` of nbits steps; ~1 double + 1 complete add per step.
+        Dispatches to the fused Pallas ladder kernel when enabled.
         """
-        acc0 = self.infinity(_batch_shape_fp(self._leaf(p[0])))
+        if self.name in ("G1", "G2"):
+            from . import pallas_field as PF
+            if PF.enabled():
+                return PF.scalar_mul_bits(self.name, p, bits)
+        acc0 = self.infinity(self.f.batch_shape(self._leaf(p[0])))
 
         def step(acc, bit):
             acc = self.double(acc)
@@ -227,9 +232,15 @@ class DevCurve:
         1): one compiled double+add body regardless of bit length, so the
         graph stays small; the select wastes the add on zero bits, which is
         the right trade on TPU (compile time and code size over ~40% ALU).
+        The Pallas ladder kernel (when enabled) goes further: zero bits skip
+        their group add entirely via a scalar `cond`.
         """
+        if self.name in ("G1", "G2") and k != 0:
+            from . import pallas_field as PF
+            if PF.enabled():
+                return PF.scalar_mul_fixed(self.name, p, k)
         if k == 0:
-            return self.infinity(_batch_shape_fp(self._leaf(p[0])))
+            return self.infinity(self.f.batch_shape(self._leaf(p[0])))
         neg = k < 0
         k = abs(k)
         tail = bin(k)[3:]

@@ -369,7 +369,15 @@ def _exp_bits(e: int, nbits: int | None = None) -> np.ndarray:
 
 def pow_fixed(a, e: int):
     """a^e (Montgomery domain) for a *static* exponent, via an MSB-first
-    square-and-multiply `lax.scan`.  ~2·log2(e) mont_muls, no branches."""
+    square-and-multiply `lax.scan`.  ~2·log2(e) mont_muls, no branches.
+
+    Long chains (the sqrt/Legendre/inversion exponents) dispatch to the
+    Pallas engine when enabled: the whole chain becomes one fused kernel
+    instead of hundreds of latency-bound scan steps."""
+    if e.bit_length() >= 64:
+        from . import pallas_field as PF
+        if PF.enabled():
+            return PF.pow_fixed(a, e)
     bits = jnp.asarray(_exp_bits(e))
     acc0 = jnp.broadcast_to(ONE_M, a.shape)
 

@@ -1,0 +1,671 @@
+"""Pallas TPU kernels for the BLS12-381 hot loops (pow chains, scalar ladders).
+
+Why this exists (PERF.md): the XLA limb engine is *latency-bound*, not
+ALU-bound — every double-and-add ladder step costs ~5 ms of dispatch/schedule
+overhead because each step is thousands of tiny HLO ops, while the actual
+vector work is microseconds.  The four stages that dominate batched beacon
+verification (subgroup-check ladders, hash-to-curve pow chains, the RLC
+ladder, cofactor clearing) are all sequential chains of field ops.  Pallas
+lets us compile each *whole chain* into ONE kernel: a `lax.fori_loop` whose
+body is a full group-law step, with all limb state resident in VMEM/registers.
+
+Layout: inside kernels a field element is a ``(..., 24, B)`` uint32 tensor —
+limbs on sublanes, batch on lanes (B a multiple of the 128-lane tile).  This
+is the transpose of the XLA engine's ``(..., 24)`` layout; wrappers
+transpose/pad at the kernel boundary (cheap XLA reshapes in HBM).
+
+The group-law formulas are NOT re-implemented: `DevCurve` (ops/curve.py) is
+generic over a `FieldFns` namespace, so the same tested double/add code runs
+inside the kernels over the Pallas field namespace below.
+
+Reference analogue: this file plays the role of the x86-64 assembly in
+`kilic/bls12-381` (SURVEY.md §2.9) — the hand-scheduled native backend under
+a generic field interface.
+
+Engine selection (`DRAND_TPU_PALLAS`): `auto` (default) — dispatch on only
+when the default backend is TPU; `1`/`interp` — dispatch on everywhere;
+`0` — off.  The Mosaic-compiled kernels run only on TPU; on other backends
+the dispatch runs the IDENTICAL chain math (`_pow_math`/`_ladder_*_math`)
+as plain jitted XLA — that is what the CPU test suite covers, plus the
+operand/layout wrappers shared by both lowerings.
+"""
+
+import math
+import os
+from contextlib import contextmanager
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import limbs as L
+from .curve import DevCurve, FieldFns
+from ..crypto.host.params import P as FP_P, B1, B2
+
+NL = L.NLIMB          # 24 limbs of 16 bits
+MASK = L.MASK
+U32 = L.U32
+
+# Lane-layout constants: (24, 1) columns broadcasting over the lane axis.
+# NUMPY on purpose: this module is imported lazily, possibly inside an active
+# jit trace — jnp constants created there would be tracers and leak across
+# traces.  numpy arrays convert at each use site instead.
+_P_LANE = np.asarray(L.int_to_limbs(FP_P))[:, None]
+_ONE_LANE = np.asarray(L.int_to_limbs(L.R_MONT))[:, None]
+_N0 = np.uint32(L.N0)
+
+TILE = int(os.environ.get("DRAND_TPU_PALLAS_TILE", "256"))
+
+# Pallas kernels may not close over array constants — p and 1_mont enter each
+# kernel as (24, TILE) operands, installed for the trace via this context.
+_CTX = {}
+
+
+def _p_lane():
+    return _CTX.get("p", _P_LANE)
+
+
+def _one_lane():
+    return _CTX.get("one", _ONE_LANE)
+
+
+@contextmanager
+def _kernel_consts(p, one):
+    old = dict(_CTX)
+    _CTX["p"], _CTX["one"] = p, one
+    try:
+        yield
+    finally:
+        _CTX.clear()
+        _CTX.update(old)
+
+
+_P_FULL = np.ascontiguousarray(np.broadcast_to(_P_LANE, (NL, TILE)))
+_ONE_FULL = np.ascontiguousarray(np.broadcast_to(_ONE_LANE, (NL, TILE)))
+
+
+def enabled() -> bool:
+    mode = os.environ.get("DRAND_TPU_PALLAS", "auto")
+    if mode == "0":
+        return False
+    if mode in ("1", "interp"):
+        return True
+    if mode == "auto":
+        return jax.default_backend() == "tpu"
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Field ops on the lane-major layout (..., 24, B).  Pure jnp — usable both
+# inside Pallas kernels and (for tests) as plain XLA ops.
+# ---------------------------------------------------------------------------
+
+
+def _shift_up(x, k=1):
+    """Move limb i to limb i+k (multiply by 2^(16k)); zeros shift in."""
+    z = jnp.zeros(x.shape[:-2] + (k,) + x.shape[-1:], x.dtype)
+    return jnp.concatenate([z, x[..., :-k, :]], axis=-2)
+
+
+def _norm(cols, nout: int):
+    """Exact base-2^16 limbs of sum(cols_i · 2^16i) mod 2^(16·nout).
+
+    cols: (..., m, B) uint32 columns, each < 2^23.  Three vector relax
+    passes bound every column by 2^16, then an unrolled Kogge-Stone
+    generate/propagate pass resolves the remaining single-bit ripple —
+    no O(limbs) sequential scan (which would serialize on the sublane axis).
+    """
+    m = cols.shape[-2]
+    if m < nout:
+        z = jnp.zeros(cols.shape[:-2] + (nout - m,) + cols.shape[-1:], U32)
+        cols = jnp.concatenate([cols, z], axis=-2)
+    elif m > nout:
+        raise ValueError("cols wider than nout")
+    c = cols
+    for _ in range(3):
+        c = (c & MASK) + _shift_up(c >> 16)
+    # now every column <= 2^16: single-bit carries remain
+    g = c >> 16                       # generate (c == 2^16)
+    p_ = (c == MASK).astype(U32)      # propagate
+    d = 1
+    while d < nout:
+        g = g | (p_ & _shift_up(g, d))
+        p_ = p_ & _shift_up(p_, d)
+        d *= 2
+    return (c + _shift_up(g, 1)) & MASK
+
+
+def _cond_sub_p(a):
+    """a < 2p (24 limbs) -> canonical a mod p."""
+    diff, borrow = _sub_raw(a)
+    return jnp.where((borrow == 0)[..., None, :], diff, a)
+
+
+def _embed(x, start: int, total: int):
+    """Place x's rows at [start, start+rows) within `total` rows (axis -2).
+
+    Concatenation with zeros instead of scattered updates: Mosaic has no
+    scatter-add, and a static-offset embed lowers to cheap sublane concats."""
+    rows = x.shape[-2]
+    parts = []
+    if start:
+        parts.append(jnp.zeros(x.shape[:-2] + (start,) + x.shape[-1:], x.dtype))
+    parts.append(x)
+    tail = total - start - rows
+    if tail:
+        parts.append(jnp.zeros(x.shape[:-2] + (tail,) + x.shape[-1:], x.dtype))
+    return jnp.concatenate(parts, axis=-2) if len(parts) > 1 else x
+
+
+def _sub_raw(a, b=None):
+    """a - (b or p) over 24 limbs; returns (diff mod 2^384, borrow in {0,1})."""
+    bb = _p_lane() if b is None else b
+    v = a + (MASK - bb)                       # each in [0, 2^17-2]
+    v = jnp.concatenate([v[..., 0:1, :] + 1, v[..., 1:, :]], axis=-2)  # +1
+    d = _norm(v, NL + 1)
+    carry = d[..., NL, :]
+    return d[..., :NL, :], 1 - carry
+
+
+def pf_add(a, b):
+    s = _norm(a + b, NL + 1)
+    limbs, carry = s[..., :NL, :], s[..., NL, :]
+    diff, borrow = _sub_raw(limbs)
+    take = ((carry == 1) | (borrow == 0))[..., None, :]
+    return jnp.where(take, diff, limbs)
+
+
+def pf_sub(a, b):
+    d, borrow = _sub_raw(a, b)
+    fixed = _norm(d + _p_lane(), NL)
+    return jnp.where((borrow == 1)[..., None, :], fixed, d)
+
+
+def pf_neg(a):
+    d, _ = _sub_raw(jnp.broadcast_to(_p_lane(), a.shape), a)
+    return jnp.where(pf_is_zero(a)[..., None, :], a, d)
+
+
+def _lohi25(prod):
+    """Split a (..., 24, B) product row-block into its 25-row lo+hi columns."""
+    z1 = jnp.zeros(prod.shape[:-2] + (1,) + prod.shape[-1:], U32)
+    lo = jnp.concatenate([prod & MASK, z1], axis=-2)
+    hi = jnp.concatenate([z1, prod >> 16], axis=-2)
+    return lo + hi
+
+
+def _conv(a, b):
+    """Schoolbook product columns (..., 48, B); every column < 2^22."""
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    a = jnp.broadcast_to(a, shape)
+    b = jnp.broadcast_to(b, shape)
+    t = jnp.zeros(shape[:-2] + (2 * NL, shape[-1]), U32)
+    for i in range(NL):
+        prod = a[..., i:i + 1, :] * b        # exact uint32 (16x16-bit)
+        t = t + _embed(_lohi25(prod), i, 2 * NL)
+    return t
+
+
+def _redc(t):
+    """Word-wise Montgomery reduction of (..., 48, B) columns -> (..., 24, B).
+
+    Same flow as limbs.mont_reduce, but limb i's cleared value is pushed into
+    limb i+1 with wide ops only (no per-limb sequential carry scan).  Row i
+    is never read again after iteration i, so it is left dirty rather than
+    zeroed (only rows 24..47 feed the result)."""
+    for i in range(NL):
+        m = (t[..., i:i + 1, :] * _N0) & MASK       # uint32 wrap: low 16 exact
+        t = t + _embed(_lohi25(m * _p_lane()), i, 2 * NL)
+        carry = t[..., i:i + 1, :] >> 16
+        t = jnp.concatenate(
+            [t[..., :i + 1, :], t[..., i + 1:i + 2, :] + carry,
+             t[..., i + 2:, :]], axis=-2)
+    return _cond_sub_p(_norm(t[..., NL:, :], NL))
+
+
+def pf_mul(a, b):
+    return _redc(_conv(a, b))
+
+
+def pf_sqr(a):
+    return pf_mul(a, a)
+
+
+def pf_is_zero(a):
+    return jnp.all(a == 0, axis=-2)
+
+
+def pf_eq(a, b):
+    return jnp.all(a == b, axis=-2)
+
+
+def pf_select(cond, a, b):
+    return jnp.where(cond[..., None, :], a, b)
+
+
+def pf_zeros(shape=()):
+    return jnp.zeros((NL,) + shape, U32)
+
+
+def pf_ones(shape=()):
+    one = _one_lane()
+    return jnp.broadcast_to(one if shape else one[:, 0], (NL,) + shape)
+
+
+def _stack(xs):
+    shape = jnp.broadcast_shapes(*[x.shape for x in xs])
+    return jnp.stack([jnp.broadcast_to(x, shape) for x in xs], axis=0)
+
+
+def pf_mul_many(pairs):
+    if len(pairs) == 1:
+        return (pf_mul(pairs[0][0], pairs[0][1]),)
+    out = pf_mul(_stack([p[0] for p in pairs]), _stack([p[1] for p in pairs]))
+    return tuple(out[i] for i in range(len(pairs)))
+
+
+def pf_add_many(pairs):
+    if len(pairs) == 1:
+        return (pf_add(pairs[0][0], pairs[0][1]),)
+    out = pf_add(_stack([p[0] for p in pairs]), _stack([p[1] for p in pairs]))
+    return tuple(out[i] for i in range(len(pairs)))
+
+
+def pf_sub_many(pairs):
+    if len(pairs) == 1:
+        return (pf_sub(pairs[0][0], pairs[0][1]),)
+    out = pf_sub(_stack([p[0] for p in pairs]), _stack([p[1] for p in pairs]))
+    return tuple(out[i] for i in range(len(pairs)))
+
+
+def _no_inv(a):  # pragma: no cover - kernels never invert
+    raise NotImplementedError("no inversion inside Pallas kernels")
+
+
+# ---------------------------------------------------------------------------
+# Fp2 on the lane layout (tower.py formulas over the pf ops)
+# ---------------------------------------------------------------------------
+
+
+def pf2_add(a, b):
+    r = pf_add_many([(a[0], b[0]), (a[1], b[1])])
+    return (r[0], r[1])
+
+
+def pf2_sub(a, b):
+    r = pf_sub_many([(a[0], b[0]), (a[1], b[1])])
+    return (r[0], r[1])
+
+
+def pf2_neg(a):
+    return (pf_neg(a[0]), pf_neg(a[1]))
+
+
+def pf2_mul_many(pairs):
+    k = len(pairs)
+    sums = pf_add_many([(a[0], a[1]) for a, _ in pairs]
+                       + [(b[0], b[1]) for _, b in pairs])
+    t = pf_mul_many(
+        [(a[0], b[0]) for a, b in pairs]
+        + [(a[1], b[1]) for a, b in pairs]
+        + [(sums[i], sums[k + i]) for i in range(k)])
+    t0, t1, t2 = t[:k], t[k:2 * k], t[2 * k:]
+    s = pf_sub_many([(t0[i], t1[i]) for i in range(k)]
+                    + [(t2[i], t0[i]) for i in range(k)])
+    c0, u = s[:k], s[k:]
+    c1 = pf_sub_many([(u[i], t1[i]) for i in range(k)])
+    return [(c0[i], c1[i]) for i in range(k)]
+
+
+def pf2_mul(a, b):
+    return pf2_mul_many([(a, b)])[0]
+
+
+def pf2_sqr_many(xs):
+    k = len(xs)
+    sums = pf_add_many([(a[0], a[1]) for a in xs])
+    difs = pf_sub_many([(a[0], a[1]) for a in xs])
+    t = pf_mul_many([(sums[i], difs[i]) for i in range(k)]
+                    + [(a[0], a[1]) for a in xs])
+    c1 = pf_add_many([(t[k + i], t[k + i]) for i in range(k)])
+    return [(t[i], c1[i]) for i in range(k)]
+
+
+def pf2_sqr(a):
+    return pf2_sqr_many([a])[0]
+
+
+def pf2_is_zero(a):
+    return pf_is_zero(a[0]) & pf_is_zero(a[1])
+
+
+def pf2_eq(a, b):
+    return pf_eq(a[0], b[0]) & pf_eq(a[1], b[1])
+
+
+def pf2_select(cond, a, b):
+    return (pf_select(cond, a[0], b[0]), pf_select(cond, a[1], b[1]))
+
+
+def pf2_zeros(shape=()):
+    z = pf_zeros(shape)
+    return (z, z)
+
+
+def pf2_ones(shape=()):
+    return (pf_ones(shape), pf_zeros(shape))
+
+
+_lane_batch_shape = lambda leaf: leaf.shape[-1:]
+
+PF_FP = FieldFns(
+    add=pf_add, sub=pf_sub, mul=pf_mul, mul_many=pf_mul_many,
+    sqr=pf_sqr, neg=pf_neg, inv=_no_inv, is_zero=pf_is_zero, eq=pf_eq,
+    select=pf_select, zeros=pf_zeros, ones=pf_ones,
+    batch_shape=_lane_batch_shape,
+)
+
+PF_FP2 = FieldFns(
+    add=pf2_add, sub=pf2_sub, mul=pf2_mul, mul_many=pf2_mul_many,
+    sqr=pf2_sqr, neg=pf2_neg, inv=_no_inv, is_zero=pf2_is_zero, eq=pf2_eq,
+    select=pf2_select, zeros=pf2_zeros, ones=pf2_ones,
+    batch_shape=_lane_batch_shape,
+)
+
+
+def _lane_const(x: int):
+    # numpy, not jnp: see the module-constant note above (lazy import under
+    # an active trace must not mint tracers)
+    return np.asarray(L.int_to_limbs(x * L.R_MONT % FP_P))[:, None]
+
+
+G1_PF = DevCurve(PF_FP, _lane_const(B1), "G1pf")
+G2_PF = DevCurve(PF_FP2, (_lane_const(B2[0]), _lane_const(B2[1])), "G2pf")
+
+
+# ---------------------------------------------------------------------------
+# Kernels
+# ---------------------------------------------------------------------------
+
+_COND_OK = os.environ.get("DRAND_TPU_PALLAS_COND", "1") == "1"
+
+
+def _maybe_cond(bit, then_fn, acc):
+    """Skip work when a shared (SMEM) bit is 0.  `lax.cond` on a scalar is
+    the fast path; flip DRAND_TPU_PALLAS_COND=0 if a Mosaic version regresses
+    on conditionals with big vector carries."""
+    if _COND_OK:
+        return jax.lax.cond(bit == 1, then_fn, lambda a: a, acc)
+    out = then_fn(acc)
+    return jax.tree.map(lambda x, y: jnp.where(bit == 1, x, y), out, acc)
+
+
+def _exp_bits_np(e: int) -> np.ndarray:
+    nbits = max(e.bit_length(), 1)
+    return np.array([(e >> (nbits - 1 - i)) & 1 for i in range(nbits)],
+                    dtype=np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Shared chain math (used by BOTH the compiled Pallas kernels on TPU and the
+# plain-XLA "direct" fallback on other backends — one body, two lowerings, so
+# the CPU test suite covers exactly the math the chip runs).
+# ---------------------------------------------------------------------------
+
+
+def _pow_math(getbit, x, nbits: int):
+    acc0 = pf_ones((x.shape[-1],))
+
+    def step(i, acc):
+        acc = pf_sqr(acc)
+        return _maybe_cond(getbit(i), lambda a: pf_mul(a, x), acc)
+
+    return jax.lax.fori_loop(0, nbits, step, acc0)
+
+
+def _ladder_var_math(kind: str, getrow, pt, nbits: int):
+    curve = _curve_of(kind)
+    acc0 = curve.infinity((_flat_point(pt)[0].shape[-1],))
+
+    def step(i, acc):
+        acc = curve.double(acc)
+        added = curve.add(acc, pt)
+        cond = getrow(i) == 1                              # (1, B)
+        return jax.tree.map(lambda x, y: jnp.where(cond, x, y), added, acc)
+
+    return jax.lax.fori_loop(0, nbits, step, acc0)
+
+
+def _ladder_fixed_math(kind: str, getbit, pt, nbits: int):
+    curve = _curve_of(kind)
+    acc0 = curve.infinity((_flat_point(pt)[0].shape[-1],))
+
+    def step(i, acc):
+        acc = curve.double(acc)
+        return _maybe_cond(getbit(i), lambda a: curve.add(a, pt), acc)
+
+    return jax.lax.fori_loop(0, nbits, step, acc0)
+
+
+def _curve_of(kind: str):
+    return G1_PF if kind == "G1" else G2_PF
+
+
+def _ncoord(kind: str) -> int:
+    return 3 if kind == "G1" else 6
+
+
+def _pack_point(kind, arrs):
+    if kind == "G1":
+        return tuple(arrs)
+    return ((arrs[0], arrs[1]), (arrs[2], arrs[3]), (arrs[4], arrs[5]))
+
+
+def _flat_point(p):
+    return [x for coord in p
+            for x in (coord if isinstance(coord, tuple) else (coord,))]
+
+
+def _use_kernels() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Compiled Pallas kernels (TPU)
+# ---------------------------------------------------------------------------
+
+_CONST_SPEC = pl.BlockSpec((NL, TILE), lambda i, *_: (0, 0))
+_DATA_SPEC = pl.BlockSpec((NL, TILE), lambda i, *_: (0, i))
+
+
+@lru_cache(maxsize=None)
+def _pow_call(e: int, btot: int):
+    nbits = max(e.bit_length(), 1)
+
+    def kernel(bits_ref, p_ref, one_ref, x_ref, o_ref):
+        with _kernel_consts(p_ref[:], one_ref[:]):
+            o_ref[:] = _pow_math(lambda i: bits_ref[i], x_ref[:], nbits)
+
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(btot // TILE,),
+        in_specs=[_CONST_SPEC, _CONST_SPEC, _DATA_SPEC],
+        out_specs=_DATA_SPEC,
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=jax.ShapeDtypeStruct((NL, btot), U32))
+
+
+@lru_cache(maxsize=None)
+def _pow_direct(e: int):
+    nbits = max(e.bit_length(), 1)
+
+    @jax.jit
+    def run(bits, x):
+        return _pow_math(lambda i: bits[i], x, nbits)
+
+    return run
+
+
+@lru_cache(maxsize=None)
+def _ladder_var_call(kind: str, nbits: int, btot: int):
+    nc = _ncoord(kind)
+
+    def kernel(p_ref, one_ref, *refs):
+        with _kernel_consts(p_ref[:], one_ref[:]):
+            ins, bits_ref, outs = refs[:nc], refs[nc], refs[nc + 1:]
+            pt = _pack_point(kind, [r[:] for r in ins])
+            acc = _ladder_var_math(
+                kind, lambda i: bits_ref[pl.ds(i, 1), :], pt, nbits)
+            for o, v in zip(outs, _flat_point(acc)):
+                o[:] = v
+
+    spec = pl.BlockSpec((NL, TILE), lambda i: (0, i))
+    gs = pl.GridSpec(
+        grid=(btot // TILE,),
+        in_specs=[pl.BlockSpec((NL, TILE), lambda i: (0, 0))] * 2
+        + [spec] * nc + [pl.BlockSpec((nbits, TILE), lambda i: (0, i))],
+        out_specs=[spec] * nc,
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=[jax.ShapeDtypeStruct((NL, btot), U32)] * nc)
+
+
+@lru_cache(maxsize=None)
+def _ladder_var_direct(kind: str, nbits: int):
+    nc = _ncoord(kind)
+
+    @jax.jit
+    def run(bits, *arrs):
+        pt = _pack_point(kind, list(arrs[:nc]))
+        acc = _ladder_var_math(
+            kind, lambda i: jax.lax.dynamic_slice_in_dim(bits, i, 1, 0),
+            pt, nbits)
+        return tuple(_flat_point(acc))
+
+    return run
+
+
+@lru_cache(maxsize=None)
+def _ladder_fixed_call(kind: str, k: int, btot: int):
+    nc = _ncoord(kind)
+    nbits = max(k.bit_length(), 1)
+
+    def kernel(bits_ref, p_ref, one_ref, *refs):
+        with _kernel_consts(p_ref[:], one_ref[:]):
+            ins, outs = refs[:nc], refs[nc:]
+            pt = _pack_point(kind, [r[:] for r in ins])
+            acc = _ladder_fixed_math(kind, lambda i: bits_ref[i], pt, nbits)
+            for o, v in zip(outs, _flat_point(acc)):
+                o[:] = v
+
+    spec = pl.BlockSpec((NL, TILE), lambda i, b: (0, i))
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(btot // TILE,),
+        in_specs=[_CONST_SPEC, _CONST_SPEC] + [spec] * nc,
+        out_specs=[spec] * nc,
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=[jax.ShapeDtypeStruct((NL, btot), U32)] * nc)
+
+
+@lru_cache(maxsize=None)
+def _ladder_fixed_direct(kind: str, k: int):
+    nc = _ncoord(kind)
+    nbits = max(k.bit_length(), 1)
+
+    @jax.jit
+    def run(bits, *arrs):
+        pt = _pack_point(kind, list(arrs[:nc]))
+        acc = _ladder_fixed_math(kind, lambda i: bits[i], pt, nbits)
+        return tuple(_flat_point(acc))
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# Layout wrappers (drop-in public API)
+# ---------------------------------------------------------------------------
+
+
+def _to_lanes(a):
+    """(..., 24) -> ((24, Bpad), batch_shape, B)."""
+    shape = a.shape[:-1]
+    b = int(np.prod(shape)) if shape else 1
+    x = a.reshape(b, NL).T
+    bp = max(TILE, math.ceil(b / TILE) * TILE)
+    if bp != b:
+        x = jnp.pad(x, ((0, 0), (0, bp - b)))
+    return x, shape, b
+
+
+def _from_lanes(x, shape, b):
+    return x[:, :b].T.reshape(shape + (NL,))
+
+
+def pow_fixed(a, e: int):
+    """Drop-in for limbs.pow_fixed: whole square-and-multiply chain as one
+    Pallas kernel (zero bits skip their multiply via scalar `cond`)."""
+    x, shape, b = _to_lanes(a)
+    bits = jnp.asarray(_exp_bits_np(e))
+    if _use_kernels():
+        out = _pow_call(e, x.shape[1])(bits, _P_FULL, _ONE_FULL, x)
+    else:
+        out = _pow_direct(e)(bits, x)
+    return _from_lanes(out, shape, b)
+
+
+def _point_to_lanes(p):
+    flat = _flat_point(p)
+    shape = flat[0].shape[:-1]
+    outs = [_to_lanes(x)[0] for x in flat]
+    b = int(np.prod(shape)) if shape else 1
+    return outs, shape, b
+
+
+def _point_from_lanes(kind, arrs, shape, b):
+    coords = [_from_lanes(x, shape, b) for x in arrs]
+    return _pack_point(kind, coords)
+
+
+def scalar_mul_bits(kind: str, p, bits):
+    """Drop-in for DevCurve.scalar_mul_bits (variable per-element scalars):
+    the whole MSB-first double-and-add ladder runs as one Pallas kernel."""
+    arrs, shape, b = _point_to_lanes(p)
+    nbits = bits.shape[0]
+    btot = arrs[0].shape[1]
+    bt = bits.reshape(nbits, b).astype(U32)
+    if btot != b:
+        bt = jnp.pad(bt, ((0, 0), (0, btot - b)))
+    if _use_kernels():
+        out = _ladder_var_call(kind, nbits, btot)(_P_FULL, _ONE_FULL, *arrs, bt)
+    else:
+        out = _ladder_var_direct(kind, nbits)(bt, *arrs)
+    return _point_from_lanes(kind, out, shape, b)
+
+
+def scalar_mul_fixed(kind: str, p, k: int):
+    """Drop-in for DevCurve.scalar_mul_fixed (static scalar: cofactors, |x|
+    chains).  Zero bits skip their group add entirely (scalar `cond`), so an
+    |x| ladder costs 64 doubles + hw(|x|)=6 adds."""
+    from . import curve as DC
+    xla_curve = DC.G1_DEV if kind == "G1" else DC.G2_DEV
+    assert k != 0, "k == 0 is handled by DevCurve.scalar_mul_fixed"
+    neg = k < 0
+    k = abs(k)
+    arrs, shape, b = _point_to_lanes(p)
+    btot = arrs[0].shape[1]
+    bits = jnp.asarray(_exp_bits_np(k))
+    if _use_kernels():
+        out = _ladder_fixed_call(kind, k, btot)(bits, _P_FULL, _ONE_FULL, *arrs)
+    else:
+        out = _ladder_fixed_direct(kind, k)(bits, *arrs)
+    res = _point_from_lanes(kind, out, shape, b)
+    return xla_curve.neg(res) if neg else res

@@ -1,0 +1,114 @@
+"""Pallas field engine vs the XLA limb engine (bit-exact equivalence).
+
+Runs the lane-major field ops and the shared chain math (_pow_math /
+_ladder_*_math — the exact bodies the TPU kernels execute) as plain XLA on
+CPU; the Mosaic-compiled lowering itself is exercised on the real chip by
+bench.py.  Reference semantics: ops/limbs.py (itself pinned to mainnet
+vectors via the host golden code).
+"""
+
+import secrets
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from drand_tpu.ops import limbs as L
+from drand_tpu.ops import curve as DC
+from drand_tpu.ops import pallas_field as PF
+from drand_tpu.crypto.host.params import P, G1_GEN, G2_GEN
+from drand_tpu.crypto.host import curve as HC
+
+
+def _rand_fp(n):
+    return [secrets.randbelow(P) for _ in range(n)]
+
+
+def _lanes(xs):
+    """ints -> (24, n) Montgomery lane-layout tensor."""
+    return jnp.asarray(np.stack([np.asarray(L.int_to_limbs(x * L.R_MONT % P))
+                                 for x in xs], axis=1))
+
+
+def _ints(lanes):
+    cols = np.asarray(lanes)
+    return [L.limbs_to_int(cols[:, i]) * L.R_INV % P
+            for i in range(cols.shape[1])]
+
+
+class TestLaneFieldOps:
+    def test_mul_add_sub_neg(self):
+        n = 16
+        a, b = _rand_fp(n), _rand_fp(n)
+        A, B = _lanes(a), _lanes(b)
+        assert _ints(PF.pf_mul(A, B)) == [x * y % P for x, y in zip(a, b)]
+        assert _ints(PF.pf_add(A, B)) == [(x + y) % P for x, y in zip(a, b)]
+        assert _ints(PF.pf_sub(A, B)) == [(x - y) % P for x, y in zip(a, b)]
+        assert _ints(PF.pf_neg(A)) == [(-x) % P for x in a]
+
+    def test_edge_values(self):
+        xs = [0, 1, P - 1, P - 2, (1 << 384) % P]
+        A = _lanes(xs)
+        assert _ints(PF.pf_mul(A, A)) == [x * x % P for x in xs]
+        assert _ints(PF.pf_add(A, A)) == [2 * x % P for x in xs]
+        assert list(np.asarray(PF.pf_is_zero(A))) == [x == 0 for x in xs]
+
+    def test_stacked_leading_axis(self):
+        a, b = _rand_fp(8), _rand_fp(8)
+        A = jnp.stack([_lanes(a), _lanes(b)])          # (2, 24, 8)
+        out = PF.pf_mul(A, A)
+        assert _ints(out[0]) == [x * x % P for x in a]
+        assert _ints(out[1]) == [x * x % P for x in b]
+
+
+@pytest.fixture(autouse=True)
+def _interp_mode(monkeypatch):
+    monkeypatch.setenv("DRAND_TPU_PALLAS", "interp")
+    yield
+
+
+class TestKernels:
+    def test_pow_kernel_matches_xla(self):
+        xs = _rand_fp(5) + [0, 1, P - 1]
+        a = L.encode_mont(xs)
+        for e in ((1 << 14) + 5, 0x8001):
+            got = PF.pow_fixed(a, e)
+            want = [pow(x, e, P) for x in xs]
+            assert L.decode_mont(got) == want
+
+    def test_ladder_var_g1_matches_scan(self):
+        pts = [HC.G1.mul(G1_GEN, secrets.randbelow(1 << 64))
+               for _ in range(4)] + [None]
+        ks = [secrets.randbits(8) for _ in range(4)] + [7]
+        p = DC.encode_g1_points(pts)
+        bits = DC.scalars_to_bits(ks, nbits=8)
+        got = PF.scalar_mul_bits("G1", p, bits)
+        want = [HC.G1.mul(pt, k) for k, pt in zip(ks, pts)]
+        assert DC.decode_g1_points(got) == want
+
+    def test_ladder_var_g2_matches_scan(self):
+        pts = [HC.G2.mul(G2_GEN, secrets.randbelow(1 << 64))
+               for _ in range(3)]
+        ks = [secrets.randbits(6) for _ in range(3)]
+        p = DC.encode_g2_points(pts)
+        bits = DC.scalars_to_bits(ks, nbits=6)
+        got = PF.scalar_mul_bits("G2", p, bits)
+        want = [HC.G2.mul(pt, k) for k, pt in zip(ks, pts)]
+        assert DC.decode_g2_points(got) == want
+
+    def test_ladder_fixed_matches_host(self):
+        pts = [HC.G1.mul(G1_GEN, secrets.randbelow(1 << 64))
+               for _ in range(3)]
+        p = DC.encode_g1_points(pts)
+        for k in (0x1d, -0x13):
+            got = PF.scalar_mul_fixed("G1", p, k)
+            want = [HC.G1.mul(pt, k) for pt in pts]
+            assert DC.decode_g1_points(got) == want
+
+    def test_dispatch_routes_to_pallas(self):
+        """With the engine enabled, the public entry points hit the kernels."""
+        pts = [G1_GEN, None, G1_GEN]
+        p = DC.encode_g1_points(pts)
+        got = DC.G1_DEV.scalar_mul_fixed(p, 5)
+        assert DC.decode_g1_points(got) == [
+            HC.G1.mul(pt, 5) for pt in pts]
