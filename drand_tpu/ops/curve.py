@@ -259,7 +259,14 @@ class DevCurve:
     def sum_points(self, p):
         """Tree-reduce a batched point (leading axis) to a single point.
 
-        log2(n) rounds of halving pairwise adds; odd leftovers carried over."""
+        log2(n) rounds of halving pairwise adds; odd leftovers carried over.
+        On TPU the whole tree runs as one Pallas kernel per lane tile."""
+        if self.name in ("G1", "G2"):
+            from . import pallas_field as PF
+            if PF.enabled():
+                out = PF.sum_points(self.name, p)
+                if out is not None:
+                    return out
         n = self._leaf(p[0]).shape[0]
         while n > 1:
             half = n // 2

@@ -93,7 +93,12 @@ def _apply_line(f, ell, px, py):
 def miller_loop(px, py, q2):
     """f_{|x|,Q}(P), conjugated for x < 0.  All inputs affine, batched.
 
-    px, py: (..., 24) Fp limbs; q2: ((x0,x1),(y0,y1)) affine Fp2 pairs."""
+    px, py: (..., 24) Fp limbs; q2: ((x0,x1),(y0,y1)) affine Fp2 pairs.
+    Dispatches to the fused Pallas kernel when enabled (the RLC pipeline's
+    pairing runs on 2 lanes — pure scan latency in XLA)."""
+    from . import pallas_field as PF
+    if PF.enabled():
+        return PF.miller_loop(px, py, q2)
     shape = px.shape[:-1]
     f0 = T.fp12_ones(shape)
     R0 = (q2[0], q2[1], T.fp2_ones(shape))
@@ -139,6 +144,9 @@ def _pow_x(g):
 
 
 def final_exponentiation(f):
+    from . import pallas_field as PF
+    if PF.enabled():
+        return PF.final_exponentiation(f)
     # easy part: f^((p^6-1)(p^2+1))
     f = T.fp12_mul(T.fp12_conj(f), T.fp12_inv(f))
     f = T.fp12_mul(T.fp12_frobenius(f, 2), f)

@@ -59,23 +59,58 @@ _N0 = np.uint32(L.N0)
 
 TILE = int(os.environ.get("DRAND_TPU_PALLAS_TILE", "256"))
 
-# Pallas kernels may not close over array constants — p and 1_mont enter each
-# kernel as (24, TILE) operands, installed for the trace via this context.
+# Pallas kernels may not close over array constants — field constants enter
+# each kernel as operands (a stacked (K, 24, tile) bundle for the pairing
+# kernels; (24, TILE) p/one pair for the chain kernels), installed for the
+# trace via this context.  Outside any kernel the numpy fallbacks apply.
 _CTX = {}
 
 
+def _mont_np(x: int) -> np.ndarray:
+    return np.asarray(L.int_to_limbs(x * L.R_MONT % FP_P))
+
+
+def _const_entries():
+    from ..crypto.host import field as HFhost
+    from ..crypto.host.params import B2
+    ents = [("p", np.asarray(L.int_to_limbs(FP_P))),
+            ("one", _mont_np(1)),
+            ("half", _mont_np((FP_P + 1) // 2)),
+            ("b2_0", _mont_np(B2[0])), ("b2_1", _mont_np(B2[1]))]
+    for j in (1, 2):
+        for i, c in enumerate(HFhost._FROB[j]):
+            ents.append((f"frob{j}_{i}_0", _mont_np(c[0])))
+            ents.append((f"frob{j}_{i}_1", _mont_np(c[1])))
+    return ents
+
+
+_CONST_ENTRIES = _const_entries()
+_CONST_IDX = {name: i for i, (name, _) in enumerate(_CONST_ENTRIES)}
+_CONST_STACK = np.stack([v for _, v in _CONST_ENTRIES])       # (K, 24)
+NCONST = len(_CONST_ENTRIES)
+
+
+def _c(name: str):
+    """Named field constant in the active layout/context."""
+    if "consts" in _CTX:
+        return _CTX["consts"][_CONST_IDX[name]]
+    if name in _CTX:
+        return _CTX[name]
+    return _CONST_STACK[_CONST_IDX[name]][:, None]            # numpy (24, 1)
+
+
 def _p_lane():
-    return _CTX.get("p", _P_LANE)
+    return _c("p")
 
 
 def _one_lane():
-    return _CTX.get("one", _ONE_LANE)
+    return _c("one")
 
 
 @contextmanager
-def _kernel_consts(p, one):
+def _kernel_consts(**kw):
     old = dict(_CTX)
-    _CTX["p"], _CTX["one"] = p, one
+    _CTX.update(kw)
     try:
         yield
     finally:
@@ -85,6 +120,12 @@ def _kernel_consts(p, one):
 
 _P_FULL = np.ascontiguousarray(np.broadcast_to(_P_LANE, (NL, TILE)))
 _ONE_FULL = np.ascontiguousarray(np.broadcast_to(_ONE_LANE, (NL, TILE)))
+
+
+@lru_cache(maxsize=None)
+def _const_bundle(tile: int) -> np.ndarray:
+    return np.ascontiguousarray(
+        np.broadcast_to(_CONST_STACK[:, :, None], (NCONST, NL, tile)))
 
 
 def enabled() -> bool:
@@ -404,9 +445,8 @@ def _maybe_cond(bit, then_fn, acc):
 
 
 def _exp_bits_np(e: int) -> np.ndarray:
-    nbits = max(e.bit_length(), 1)
-    return np.array([(e >> (nbits - 1 - i)) & 1 for i in range(nbits)],
-                    dtype=np.int32)
+    # int32 view of limbs._exp_bits (SMEM scalar operands are int32)
+    return np.asarray(L._exp_bits(e), np.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -486,7 +526,7 @@ def _pow_call(e: int, btot: int):
     nbits = max(e.bit_length(), 1)
 
     def kernel(bits_ref, p_ref, one_ref, x_ref, o_ref):
-        with _kernel_consts(p_ref[:], one_ref[:]):
+        with _kernel_consts(p=p_ref[:, 0:1], one=one_ref[:, 0:1]):
             o_ref[:] = _pow_math(lambda i: bits_ref[i], x_ref[:], nbits)
 
     gs = pltpu.PrefetchScalarGridSpec(
@@ -516,7 +556,7 @@ def _ladder_var_call(kind: str, nbits: int, btot: int):
     nc = _ncoord(kind)
 
     def kernel(p_ref, one_ref, *refs):
-        with _kernel_consts(p_ref[:], one_ref[:]):
+        with _kernel_consts(p=p_ref[:, 0:1], one=one_ref[:, 0:1]):
             ins, bits_ref, outs = refs[:nc], refs[nc], refs[nc + 1:]
             pt = _pack_point(kind, [r[:] for r in ins])
             acc = _ladder_var_math(
@@ -557,7 +597,7 @@ def _ladder_fixed_call(kind: str, k: int, btot: int):
     nbits = max(k.bit_length(), 1)
 
     def kernel(bits_ref, p_ref, one_ref, *refs):
-        with _kernel_consts(p_ref[:], one_ref[:]):
+        with _kernel_consts(p=p_ref[:, 0:1], one=one_ref[:, 0:1]):
             ins, outs = refs[:nc], refs[nc:]
             pt = _pack_point(kind, [r[:] for r in ins])
             acc = _ladder_fixed_math(kind, lambda i: bits_ref[i], pt, nbits)
@@ -595,12 +635,12 @@ def _ladder_fixed_direct(kind: str, k: int):
 # ---------------------------------------------------------------------------
 
 
-def _to_lanes(a):
+def _to_lanes(a, tile: int = TILE):
     """(..., 24) -> ((24, Bpad), batch_shape, B)."""
     shape = a.shape[:-1]
     b = int(np.prod(shape)) if shape else 1
     x = a.reshape(b, NL).T
-    bp = max(TILE, math.ceil(b / TILE) * TILE)
+    bp = max(tile, math.ceil(b / tile) * tile)
     if bp != b:
         x = jnp.pad(x, ((0, 0), (0, bp - b)))
     return x, shape, b
@@ -669,3 +709,469 @@ def scalar_mul_fixed(kind: str, p, k: int):
         out = _ladder_fixed_direct(kind, k)(bits, *arrs)
     res = _point_from_lanes(kind, out, shape, b)
     return xla_curve.neg(res) if neg else res
+
+
+# ---------------------------------------------------------------------------
+# Fp6 / Fp12 tower on the lane layout (formulas mirror ops/tower.py, which is
+# itself pinned to the host golden code and LoE mainnet vectors).
+#
+# Deliberate duplication: unlike the group law (shared via FieldFns/DevCurve),
+# the tower formulas live in both engines; tower.py is hard-wired to the XLA
+# limb namespace.  The bit-exact equivalence suite (test_ops_pallas*.py)
+# pins the two engines to each other — a one-sided formula edit fails there.
+# ---------------------------------------------------------------------------
+
+
+def pf2_mul_fp(a, k):
+    r = pf_mul_many([(a[0], k), (a[1], k)])
+    return (r[0], r[1])
+
+
+def pf2_conj(a):
+    return (a[0], pf_neg(a[1]))
+
+
+def pf2_mul_xi(a):
+    return (pf_sub(a[0], a[1]), pf_add(a[0], a[1]))
+
+
+def pf2_inv(a):
+    """1/a via one Fermat pow chain on the norm (getbit from the context —
+    the exponent p-2 enters kernels as a scalar-prefetch bit array)."""
+    t = pf_mul_many([(a[0], a[0]), (a[1], a[1])])
+    norm = pf_add(t[0], t[1])
+    ninv = _pow_math(_CTX["invbit"], norm, INV_NBITS)
+    r = pf_mul_many([(a[0], ninv), (a[1], ninv)])
+    return (r[0], pf_neg(r[1]))
+
+
+INV_NBITS = (FP_P - 2).bit_length()
+_INV_BITS_NP = None  # built lazily
+
+
+def _inv_bits():
+    global _INV_BITS_NP
+    if _INV_BITS_NP is None:
+        _INV_BITS_NP = _exp_bits_np(FP_P - 2)
+    return _INV_BITS_NP
+
+
+def pf6_add(a, b):
+    r = pf_add_many([(x[0], y[0]) for x, y in zip(a, b)]
+                    + [(x[1], y[1]) for x, y in zip(a, b)])
+    return tuple((r[i], r[3 + i]) for i in range(3))
+
+
+def pf6_sub(a, b):
+    r = pf_sub_many([(x[0], y[0]) for x, y in zip(a, b)]
+                    + [(x[1], y[1]) for x, y in zip(a, b)])
+    return tuple((r[i], r[3 + i]) for i in range(3))
+
+
+def pf6_neg(a):
+    return tuple(pf2_neg(x) for x in a)
+
+
+def pf6_mul_many(pairs):
+    """k Fp6 products, Karatsuba-3: 6k Fp2 products in one pf2_mul_many."""
+    k = len(pairs)
+    pre = pf_add_many(
+        [pr for a, b in pairs for pr in (
+            (a[1][0], a[2][0]), (a[1][1], a[2][1]),
+            (b[1][0], b[2][0]), (b[1][1], b[2][1]),
+            (a[0][0], a[1][0]), (a[0][1], a[1][1]),
+            (b[0][0], b[1][0]), (b[0][1], b[1][1]),
+            (a[0][0], a[2][0]), (a[0][1], a[2][1]),
+            (b[0][0], b[2][0]), (b[0][1], b[2][1]),
+        )])
+    prods = []
+    for i, (a, b) in enumerate(pairs):
+        o = i * 12
+        prods += [(a[0], b[0]), (a[1], b[1]), (a[2], b[2]),
+                  ((pre[o + 0], pre[o + 1]), (pre[o + 2], pre[o + 3])),
+                  ((pre[o + 4], pre[o + 5]), (pre[o + 6], pre[o + 7])),
+                  ((pre[o + 8], pre[o + 9]), (pre[o + 10], pre[o + 11]))]
+    t = pf2_mul_many(prods)
+    out = []
+    for i in range(k):
+        t0, t1, t2, tc12, tc01, tc02 = t[6 * i:6 * i + 6]
+        c0 = pf2_add(t0, pf2_mul_xi(pf2_sub(pf2_sub(tc12, t1), t2)))
+        c1 = pf2_add(pf2_sub(pf2_sub(tc01, t0), t1), pf2_mul_xi(t2))
+        c2 = pf2_add(pf2_sub(pf2_sub(tc02, t0), t2), t1)
+        out.append((c0, c1, c2))
+    return out
+
+
+def pf6_mul(a, b):
+    return pf6_mul_many([(a, b)])[0]
+
+
+def pf6_mul_by_v(a):
+    return (pf2_mul_xi(a[2]), a[0], a[1])
+
+
+def pf6_inv(a):
+    a0, a1, a2 = a
+    t = pf2_mul_many([(a0, a0), (a1, a2), (a2, a2), (a0, a1), (a1, a1), (a0, a2)])
+    sq0, m12, sq2, m01, sq1, m02 = t
+    c0 = pf2_sub(sq0, pf2_mul_xi(m12))
+    c1 = pf2_sub(pf2_mul_xi(sq2), m01)
+    c2 = pf2_sub(sq1, m02)
+    u = pf2_mul_many([(a1, c2), (a2, c1), (a0, c0)])
+    tt = pf2_add(pf2_mul_xi(pf2_add(u[0], u[1])), u[2])
+    tinv = pf2_inv(tt)
+    r = pf2_mul_many([(c0, tinv), (c1, tinv), (c2, tinv)])
+    return (r[0], r[1], r[2])
+
+
+def pf6_zeros(shape=()):
+    z = pf2_zeros(shape)
+    return (z, z, z)
+
+
+def pf6_ones(shape=()):
+    return (pf2_ones(shape), pf2_zeros(shape), pf2_zeros(shape))
+
+
+def pf12_ones(shape=()):
+    return (pf6_ones(shape), pf6_zeros(shape))
+
+
+def pf12_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t = pf6_mul_many([(a0, b0), (a1, b1), (pf6_add(a0, a1), pf6_add(b0, b1))])
+    t0, t1, t2 = t
+    return (pf6_add(t0, pf6_mul_by_v(t1)), pf6_sub(pf6_sub(t2, t0), t1))
+
+
+def pf12_sqr(a):
+    a0, a1 = a
+    t = pf6_mul_many([(a0, a1), (pf6_add(a0, a1), pf6_add(a0, pf6_mul_by_v(a1)))])
+    tt, c0 = t
+    c0 = pf6_sub(pf6_sub(c0, tt), pf6_mul_by_v(tt))
+    return (c0, pf6_add(tt, tt))
+
+
+def pf12_conj(a):
+    return (a[0], pf6_neg(a[1]))
+
+
+def pf12_inv(a):
+    a0, a1 = a
+    t = pf6_mul_many([(a0, a0), (a1, a1)])
+    tt = pf6_sub(t[0], pf6_mul_by_v(t[1]))
+    tinv = pf6_inv(tt)
+    r = pf6_mul_many([(a0, tinv), (a1, tinv)])
+    return (r[0], pf6_neg(r[1]))
+
+
+def pf12_frobenius(a, j: int):
+    (c0, c2, c4), (c1, c3, c5) = a
+    cs = [c0, c1, c2, c3, c4, c5]
+    if j & 1:
+        cs = [pf2_conj(c) for c in cs]
+    out = pf2_mul_many([(c, (_c(f"frob{j}_{i}_0"), _c(f"frob{j}_{i}_1")))
+                        for i, c in enumerate(cs)])
+    return ((out[0], out[2], out[4]), (out[1], out[3], out[5]))
+
+
+# ---------------------------------------------------------------------------
+# Pairing: projective Miller loop + final exponentiation (mirrors
+# ops/pairing.py step-for-step; replaces the last latency-bound XLA chains
+# of the verification pipeline — at RLC batch the pairing runs on 2 lanes,
+# pure latency, so the fused kernels win ~100x there)
+# ---------------------------------------------------------------------------
+
+from ..crypto.host.params import X as _BLS_X
+
+_XLOOP_BITS_NP = np.array([int(bch) for bch in bin(-_BLS_X)[3:]], dtype=np.int32)
+_XLOOP_NBITS = len(_XLOOP_BITS_NP)          # 63
+
+
+def _pf2_triple(a):
+    return pf2_add(pf2_add(a, a), a)
+
+
+def _pf_dbl_step(Rp):
+    Rx, Ry, Rz = Rp
+    b2 = (_c("b2_0"), _c("b2_1"))
+    s1 = pf2_mul_many(
+        [(Ry, Ry), (Rz, Rz), (pf2_add(Ry, Rz), pf2_add(Ry, Rz)), (Rx, Rx), (Rx, Ry)])
+    t0, t1, u, v, m = s1
+    t2 = _pf2_triple(pf2_mul(t1, b2))
+    t3 = _pf2_triple(t2)
+    t4 = pf2_sub(pf2_sub(u, t1), t0)
+    ell = (pf2_sub(t2, t0), _pf2_triple(v), pf2_neg(t4))
+    half = _c("half")
+    hs = pf_mul_many([(pf2_add(t0, t3)[0], half), (pf2_add(t0, t3)[1], half),
+                      (pf2_sub(t0, t3)[0], half), (pf2_sub(t0, t3)[1], half)])
+    hh = (hs[0], hs[1])
+    g = (hs[2], hs[3])
+    s3 = pf2_mul_many([(hh, hh), (t2, t2), (g, m), (t0, t4)])
+    Ry2 = pf2_sub(s3[0], _pf2_triple(s3[1]))
+    return (s3[2], Ry2, s3[3]), ell
+
+
+def _pf_add_step(Rp, Q):
+    Rx, Ry, Rz = Rp
+    Qx, Qy = Q
+    s1 = pf2_mul_many([(Qy, Rz), (Qx, Rz)])
+    t0 = pf2_sub(Ry, s1[0])
+    t1 = pf2_sub(Rx, s1[1])
+    s2 = pf2_mul_many([(t0, Qx), (t1, Qy), (t1, t1), (t0, t0)])
+    ell = (pf2_sub(s2[0], s2[1]), pf2_neg(t0), t1)
+    t2 = s2[2]
+    s3 = pf2_mul_many([(t2, t1), (t2, Rx), (s2[3], Rz)])
+    t3, t4, t0sqRz = s3
+    t5 = pf2_add(pf2_sub(t3, pf2_add(t4, t4)), t0sqRz)
+    s4 = pf2_mul_many([(t1, t5), (pf2_sub(t4, t5), t0), (t3, Ry), (Rz, t3)])
+    return (s4[0], pf2_sub(s4[1], s4[2]), s4[3]), ell
+
+
+def _pf_apply_line(f, ell, px, py):
+    o1 = pf2_mul_fp(ell[1], px)
+    o4 = pf2_mul_fp(ell[2], py)
+    z = pf2_zeros(px.shape[-1:])
+    sp = ((ell[0], o1, z), (z, o4, z))
+    return pf12_mul(f, sp)
+
+
+def _miller_math(getbit, px, py, q2, nbits: int):
+    shape = px.shape[-1:]
+    f0 = pf12_ones(shape)
+    R0 = (q2[0], q2[1], pf2_ones(shape))
+
+    def step(i, carry):
+        f, Rp = carry
+        f = pf12_sqr(f)
+        Rp, ell = _pf_dbl_step(Rp)
+        f = _pf_apply_line(f, ell, px, py)
+
+        def add_branch(args):
+            fa, Ra = args
+            Ra, ell_a = _pf_add_step(Ra, q2)
+            return _pf_apply_line(fa, ell_a, px, py), Ra
+
+        return _maybe_cond(getbit(i), add_branch, (f, Rp))
+
+    f, _ = jax.lax.fori_loop(0, nbits, step, (f0, R0))
+    return pf12_conj(f)
+
+
+def _finalexp_math(getxbit, f):
+    # easy part: f^((p^6-1)(p^2+1))
+    f = pf12_mul(pf12_conj(f), pf12_inv(f))
+    f = pf12_mul(pf12_frobenius(f, 2), f)
+
+    def pow_x(g):
+        # g^x for x < 0 (cyclotomic: inverse == conjugate); |x| has hw 6,
+        # zero bits skip their multiply via the scalar cond
+        def step(i, acc):
+            acc = pf12_sqr(acc)
+            return _maybe_cond(getxbit(i), lambda a: pf12_mul(a, g), acc)
+
+        return pf12_conj(jax.lax.fori_loop(0, _XLOOP_NBITS, step, g))
+
+    e1 = pf12_mul(pow_x(f), pf12_conj(f))
+    e1 = pf12_mul(pow_x(e1), pf12_conj(e1))
+    e2 = pf12_mul(pow_x(e1), pf12_frobenius(e1, 1))
+    e3 = pf12_mul(pf12_mul(pow_x(pow_x(e2)), pf12_frobenius(e2, 2)),
+                  pf12_conj(e2))
+    f3 = pf12_mul(pf12_sqr(f), f)
+    return pf12_mul(e3, f3)
+
+
+def _flat12(f):
+    return [x for c6 in f for c2 in c6 for x in c2]
+
+
+def _pack12(arrs):
+    it = iter(arrs)
+    fp2 = lambda: (next(it), next(it))
+    fp6 = lambda: (fp2(), fp2(), fp2())
+    return (fp6(), fp6())
+
+
+# The fp12 final-exp body holds several live fp12 values; at 256 lanes its
+# VMEM footprint exceeds the 16M scoped limit, so the pairing kernels run on
+# 128-lane tiles (their batches are tiny anyway — 2 lanes in the RLC path).
+PAIR_TILE = 128
+_BUNDLE_SPEC3 = lambda: pl.BlockSpec((NCONST, NL, PAIR_TILE),
+                                     lambda i, *_: (0, 0, 0))
+
+
+@lru_cache(maxsize=None)
+def _miller_call(btot: int):
+    def kernel(bits_ref, consts_ref, *refs):
+        with _kernel_consts(consts=consts_ref[:, :, 0:1]):
+            ins, outs = refs[:6], refs[6:]
+            px, py = ins[0][:], ins[1][:]
+            q2 = ((ins[2][:], ins[3][:]), (ins[4][:], ins[5][:]))
+            f = _miller_math(lambda i: bits_ref[i], px, py, q2, _XLOOP_NBITS)
+            for o, v in zip(outs, _flat12(f)):
+                o[:] = v
+
+    spec = pl.BlockSpec((NL, PAIR_TILE), lambda i, b: (0, i))
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(btot // PAIR_TILE,),
+        in_specs=[_BUNDLE_SPEC3()] + [spec] * 6,
+        out_specs=[spec] * 12,
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=[jax.ShapeDtypeStruct((NL, btot), U32)] * 12)
+
+
+@lru_cache(maxsize=None)
+def _miller_direct():
+    @jax.jit
+    def run(bits, *arrs):
+        px, py = arrs[0], arrs[1]
+        q2 = ((arrs[2], arrs[3]), (arrs[4], arrs[5]))
+        f = _miller_math(lambda i: bits[i], px, py, q2, _XLOOP_NBITS)
+        return tuple(_flat12(f))
+
+    return run
+
+
+@lru_cache(maxsize=None)
+def _finalexp_call(btot: int):
+    def kernel(xbits_ref, invbits_ref, consts_ref, *refs):
+        with _kernel_consts(consts=consts_ref[:, :, 0:1],
+                            invbit=lambda i: invbits_ref[i]):
+            ins, outs = refs[:12], refs[12:]
+            f = _pack12([r[:] for r in ins])
+            out = _finalexp_math(lambda i: xbits_ref[i], f)
+            for o, v in zip(outs, _flat12(out)):
+                o[:] = v
+
+    spec = pl.BlockSpec((NL, PAIR_TILE), lambda i, b1, b2: (0, i))
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(btot // PAIR_TILE,),
+        in_specs=[pl.BlockSpec((NCONST, NL, PAIR_TILE),
+                               lambda i, b1, b2: (0, 0, 0))]
+        + [spec] * 12,
+        out_specs=[spec] * 12,
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=[jax.ShapeDtypeStruct((NL, btot), U32)] * 12)
+
+
+@lru_cache(maxsize=None)
+def _finalexp_direct():
+    @jax.jit
+    def run(xbits, invbits, *arrs):
+        with _kernel_consts(invbit=lambda i: invbits[i]):
+            f = _pack12(list(arrs))
+            return tuple(_flat12(_finalexp_math(lambda i: xbits[i], f)))
+
+    return run
+
+
+def miller_loop(px, py, q2):
+    """Drop-in for pairing.miller_loop (XLA layout in/out)."""
+    flat_in = [px, py, q2[0][0], q2[0][1], q2[1][0], q2[1][1]]
+    shape = px.shape[:-1]
+    b = int(np.prod(shape)) if shape else 1
+    lanes = [_to_lanes(x, PAIR_TILE)[0] for x in flat_in]
+    btot = lanes[0].shape[1]
+    bits = jnp.asarray(_XLOOP_BITS_NP)
+    if _use_kernels():
+        out = _miller_call(btot)(bits, _const_bundle(PAIR_TILE), *lanes)
+    else:
+        out = _miller_direct()(bits, *lanes)
+    leaves = [_from_lanes(x, shape, b) for x in out]
+    return _pack12(leaves)
+
+
+def final_exponentiation(f):
+    """Drop-in for pairing.final_exponentiation (XLA layout in/out)."""
+    flat_in = _flat12(f)
+    shape = flat_in[0].shape[:-1]
+    b = int(np.prod(shape)) if shape else 1
+    lanes = [_to_lanes(x, PAIR_TILE)[0] for x in flat_in]
+    btot = lanes[0].shape[1]
+    xbits = jnp.asarray(_XLOOP_BITS_NP)
+    invbits = jnp.asarray(_inv_bits())
+    if _use_kernels():
+        out = _finalexp_call(btot)(xbits, invbits,
+                                   _const_bundle(PAIR_TILE), *lanes)
+    else:
+        out = _finalexp_direct()(xbits, invbits, *lanes)
+    leaves = [_from_lanes(x, shape, b) for x in out]
+    return _pack12(leaves)
+
+
+# ---------------------------------------------------------------------------
+# Point-sum tree reduction: collapse a point batch across the lane axis
+# inside one kernel (replaces DevCurve.sum_points' log2(n) XLA rounds, each
+# a separate latency-bound dispatch).  Grid tiles reduce to one point per
+# tile; the caller folds the (few) per-tile partials in XLA.
+# ---------------------------------------------------------------------------
+
+
+def _sum_tile_math(kind: str, pt):
+    """Reduce a (…, 24, W) point across lanes: log2(W) rotate-and-add levels
+    at CONSTANT width (Mosaic rejects the narrowing layouts a halving tree
+    produces below 128 lanes).  Lane 0 holds the sum afterwards; the other
+    lanes carry partial garbage.  W must be a power of two."""
+    curve = _curve_of(kind)
+    w = _flat_point(pt)[0].shape[-1]
+    assert w & (w - 1) == 0, "rotate-and-add reduction needs power-of-two width"
+    sh = w // 2
+    while sh >= 1:
+        rolled = jax.tree.map(lambda t: jnp.roll(t, -sh, axis=-1), pt)
+        pt = curve.add(pt, rolled)
+        sh //= 2
+    return pt
+
+
+@lru_cache(maxsize=None)
+def _sum_call(kind: str, btot: int):
+    nc = _ncoord(kind)
+
+    def kernel(p_ref, one_ref, *refs):
+        with _kernel_consts(p=p_ref[:, 0:1], one=one_ref[:, 0:1]):
+            ins, outs = refs[:nc], refs[nc:]
+            pt = _pack_point(kind, [r[:] for r in ins])
+            acc = _sum_tile_math(kind, pt)
+            # a (24, 1) output tile violates Mosaic's lane-tiling minimum —
+            # broadcast lane 0 (the sum) across the tile; the caller reads
+            # lane 0 of each tile (strided slice in XLA)
+            for o, v in zip(outs, _flat_point(acc)):
+                o[:] = jnp.broadcast_to(v[..., 0:1], (NL, TILE))
+
+    spec = pl.BlockSpec((NL, TILE), lambda i: (0, i))
+    gs = pl.GridSpec(
+        grid=(btot // TILE,),
+        in_specs=[pl.BlockSpec((NL, TILE), lambda i: (0, 0))] * 2
+        + [spec] * nc,
+        out_specs=[spec] * nc,
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=[jax.ShapeDtypeStruct((NL, btot), U32)] * nc)
+
+
+def sum_points(kind: str, p):
+    """Drop-in for DevCurve.sum_points (leading-axis point reduction)."""
+    from . import curve as DC
+    xla_curve = DC.G1_DEV if kind == "G1" else DC.G2_DEV
+    shape = _flat_point(p)[0].shape[:-1]
+    if len(shape) != 1 or not _use_kernels():
+        return None                                  # caller falls back to XLA
+    arrs, _, b = _point_to_lanes(p)
+    btot = arrs[0].shape[1]
+    # pad lanes beyond n are all-zero: Z = 0 reads as infinity, inert
+    out = _sum_call(kind, btot)(_P_FULL, _ONE_FULL, *arrs)
+    out = [x[:, ::TILE] for x in out]                # lane 0 of each tile
+    partials = _point_from_lanes(kind, out, (btot // TILE,), btot // TILE)
+    # fold the per-tile partials (few) with the XLA complete add
+    acc = jax.tree.map(lambda t: t[0], partials)
+    for i in range(1, btot // TILE):
+        acc = xla_curve.add(acc, jax.tree.map(lambda t: t[i], partials))
+    return acc

@@ -35,7 +35,7 @@ if os.environ.get("PALLAS_AXON_POOL_IPS"):
 # persistent cache on CPU — see above).  Run them LAST so a time-bounded
 # run still exercises the whole framework first.
 _HEAVY = ("test_batch", "test_multichip", "test_ops_curve_pairing",
-          "test_partials")
+          "test_partials", "test_ops_pallas")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,84 @@
+"""Pallas pairing + point-sum chain math vs host golden (see
+test_ops_pallas.py for the field/ladder half; split so the two compile-heavy
+halves land on different xdist workers)."""
+
+import pytest
+
+from drand_tpu.ops import limbs as L
+from drand_tpu.ops import curve as DC
+from drand_tpu.ops import pallas_field as PF
+from drand_tpu.crypto.host.params import P, G1_GEN
+
+
+@pytest.fixture(autouse=True)
+def _interp_mode(monkeypatch):
+    monkeypatch.setenv("DRAND_TPU_PALLAS", "interp")
+    yield
+
+
+class TestPairing:
+    """Pallas pairing chain math (direct XLA lowering) vs host golden.
+
+    Raw Miller-loop values are implementation-defined up to subfield factors
+    (projective line scalings) that the final exponentiation kills, so only
+    the post-final-exp value is compared."""
+
+    def test_full_pairing_matches_host(self):
+        import random
+        from drand_tpu.crypto.host import curve as C
+        from drand_tpu.crypto.host import pairing as HP
+        from drand_tpu.crypto.host.params import R
+        from drand_tpu.ops import tower as T
+
+        random.seed(7)
+        ks = [random.randrange(1, R) for _ in range(2)]
+        g1s = [C.G1.mul(C.G1.gen, k) for k in ks]
+        g2s = [C.G2.mul(C.G2.gen, k) for k in ks]
+        px = L.encode_mont([p[0] for p in g1s])
+        py = L.encode_mont([p[1] for p in g1s])
+        qx = (L.encode_mont([q[0][0] for q in g2s]),
+              L.encode_mont([q[0][1] for q in g2s]))
+        qy = (L.encode_mont([q[1][0] for q in g2s]),
+              L.encode_mont([q[1][1] for q in g2s]))
+        e = PF.final_exponentiation(PF.miller_loop(px, py, (qx, qy)))
+        dec = T.decode_fp12(e)
+        want = [HP.pairing(p1, q2) for p1, q2 in zip(g1s, g2s)]
+
+        def row(d, i):
+            return tuple(tuple((c0[i], c1[i]) for c0, c1 in c6) for c6 in d)
+
+        for i in range(2):
+            assert row(dec, i) == want[i]
+
+    def test_pairing_bilinearity_identity(self):
+        """e(P, Q) * e(-P, Q) == 1 through the dispatched device path."""
+        from drand_tpu.crypto.host import curve as C
+        from drand_tpu.ops import pairing as DP
+
+        p1 = C.G1.mul(C.G1.gen, 5)
+        q2 = C.G2.mul(C.G2.gen, 7)
+        px = L.encode_mont([p1[0], p1[0]])
+        py = L.encode_mont([p1[1], (-p1[1]) % P])
+        qx = (L.encode_mont([q2[0][0]] * 2), L.encode_mont([q2[0][1]] * 2))
+        qy = (L.encode_mont([q2[1][0]] * 2), L.encode_mont([q2[1][1]] * 2))
+        ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
+        assert bool(ok)
+
+
+class TestSumPoints:
+    def test_sum_tile_math_matches_host(self):
+        import secrets
+        from drand_tpu.crypto.host import curve as HC2
+        import numpy as np2
+
+        pts = [HC2.G1.mul(G1_GEN, secrets.randbelow(1 << 48)) for _ in range(7)]
+        pts += [None]  # infinity in the batch; 8 = power-of-two width
+        arrs, shape, b = PF._point_to_lanes(DC.encode_g1_points(pts))
+        pt = PF._pack_point("G1", [a[:, :len(pts)] for a in arrs])
+        acc = PF._sum_tile_math("G1", pt)
+        got = DC.decode_g1_points(
+            tuple(x[:, 0][None, :] for x in PF._flat_point(acc)))[0]
+        want = None
+        for p in pts:
+            want = HC2.G1.add(want, p)
+        assert got == want
