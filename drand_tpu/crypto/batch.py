@@ -14,9 +14,13 @@ which is sound except with probability ~2^-SECURITY_BITS, because pk is the
 same point for every round of a chain.  On RLC failure we fall back to exact
 per-round pairing checks to locate the bad rounds.
 
-Host/device split: SHA-256 digests, point (de)compression and Lagrange/RLC
-scalar arithmetic mod r stay on host; all curve/pairing algebra runs on
-device.  Batch sizes are padded to powers of two to bound recompiles.
+Host/device split (this is a single-host-core environment — per-element
+Python or C is the bottleneck): SHA-256 digests / hash-to-field run in one
+threadable native C call; wire signatures are split into limb arrays with
+pure numpy; the y-coordinate recovery (the sqrt of decompression) runs ON
+DEVICE inside the pipelines, batched through the Pallas pow kernel.  All
+curve/pairing algebra is device-side.  Batch sizes are padded to powers of
+two to bound recompiles.
 """
 
 import secrets
@@ -41,6 +45,74 @@ _MIN_BATCH = 8
 _NEG_G1 = C.G1.neg(G1_GEN)
 _NEG_G2 = C.G2.neg(G2_GEN)
 
+# Wire-parse constants: canonical (non-Montgomery) generator x limbs + sign
+# flags for substituting malformed/padding slots, and p for range checks.
+_can_limbs = lambda x: np.asarray(L.int_to_limbs(x))
+_mont_limbs = lambda x: np.asarray(L.int_to_limbs(x * L.R_MONT % P))
+_P_WORDS = _can_limbs(P)
+_GEN_X_G1 = _can_limbs(G1_GEN[0])
+_GEN_SIGN_G1 = np.uint32(S._y_is_larger_fp(G1_GEN[1]))
+_GEN_X_G2 = np.stack([_can_limbs(G2_GEN[0][0]), _can_limbs(G2_GEN[0][1])])
+_GEN_SIGN_G2 = np.uint32(S._y_is_larger_fp2(G2_GEN[1]))
+# in-pipeline generator substitute (Montgomery Jacobian, z = 1)
+_GEN_JAC_G1 = (_mont_limbs(G1_GEN[0]), _mont_limbs(G1_GEN[1]), _mont_limbs(1))
+_GEN_JAC_G2 = ((_mont_limbs(G2_GEN[0][0]), _mont_limbs(G2_GEN[0][1])),
+               (_mont_limbs(G2_GEN[1][0]), _mont_limbs(G2_GEN[1][1])),
+               (_mont_limbs(1), _can_limbs(0)))
+
+
+def _ge_p(limbs: np.ndarray) -> np.ndarray:
+    """x >= p over (n, 24) little-endian limb arrays (host range check)."""
+    diff = limbs.astype(np.int64) - _P_WORDS.astype(np.int64)[None]
+    nz = diff != 0
+    any_nz = nz.any(axis=1)
+    top = 23 - np.argmax(nz[:, ::-1], axis=1)
+    return np.where(any_nz, diff[np.arange(len(limbs)), top] > 0, True)
+
+
+def _wire_parse(sigs, g2: bool):
+    """Compressed wire signatures -> (x limb array, sign bits, bad mask),
+    all pure numpy.  x: (n, 24) for G1, (n, 2, 24) [x0, x1] for G2."""
+    n = len(sigs)
+    nb = 96 if g2 else 48
+    bad = np.zeros(n, dtype=bool)
+    if all(len(s) == nb for s in sigs):
+        arr = np.frombuffer(b"".join(bytes(s) for s in sigs),
+                            np.uint8).reshape(n, nb).copy()
+    else:
+        arr = np.zeros((n, nb), np.uint8)
+        for i, sig in enumerate(sigs):
+            if len(sig) == nb:
+                arr[i] = np.frombuffer(bytes(sig), np.uint8)
+            else:
+                bad[i] = True
+    flags = arr[:, 0]
+    bad |= (flags & 0x80) == 0
+    bad |= (flags & 0x40) != 0                  # infinity: invalid signature
+    sign = ((flags >> 5) & 1).astype(np.uint32)
+    arr[:, 0] &= 0x1F
+
+    def words(block):                           # 48 BE bytes -> 24 LE limbs
+        w = (block[:, ::2].astype(np.uint32) << 8) | block[:, 1::2]
+        return np.ascontiguousarray(w[:, ::-1])
+
+    if g2:
+        x1 = words(arr[:, :48])                 # wire order: c1 then c0
+        x0 = words(arr[:, 48:])
+        bad |= _ge_p(x0) | _ge_p(x1)
+        return np.stack([x0, x1], axis=1), sign, bad
+    x = words(arr)
+    bad |= _ge_p(x)
+    return x, sign, bad
+
+
+def _pad_msgs(msgs, pad: int):
+    """Pad a message list to `pad` entries; keeps lengths uniform when they
+    already are (the native h2f batch path requires equal lengths)."""
+    pad_msg = b"\x00" * len(msgs[0]) if msgs and \
+        all(len(m) == len(msgs[0]) for m in msgs) else b""
+    return list(msgs) + [pad_msg] * (pad - len(msgs))
+
 
 def _pad_len(n: int) -> int:
     m = _MIN_BATCH
@@ -50,8 +122,14 @@ def _pad_len(n: int) -> int:
 
 
 def _rlc_scalars(n: int, pad: int):
-    ks = [secrets.randbits(SECURITY_BITS) for _ in range(n)] + [0] * (pad - n)
-    return DC.scalars_to_bits(ks, nbits=SECURITY_BITS)
+    # numpy PCG seeded with 128 bits of OS entropy: the randomizers only
+    # need to be unpredictable to the adversary, and the Python-int path
+    # costs ~35us/round of host time at scale
+    rng = np.random.default_rng(secrets.randbits(128))
+    raw = rng.integers(0, 256, size=(pad, SECURITY_BITS // 8), dtype=np.uint8)
+    raw[n:] = 0
+    bits = np.unpackbits(raw, axis=1)            # MSB-first per byte
+    return jax.numpy.asarray(np.ascontiguousarray(bits.T, dtype=np.uint32))
 
 
 # ---------------------------------------------------------------------------
@@ -59,9 +137,21 @@ def _rlc_scalars(n: int, pad: int):
 # across calls of the same padded size thanks to jit's shape cache)
 # ---------------------------------------------------------------------------
 
-def _rlc_run_g2sig(sig_jac, u0, u1, bits, pk_aff, neg_g1_aff):
+def _gen_sub(curve, gen, pt, ok):
+    """Replace slots whose decompression failed with the generator so they
+    cannot poison the RLC; the returned ok mask carries the verdict."""
+    shape = curve.f.batch_shape(curve._leaf(pt[0]))
+    genb = jax.tree.map(
+        lambda c: jax.numpy.broadcast_to(jax.numpy.asarray(c),
+                                         shape + (L.NLIMB,)), gen)
+    return curve._select(ok, pt, genb)
+
+
+def _rlc_run_g2sig(sig_x, sign, u0, u1, bits, pk_aff, neg_g1_aff):
     """Scheme family with sigs on G2, keys on G1 (chained/unchained)."""
-    sub_ok = DC.g2_in_subgroup(sig_jac)
+    sig_jac, parse_ok = DH.g2_recover_y(sig_x[0], sig_x[1], sign)
+    sig_jac = _gen_sub(DC.G2_DEV, _GEN_JAC_G2, sig_jac, parse_ok)
+    sub_ok = DC.g2_in_subgroup(sig_jac) & parse_ok
     hm = DH.hash_to_g2_jac(u0, u1)
     # one ladder for both MSMs: stack sigs and H(m)s along the batch axis
     both = jax.tree.map(lambda a, b: jax.numpy.concatenate([a, b], 0), sig_jac, hm)
@@ -81,9 +171,11 @@ def _rlc_run_g2sig(sig_jac, u0, u1, bits, pk_aff, neg_g1_aff):
     return sub_ok, ok
 
 
-def _rlc_run_g1sig(sig_jac, u0, u1, bits, pk_aff, neg_g2_aff):
+def _rlc_run_g1sig(sig_x, sign, u0, u1, bits, pk_aff, neg_g2_aff):
     """Short-sig scheme: sigs on G1, keys on G2."""
-    sub_ok = DC.g1_in_subgroup(sig_jac)
+    sig_jac, parse_ok = DH.g1_recover_y(sig_x, sign)
+    sig_jac = _gen_sub(DC.G1_DEV, _GEN_JAC_G1, sig_jac, parse_ok)
+    sub_ok = DC.g1_in_subgroup(sig_jac) & parse_ok
     hm = DH.hash_to_g1_jac(u0, u1)
     both = jax.tree.map(lambda a, b: jax.numpy.concatenate([a, b], 0), sig_jac, hm)
     bits2 = jax.numpy.concatenate([bits, bits], axis=1)
@@ -102,11 +194,13 @@ def _rlc_run_g1sig(sig_jac, u0, u1, bits, pk_aff, neg_g2_aff):
     return sub_ok, ok
 
 
-def _exact_run_g2sig(sig_jac, u0, u1, pk_aff, neg_g1_aff):
+def _exact_run_g2sig(sig_x, sign, u0, u1, pk_aff, neg_g1_aff):
     """Per-round exact check (fallback path): e(-g1,S_i)·e(pk,H_i) == 1."""
-    sub_ok = DC.g2_in_subgroup(sig_jac)
+    sig_jac, parse_ok = DH.g2_recover_y(sig_x[0], sig_x[1], sign)
+    sig_jac = _gen_sub(DC.G2_DEV, _GEN_JAC_G2, sig_jac, parse_ok)
+    sub_ok = DC.g2_in_subgroup(sig_jac) & parse_ok
     hm = DH.hash_to_g2_jac(u0, u1)
-    sx, sy, s_inf = DC.G2_DEV.to_affine(sig_jac)
+    sx, sy, _ = DC.G2_DEV.to_affine(sig_jac)
     hx, hy, _ = DC.G2_DEV.to_affine(hm)
     n = u0[0].shape[0]
     px = jax.numpy.stack([jax.numpy.broadcast_to(neg_g1_aff[0], (n, L.NLIMB)),
@@ -116,13 +210,15 @@ def _exact_run_g2sig(sig_jac, u0, u1, pk_aff, neg_g1_aff):
     qx = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), sx, hx)
     qy = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), sy, hy)
     ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
-    return sub_ok & ~s_inf & ok
+    return sub_ok & ok
 
 
-def _exact_run_g1sig(sig_jac, u0, u1, pk_aff, neg_g2_aff):
-    sub_ok = DC.g1_in_subgroup(sig_jac)
+def _exact_run_g1sig(sig_x, sign, u0, u1, pk_aff, neg_g2_aff):
+    sig_jac, parse_ok = DH.g1_recover_y(sig_x, sign)
+    sig_jac = _gen_sub(DC.G1_DEV, _GEN_JAC_G1, sig_jac, parse_ok)
+    sub_ok = DC.g1_in_subgroup(sig_jac) & parse_ok
     hm = DH.hash_to_g1_jac(u0, u1)
-    sx, sy, s_inf = DC.G1_DEV.to_affine(sig_jac)
+    sx, sy, _ = DC.G1_DEV.to_affine(sig_jac)
     hx, hy, _ = DC.G1_DEV.to_affine(hm)
     n = u0.shape[0]
     # e(S, -g2) · e(H_i, pk) == 1
@@ -134,7 +230,7 @@ def _exact_run_g1sig(sig_jac, u0, u1, pk_aff, neg_g2_aff):
     qy = jax.tree.map(lambda a, b: jax.numpy.stack([bc(a), bc(b)]),
                       neg_g2_aff[1], pk_aff[1])
     ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
-    return sub_ok & ~s_inf & ok
+    return sub_ok & ok
 
 
 @lru_cache(maxsize=None)
@@ -183,40 +279,40 @@ class BatchBeaconVerifier:
 
     # -- host-side packing ---------------------------------------------------
 
-    def _parse_sigs(self, sigs):
-        """Decompress sig bytes (no subgroup check — that's the device's job).
-
-        Returns (host affine points with generator filling malformed slots,
-        malformed mask)."""
-        pts, bad = [], np.zeros(len(sigs), dtype=bool)
-        gen = G2_GEN if self.g2sig else G1_GEN
-        from_bytes = S.g2_from_bytes if self.g2sig else S.g1_from_bytes
-        for i, sb in enumerate(sigs):
-            try:
-                pt = from_bytes(bytes(sb), check_subgroup=False)
-                if pt is None:
-                    raise ValueError("infinity signature")
-            except (ValueError, AssertionError):
-                pt, bad[i] = gen, True
-            pts.append(pt)
-        return pts, bad
-
     def _messages(self, rounds, prev_sigs):
         if self.scheme.chained:
             return [self.scheme.digest_beacon(r, p) for r, p in zip(rounds, prev_sigs)]
         return [self.scheme.digest_beacon(r, None) for r in rounds]
 
-    def _encode(self, pts, msgs, pad):
-        gen = G2_GEN if self.g2sig else G1_GEN
-        pts = pts + [gen] * (pad - len(pts))
-        msgs = msgs + [b""] * (pad - len(msgs))
+    def _encode(self, sigs, msgs, pad):
+        """Host packing, O(1) Python ops: numpy wire parse (x limbs + sign
+        flags; y recovery happens on device in the pipelines) and batched
+        hash-to-field.  Malformed and padding slots carry the generator
+        encoding — inert (zero RLC coefficient / discarded exact result),
+        with the verdict in the returned bad mask."""
+        import jax.numpy as jnp
+        n = len(sigs)
+        xw, sign, bad = _wire_parse(sigs, self.g2sig)
+        gx = _GEN_X_G2 if self.g2sig else _GEN_X_G1
+        gsign = _GEN_SIGN_G2 if self.g2sig else _GEN_SIGN_G1
+        xshape = (pad, 2, L.NLIMB) if self.g2sig else (pad, L.NLIMB)
+        full_x = np.empty(xshape, np.uint32)
+        full_sign = np.empty(pad, np.uint32)
+        full_x[:n], full_sign[:n] = xw, sign
+        full_x[:n][bad] = gx
+        full_sign[:n][bad] = gsign
+        full_x[n:] = gx
+        full_sign[n:] = gsign
         if self.g2sig:
-            sig_jac = DC.encode_g2_points(pts)
-            u0, u1 = DH.hash_msgs_to_field_g2(msgs, self.scheme.dst)
+            sig_x = (jnp.asarray(full_x[:, 0]), jnp.asarray(full_x[:, 1]))
         else:
-            sig_jac = DC.encode_g1_points(pts)
-            u0, u1 = DH.hash_msgs_to_field_g1(msgs, self.scheme.dst)
-        return sig_jac, u0, u1
+            sig_x = jnp.asarray(full_x)
+        pmsgs = _pad_msgs(msgs, pad)
+        if self.g2sig:
+            u0, u1 = DH.hash_msgs_to_field_g2(pmsgs, self.scheme.dst)
+        else:
+            u0, u1 = DH.hash_msgs_to_field_g1(pmsgs, self.scheme.dst)
+        return (sig_x, jnp.asarray(full_sign), u0, u1), bad
 
     # -- verification ---------------------------------------------------------
 
@@ -270,19 +366,20 @@ class BatchBeaconVerifier:
 
     def _rlc_ok(self, enc, n) -> bool:
         """One RLC check over an encoded range; True iff all n rounds verify."""
-        sig_jac, u0, u1 = enc
         bits = _rlc_scalars(n, _pad_len(n))
-        (sig_jac, u0, u1), bits = self._shard_round_axis((sig_jac, u0, u1),
-                                                         bits)
+        enc, bits = self._shard_round_axis(enc, bits)
+        sig_x, sign, u0, u1 = enc
         pipe = _rlc_pipeline_g2sig() if self.g2sig else _rlc_pipeline_g1sig()
-        sub_ok, ok = pipe(sig_jac, u0, u1, bits, self.pk_aff, self.fixed_aff)
+        sub_ok, ok = pipe(sig_x, sign, u0, u1, bits,
+                          self.pk_aff, self.fixed_aff)
         return bool(ok) and np.asarray(sub_ok)[:n].all()
 
     def _exact(self, enc, n) -> np.ndarray:
         """Per-round exact pairing checks over an encoded range."""
-        sig_jac, u0, u1 = enc
+        sig_x, sign, u0, u1 = enc
         pipe = _exact_pipeline_g2sig() if self.g2sig else _exact_pipeline_g1sig()
-        return np.asarray(pipe(sig_jac, u0, u1, self.pk_aff, self.fixed_aff))[:n]
+        return np.asarray(pipe(sig_x, sign, u0, u1,
+                               self.pk_aff, self.fixed_aff))[:n]
 
     # Below this range size a failed RLC goes straight to exact checks;
     # above it, bisect with RLC halves so one bad round costs O(log n) RLC
@@ -316,8 +413,7 @@ class BatchBeaconVerifier:
         if prev_sigs is None:
             prev_sigs = [None] * n
         msgs = self._messages(rounds, prev_sigs)
-        pts, bad = self._parse_sigs(sigs)
-        enc = self._encode(pts, msgs, _pad_len(n))
+        enc, bad = self._encode(sigs, msgs, _pad_len(n))
         return self._verify_range(enc, 0, n, bad)
 
     def verify_chain(self, beacons):
@@ -363,7 +459,7 @@ def sign_batch(scheme: Scheme, secret: int, msgs) -> list:
     n = len(msgs)
     pad = _pad_len(n)
     g2sig = scheme.sig_group is GroupG2
-    pmsgs = list(msgs) + [b""] * (pad - n)
+    pmsgs = _pad_msgs(msgs, pad)
     if g2sig:
         u0, u1 = DH.hash_msgs_to_field_g2(pmsgs, scheme.dst)
     else:
@@ -397,6 +493,37 @@ def _affine_g2_to_host(x, y):
 # (replaces kyber tbls.Recover at chainstore.go:202 for bulk aggregation)
 # ---------------------------------------------------------------------------
 
+def _decompress_grid(sig_grid, t: int, nr: int, g2sig: bool):
+    """(rounds, t) wire sigs -> stacked (t, nr) Jacobian device point.
+
+    One native C batch call when available (Montgomery limbs in the device
+    layout, no Python bigints); falls back to the per-point host decoder."""
+    from .host import native
+    flat = [bytes(sig_grid[r][j]) for j in range(t) for r in range(nr)]
+    if native.available():
+        import jax.numpy as jnp
+        dec = native.g2_decompress_limbs_batch if g2sig \
+            else native.g1_decompress_limbs_batch
+        limbs, ok = dec(flat)
+        if not ok.all():
+            raise ValueError("invalid partial signature encoding")
+        nc = 4 if g2sig else 2
+        coords = [jnp.asarray(limbs[:, c].reshape(t, nr, L.NLIMB))
+                  for c in range(nc)]
+        one = jnp.asarray(np.broadcast_to(_mont_limbs(1), (t, nr, L.NLIMB)))
+        if g2sig:
+            zero = jnp.zeros((t, nr, L.NLIMB), jnp.uint32)
+            return ((coords[0], coords[1]), (coords[2], coords[3]),
+                    (one, zero))
+        return (coords[0], coords[1], one)
+    from_bytes = S.g2_from_bytes if g2sig else S.g1_from_bytes
+    enc = DC.encode_g2_points if g2sig else DC.encode_g1_points
+    rows = [[from_bytes(flat[j * nr + r], check_subgroup=False)
+             for r in range(nr)] for j in range(t)]
+    return jax.tree.map(lambda *rs: jax.numpy.stack(rs),
+                        *[enc(row) for row in rows])
+
+
 @lru_cache(maxsize=None)
 def _recover_pipeline(g2sig: bool):
     def run(part_jac, bits):
@@ -418,21 +545,13 @@ def recover_batch(scheme: Scheme, indices, partial_sigs) -> list:
     nr = len(indices)
     t = len(indices[0])
     g2sig = scheme.sig_group is GroupG2
-    from_bytes = S.g2_from_bytes if g2sig else S.g1_from_bytes
-    # host: Lagrange coefficients and point decompression
+    # host: Lagrange coefficients (Python ints mod r, t*nr of them)
     lams = np.zeros((t, nr), dtype=object)
-    pts = []
     for r in range(nr):
         idxs = indices[r]
         for j in range(t):
             lams[j][r] = HT._lagrange_coeff(idxs, idxs[j])
-    for j in range(t):
-        row = [from_bytes(bytes(partial_sigs[r][j]), check_subgroup=False)
-               for r in range(nr)]
-        pts.append(row)
-    enc = DC.encode_g2_points if g2sig else DC.encode_g1_points
-    part_jac = jax.tree.map(
-        lambda *rows: jax.numpy.stack(rows), *[enc(row) for row in pts])
+    part_jac = _decompress_grid(partial_sigs, t, nr, g2sig)
     flat = [int(lams[j][r]) for j in range(t) for r in range(nr)]
     bits = DC.scalars_to_bits(flat, nbits=256).reshape(256, t, nr)
     x, y, _ = _recover_pipeline(g2sig)(part_jac, bits)

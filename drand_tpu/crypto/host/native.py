@@ -214,3 +214,64 @@ def g2_decompress(comp: bytes, check_subgroup: bool = True):
                                    out) != 0:
         raise ValueError("invalid G2 point encoding")
     return _g2_from_aff(out.raw)
+
+
+# -- batch limb packing (the TPU-pipeline fast path) -------------------------
+#
+# These return (n, k, 24) uint32 arrays of MONTGOMERY limbs in the device
+# engine's exact layout (ops/limbs.py) — the C side splits its internal
+# Montgomery words directly, so no bigint arithmetic happens in Python.
+
+import numpy as _np
+
+
+def g1_decompress_limbs_batch(sigs: Sequence[bytes], nthreads: int = 0):
+    """48B wire sigs -> ((n, 2, 24) u32 Montgomery affine limbs, ok mask).
+
+    No subgroup check (done batched on device); infinity counts as bad."""
+    n = len(sigs)
+    buf = b"".join(bytes(s) for s in sigs)
+    out = _np.empty((n, 2, 24), dtype=_np.uint32)
+    ok = _np.empty(n, dtype=_np.uint8)
+    lib().ntv_g1_decompress_limbs_batch(
+        n, buf, out.ctypes.data_as(ctypes.c_void_p),
+        ok.ctypes.data_as(ctypes.c_void_p), nthreads)
+    return out, ok.astype(bool)
+
+
+def g2_decompress_limbs_batch(sigs: Sequence[bytes], nthreads: int = 0):
+    """96B wire sigs -> ((n, 4, 24) u32 limbs: x0 x1 y0 y1, ok mask)."""
+    n = len(sigs)
+    buf = b"".join(bytes(s) for s in sigs)
+    out = _np.empty((n, 4, 24), dtype=_np.uint32)
+    ok = _np.empty(n, dtype=_np.uint8)
+    lib().ntv_g2_decompress_limbs_batch(
+        n, buf, out.ctypes.data_as(ctypes.c_void_p),
+        ok.ctypes.data_as(ctypes.c_void_p), nthreads)
+    return out, ok.astype(bool)
+
+
+def h2f_fp_limbs_batch(msgs: Sequence[bytes], dst: bytes, nthreads: int = 0):
+    """hash_to_field count=2 over Fp for equal-length msgs -> (n, 2, 24)."""
+    n = len(msgs)
+    ml = len(msgs[0])
+    buf = b"".join(msgs)
+    assert len(buf) == n * ml, "h2f batch requires equal-length messages"
+    out = _np.empty((n, 2, 24), dtype=_np.uint32)
+    lib().ntv_h2f_fp_limbs_batch(
+        n, buf, ml, bytes(dst), len(dst),
+        out.ctypes.data_as(ctypes.c_void_p), nthreads)
+    return out
+
+
+def h2f_fp2_limbs_batch(msgs: Sequence[bytes], dst: bytes, nthreads: int = 0):
+    """hash_to_field count=2 over Fp2 -> (n, 4, 24): u0.c0 u0.c1 u1.c0 u1.c1."""
+    n = len(msgs)
+    ml = len(msgs[0])
+    buf = b"".join(msgs)
+    assert len(buf) == n * ml, "h2f batch requires equal-length messages"
+    out = _np.empty((n, 4, 24), dtype=_np.uint32)
+    lib().ntv_h2f_fp2_limbs_batch(
+        n, buf, ml, bytes(dst), len(dst),
+        out.ctypes.data_as(ctypes.c_void_p), nthreads)
+    return out

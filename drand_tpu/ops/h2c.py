@@ -20,6 +20,7 @@ Design notes:
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from . import limbs as L
 from . import tower as T
@@ -237,7 +238,14 @@ def map_to_g2_jac(u):
 # ---------------------------------------------------------------------------
 
 def hash_msgs_to_field_g1(msgs, dst=DST_G1):
-    """Host: messages -> (u0_batch, u1_batch) Montgomery limb tensors."""
+    """Host: messages -> (u0_batch, u1_batch) Montgomery limb tensors.
+
+    Equal-length batches go through the native C batch path (one call,
+    threaded, limbs emitted directly in the device layout)."""
+    from ..crypto.host import native
+    if native.available() and msgs and all(len(m) == len(msgs[0]) for m in msgs):
+        h = native.h2f_fp_limbs_batch([bytes(m) for m in msgs], dst)
+        return jnp.asarray(h[:, 0]), jnp.asarray(h[:, 1])
     u0s, u1s = [], []
     for m in msgs:
         u0, u1 = hash_to_field_fp(m, dst, 2)
@@ -247,6 +255,11 @@ def hash_msgs_to_field_g1(msgs, dst=DST_G1):
 
 
 def hash_msgs_to_field_g2(msgs, dst=DST_G2):
+    from ..crypto.host import native
+    if native.available() and msgs and all(len(m) == len(msgs[0]) for m in msgs):
+        h = native.h2f_fp2_limbs_batch([bytes(m) for m in msgs], dst)
+        return ((jnp.asarray(h[:, 0]), jnp.asarray(h[:, 1])),
+                (jnp.asarray(h[:, 2]), jnp.asarray(h[:, 3])))
     c = [[], [], [], []]
     for m in msgs:
         (a0, a1), (b0, b1) = hash_to_field_fp2(m, dst, 2)
@@ -279,3 +292,51 @@ def hash_to_g1_jac(u0, u1):
     q1 = jax.tree.map(lambda t: t[n:], q)
     r = DC.G1_DEV.add(q0, q1)
     return DC.g1_clear_cofactor(r)
+
+
+# ---------------------------------------------------------------------------
+# Device-side signature decompression: wire x-coordinate + sign flag -> point.
+#
+# The reference decompresses on CPU (one sqrt each, kilic asm); here the host
+# only splits bytes into limb arrays (pure numpy, see crypto/batch.py) and
+# the batched sqrt chain runs on device — this single-host-core environment
+# makes per-point host work the bottleneck otherwise.
+# ---------------------------------------------------------------------------
+
+_HALF1_DEV = jnp.asarray(np.asarray(L.int_to_limbs((P + 1) // 2)))
+
+
+def g1_recover_y(x_can, sign_bit):
+    """x (canonical limbs, batch), sign flag (0/1) -> (Jacobian point, ok).
+
+    ok is False where x**3 + 4 is a non-residue (not on curve); y parity
+    follows the zcash larger-half convention (host serialize.py:18-19)."""
+    xm = L.to_mont(x_can)
+    b = jnp.broadcast_to(DC.G1_DEV.b, xm.shape)
+    y2 = L.add_mod(L.mont_mul(L.mont_sqr(xm), xm), b)
+    y = fp_sqrt(y2)
+    ok = L.eq(L.mont_sqr(y), y2)
+    larger = _fp_ge_half1(y)
+    flip = larger ^ (sign_bit == 1)
+    y = L.select(flip, L.neg_mod(y), y)
+    one = jnp.broadcast_to(L.ONE_M, xm.shape)
+    return (xm, y, one), ok
+
+
+def g2_recover_y(x0_can, x1_can, sign_bit):
+    xm = (L.to_mont(x0_can), L.to_mont(x1_can))
+    b = jax.tree.map(lambda c: jnp.broadcast_to(c, xm[0].shape), DC.G2_DEV.b)
+    y2 = T.fp2_add(T.fp2_mul(T.fp2_sqr(xm), xm), b)
+    y = fp2_sqrt(y2)
+    ok = T.fp2_eq(T.fp2_sqr(y), y2)
+    c1_zero = L.is_zero(L.from_mont(y[1]))
+    larger = jnp.where(c1_zero, _fp_ge_half1(y[0]), _fp_ge_half1(y[1]))
+    flip = larger ^ (sign_bit == 1)
+    y = T.fp2_select(flip, T.fp2_neg(y), y)
+    return (xm, y, T.fp2_ones(xm[0].shape[:-1])), ok
+
+
+def _fp_ge_half1(y_mont):
+    """canonical(y) > (p-1)/2  ==  canonical(y) >= (p+1)/2."""
+    y_can = L.from_mont(y_mont)
+    return L.ge(y_can, jnp.broadcast_to(_HALF1_DEV, y_can.shape))

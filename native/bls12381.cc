@@ -2091,3 +2091,131 @@ int ntv_g2_decompress_aff(const uint8_t comp[96], int check_subgroup,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Batch packing exports for the TPU pipelines (drand_tpu/crypto/batch.py).
+//
+// Limb format: per Fp, 24 uint32 base-2^16 little-endian limbs of the
+// MONTGOMERY representative (R = 2^384) — byte-identical to the device
+// engine's layout (ops/limbs.py), so these arrays feed the jitted pipelines
+// with no host-side bigint work at all.  Threaded over the batch.
+// ---------------------------------------------------------------------------
+
+#include <thread>
+
+static void fp_to_limbs24_mont(uint32_t *o, const fp &m) {
+  for (int i = 0; i < 6; i++) {
+    uint64_t w = m.l[i];
+    o[4 * i + 0] = (uint32_t)(w & 0xffff);
+    o[4 * i + 1] = (uint32_t)((w >> 16) & 0xffff);
+    o[4 * i + 2] = (uint32_t)((w >> 32) & 0xffff);
+    o[4 * i + 3] = (uint32_t)((w >> 48) & 0xffff);
+  }
+}
+
+template <typename F>
+static void run_batch(int n, int nthreads, F f) {
+  if (nthreads <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    nthreads = hc ? (int)hc : 1;
+  }
+  if (nthreads > 8) nthreads = 8;   // ts[] capacity
+  if (nthreads <= 1 || n < 64) {
+    f(0, n);
+    return;
+  }
+  std::thread ts[8];
+  int per = (n + nthreads - 1) / nthreads;
+  int t = 0;
+  for (int lo = 0; lo < n; lo += per, t++) {
+    int hi = lo + per > n ? n : lo + per;
+    ts[t] = std::thread(f, lo, hi);
+  }
+  for (int i = 0; i < t; i++) ts[i].join();
+}
+
+extern "C" {
+
+// comp: n*48 bytes -> out: n*2*24 u32 Montgomery limbs (x, y); ok[i] in {0,1}
+// (failure or infinity -> 0 with zeroed slot).  No subgroup check (the
+// device pipeline performs it batched).
+int ntv_g1_decompress_limbs_batch(int n, const uint8_t *comp, uint32_t *out,
+                                  uint8_t *ok, int nthreads) {
+  run_batch(n, nthreads, [&](int lo, int hi) {
+    for (int i = lo; i < hi; i++) {
+      g1p pt;
+      uint32_t *o = out + (size_t)i * 48;
+      if (!g1_decompress(pt, comp + (size_t)48 * i, 0) || g1_is_inf(pt)) {
+        memset(o, 0, 48 * sizeof(uint32_t));
+        ok[i] = 0;
+        continue;
+      }
+      fp_to_limbs24_mont(o, pt.x);        // decompress emits z = 1
+      fp_to_limbs24_mont(o + 24, pt.y);
+      ok[i] = 1;
+    }
+  });
+  return 0;
+}
+
+// comp: n*96 bytes -> out: n*4*24 u32 limbs (x0, x1, y0, y1)
+int ntv_g2_decompress_limbs_batch(int n, const uint8_t *comp, uint32_t *out,
+                                  uint8_t *ok, int nthreads) {
+  run_batch(n, nthreads, [&](int lo, int hi) {
+    for (int i = lo; i < hi; i++) {
+      g2p pt;
+      uint32_t *o = out + (size_t)i * 96;
+      if (!g2_decompress(pt, comp + (size_t)96 * i, 0) || g2_is_inf(pt)) {
+        memset(o, 0, 96 * sizeof(uint32_t));
+        ok[i] = 0;
+        continue;
+      }
+      fp_to_limbs24_mont(o, pt.x.c0);
+      fp_to_limbs24_mont(o + 24, pt.x.c1);
+      fp_to_limbs24_mont(o + 48, pt.y.c0);
+      fp_to_limbs24_mont(o + 72, pt.y.c1);
+      ok[i] = 1;
+    }
+  });
+  return 0;
+}
+
+// RFC 9380 hash_to_field with count=2 over Fp (h2c.py:39-41):
+// msgs: n*msg_len -> out: n*2*24 limbs (u0, u1)
+int ntv_h2f_fp_limbs_batch(int n, const uint8_t *msgs, int msg_len,
+                           const uint8_t *dst, int dst_len, uint32_t *out,
+                           int nthreads) {
+  run_batch(n, nthreads, [&](int lo, int hi) {
+    uint8_t buf[128];
+    for (int i = lo; i < hi; i++) {
+      expand_message_xmd(buf, 128, msgs + (size_t)i * msg_len, msg_len,
+                         dst, dst_len);
+      fp u0, u1;
+      fp_from_64bytes(u0, buf);
+      fp_from_64bytes(u1, buf + 64);
+      fp_to_limbs24_mont(out + (size_t)i * 48, u0);
+      fp_to_limbs24_mont(out + (size_t)i * 48 + 24, u1);
+    }
+  });
+  return 0;
+}
+
+// count=2 over Fp2 (h2c.py:44-52): out: n*4*24 limbs (u0.c0, u0.c1, u1.c0, u1.c1)
+int ntv_h2f_fp2_limbs_batch(int n, const uint8_t *msgs, int msg_len,
+                            const uint8_t *dst, int dst_len, uint32_t *out,
+                            int nthreads) {
+  run_batch(n, nthreads, [&](int lo, int hi) {
+    uint8_t buf[256];
+    for (int i = lo; i < hi; i++) {
+      expand_message_xmd(buf, 256, msgs + (size_t)i * msg_len, msg_len,
+                         dst, dst_len);
+      fp e[4];
+      for (int j = 0; j < 4; j++) fp_from_64bytes(e[j], buf + 64 * j);
+      for (int j = 0; j < 4; j++)
+        fp_to_limbs24_mont(out + (size_t)i * 96 + 24 * j, e[j]);
+    }
+  });
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,81 @@
+"""Stage-level wall-clock profile of the batch-verify pipeline on the chip.
+
+Each stage is jitted separately (axon adds ~0.1s dispatch per call — noted
+in the numbers), so this is for RELATIVE stage weights, not absolutes.
+Usage: python tools/chip_profile.py [N]
+"""
+import sys, time
+import jax
+jax.config.update("jax_compilation_cache_dir", "/tmp/drand_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from drand_tpu.crypto import batch, schemes
+from drand_tpu.ops import curve as DC, h2c as DH, limbs as L, pairing as DP
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+
+sch = schemes.scheme_from_name(schemes.SHORT_SIG_SCHEME_ID)
+sec, pub = sch.keypair(seed=b"profile")
+verifier = batch.BatchBeaconVerifier(sch, sch.public_bytes(pub))
+rounds = list(range(1, N + 1))
+msgs = [sch.digest_beacon(r, None) for r in rounds]
+sigs = batch.sign_batch(sch, sec, msgs)
+(sig_x, sign, u0, u1), bad = verifier._encode(sigs, msgs, batch._pad_len(N))
+bits = batch._rlc_scalars(N, batch._pad_len(N))
+
+def timeit(name, fn, *args):
+    out = jax.block_until_ready(fn(*args))     # compile + run
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*args))
+    print(f"{name:28s} {1e3*(time.perf_counter()-t0):8.1f} ms", flush=True)
+    return out
+
+def recover(sig_x, sign):
+    return DH.g1_recover_y(sig_x, sign)
+
+sig_jac, _ = timeit("decompress (device y)", jax.jit(recover), sig_x, sign)
+sub_ok = timeit("subgroup check", jax.jit(DC.g1_in_subgroup), sig_jac)
+hm = timeit("hash_to_g1 (h2c)", jax.jit(DH.hash_to_g1_jac), u0, u1)
+
+def rlc_ladder(sig_jac, hm, bits):
+    both = jax.tree.map(lambda a, b: jnp.concatenate([a, b], 0), sig_jac, hm)
+    bits2 = jnp.concatenate([bits, bits], axis=1)
+    return DC.G1_DEV.scalar_mul_bits(both, bits2)
+
+mult = timeit("RLC ladder (2N x 128b)", jax.jit(rlc_ladder), sig_jac, hm, bits)
+
+def sums(mult):
+    n = bits.shape[1]
+    A = DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[:n], mult))
+    B = DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[n:], mult))
+    return A, B
+
+AB = timeit("point sums (2 trees)", jax.jit(sums), mult)
+
+def affine(AB):
+    A, B = AB
+    ax, ay, _ = DC.G1_DEV.to_affine(A)
+    bx, by, _ = DC.G1_DEV.to_affine(B)
+    return ax, ay, bx, by
+
+aff = timeit("to_affine (2 pts)", jax.jit(affine), AB)
+
+def pairing_check(aff):
+    ax, ay, bx, by = aff
+    px = jnp.stack([ax, bx])
+    py = jnp.stack([ay, by])
+    qx = jax.tree.map(lambda a, b: jnp.stack([a, b]),
+                      verifier.fixed_aff[0], verifier.pk_aff[0])
+    qy = jax.tree.map(lambda a, b: jnp.stack([a, b]),
+                      verifier.fixed_aff[1], verifier.pk_aff[1])
+    return DP.paired_product_is_one(px, py, (qx, qy), 2)
+
+ok = timeit("pairing product check", jax.jit(pairing_check), aff)
+print("verified:", bool(ok))
+
+t0 = time.perf_counter()
+okf = verifier.verify_batch(rounds, sigs)
+print(f"{'full verify_batch (warm)':28s} {1e3*(time.perf_counter()-t0):8.1f} ms  all={bool(okf.all())}")
