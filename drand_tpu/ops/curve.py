@@ -328,6 +328,29 @@ def g1_phi(p):
     return (L.mont_mul(_BETA_DEV, X1), Y1, Z1)
 
 
+def g1_glv_msm_terms(p, bits0, bits1):
+    """(k0 + lambda*k1)-weighted points for the RLC (lambda = -x^2 mod r,
+    the phi eigenvalue).  64-step joint double-and-add; dispatches to the
+    fused Pallas GLV kernel when enabled."""
+    from . import pallas_field as PF
+    if PF.enabled():
+        return PF.scalar_mul_glv_g1(p, bits0, bits1)
+    phi = g1_phi(p)
+    p3 = G1_DEV.add(p, phi)
+    acc0 = G1_DEV.infinity(G1_DEV.f.batch_shape(G1_DEV._leaf(p[0])))
+
+    def step(acc, bb):
+        b0, b1 = bb
+        acc = G1_DEV.double(acc)
+        t = G1_DEV._select(b0 == 1, G1_DEV._select(b1 == 1, p3, p),
+                           G1_DEV._select(b1 == 1, phi, p))
+        added = G1_DEV.add(acc, t)
+        return G1_DEV._select((b0 | b1) == 1, added, acc), None
+
+    acc, _ = jax.lax.scan(step, acc0, (bits0, bits1))
+    return acc
+
+
 def g2_in_subgroup(p):
     """Q in G2 <=> psi(Q) == [x]Q (batch).  Infinity counts as member."""
     lhs = g2_psi(p)

@@ -76,6 +76,7 @@ def _const_entries():
     ents = [("p", np.asarray(L.int_to_limbs(FP_P))),
             ("one", _mont_np(1)),
             ("half", _mont_np((FP_P + 1) // 2)),
+            ("beta", _mont_np(pow(2, (FP_P - 1) // 3, FP_P))),
             ("b2_0", _mont_np(B2[0])), ("b2_1", _mont_np(B2[1]))]
     for j in (1, 2):
         for i, c in enumerate(HFhost._FROB[j]):
@@ -1175,3 +1176,106 @@ def sum_points(kind: str, p):
     for i in range(1, btot // TILE):
         acc = xla_curve.add(acc, jax.tree.map(lambda t: t[i], partials))
     return acc
+
+
+# ---------------------------------------------------------------------------
+# GLV joint ladder for G1 RLC coefficients: k = k0 + lambda*k1 with uniform
+# 64-bit halves (lambda = -x^2 mod r, the phi eigenvalue: ops/curve.py
+# g1_in_subgroup identity).  64 double+add steps instead of 128 — the RLC
+# randomizers are SAMPLED in split form, so no decomposition is needed and
+# per-coefficient soundness stays 2^-128 (the map (k0,k1) -> k0+lambda*k1
+# is injective on [0,2^64)^2).
+# ---------------------------------------------------------------------------
+
+
+def _ladder_glv_math(getrow0, getrow1, pt, phi, p3, nbits: int):
+    """Joint ladder over precomputed tables {P, phi(P), P+phi(P)} (the
+    tables are built OUTSIDE the kernel in XLA — the in-kernel beta multiply
+    and table add crashed the Mosaic compiler)."""
+    curve = G1_PF
+    acc0 = curve.infinity((_flat_point(pt)[0].shape[-1],))
+
+    def sel(cond, a, b):
+        return jax.tree.map(lambda x, y: jnp.where(cond, x, y), a, b)
+
+    def step(i, acc):
+        acc = curve.double(acc)
+        b0 = getrow0(i) == 1                        # (1, B)
+        b1 = getrow1(i) == 1
+        t = sel(b0, sel(b1, p3, pt), sel(b1, phi, pt))
+        added = curve.add(acc, t)
+        return sel(b0 | b1, added, acc)
+
+
+
+    return jax.lax.fori_loop(0, nbits, step, acc0)
+
+
+@lru_cache(maxsize=None)
+def _ladder_glv_call(nbits: int, btot: int):
+    def kernel(p_ref, one_ref, *refs):
+        with _kernel_consts(p=p_ref[:, 0:1], one=one_ref[:, 0:1]):
+            ins, outs = refs[:9], refs[11:]
+            b0_ref, b1_ref = refs[9], refs[10]
+            pt = tuple(r[:] for r in ins[:3])
+            phi = tuple(r[:] for r in ins[3:6])
+            p3 = tuple(r[:] for r in ins[6:9])
+            acc = _ladder_glv_math(lambda i: b0_ref[pl.ds(i, 1), :],
+                                   lambda i: b1_ref[pl.ds(i, 1), :],
+                                   pt, phi, p3, nbits)
+            for o, v in zip(outs, _flat_point(acc)):
+                o[:] = v
+
+    spec = pl.BlockSpec((NL, TILE), lambda i: (0, i))
+    bspec = pl.BlockSpec((nbits, TILE), lambda i: (0, i))
+    gs = pl.GridSpec(
+        grid=(btot // TILE,),
+        in_specs=[pl.BlockSpec((NL, TILE), lambda i: (0, 0))] * 2
+        + [spec] * 9 + [bspec, bspec],
+        out_specs=[spec] * 3,
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=[jax.ShapeDtypeStruct((NL, btot), U32)] * 3)
+
+
+@lru_cache(maxsize=None)
+def _ladder_glv_direct(nbits: int):
+    @jax.jit
+    def run(b0, b1, *arrs):
+        pt, phi, p3 = tuple(arrs[:3]), tuple(arrs[3:6]), tuple(arrs[6:9])
+        sl = lambda b: (lambda i: jax.lax.dynamic_slice_in_dim(b, i, 1, 0))
+        return tuple(_flat_point(
+            _ladder_glv_math(sl(b0), sl(b1), pt, phi, p3, nbits)))
+
+    return run
+
+
+def scalar_mul_glv_g1(p, bits0, bits1):
+    """(k0 + lambda*k1)-weighted points, bits MSB-first (nbits,) + batch.
+
+    The {P, phi(P), P+phi(P)} tables are built in XLA (one wide multiply and
+    one complete add); the 64-step joint ladder is the fused kernel."""
+    from . import curve as DC
+    phi = DC.g1_phi(p)
+    p3 = DC.G1_DEV.add(p, phi)
+    flat = list(p) + list(phi) + list(p3)
+    arrs = []
+    shape = b = None
+    for x in flat:
+        lx, shape, b = _to_lanes(x)
+        arrs.append(lx)
+    nbits = bits0.shape[0]
+    btot = arrs[0].shape[1]
+
+    def prep(bits):
+        bt = bits.reshape(nbits, b).astype(U32)
+        return jnp.pad(bt, ((0, 0), (0, btot - b))) if btot != b else bt
+
+    b0, b1 = prep(bits0), prep(bits1)
+    if _use_kernels():
+        out = _ladder_glv_call(nbits, btot)(_P_FULL, _ONE_FULL,
+                                            *arrs, b0, b1)
+    else:
+        out = _ladder_glv_direct(nbits)(b0, b1, *arrs)
+    return _point_from_lanes("G1", out, shape, b)

@@ -112,3 +112,30 @@ class TestKernels:
         got = DC.G1_DEV.scalar_mul_fixed(p, 5)
         assert DC.decode_g1_points(got) == [
             HC.G1.mul(pt, 5) for pt in pts]
+
+
+class TestGLV:
+    def test_glv_msm_terms_match_host(self):
+        import secrets
+        import numpy as np2
+        from drand_tpu.crypto.host.params import R as ORDER_R, X as BLS_X
+
+        lam = (-BLS_X * BLS_X) % ORDER_R          # phi eigenvalue: -x^2 mod r
+        pts = [HC.G1.mul(G1_GEN, secrets.randbelow(1 << 60)) for _ in range(3)]
+        k0s = [secrets.randbits(10) for _ in range(3)]
+        k1s = [secrets.randbits(10) for _ in range(3)]
+        p = DC.encode_g1_points(pts)
+        b0 = DC.scalars_to_bits(k0s, nbits=10)
+        b1 = DC.scalars_to_bits(k1s, nbits=10)
+        got = PF.scalar_mul_glv_g1(p, b0, b1)     # direct path on CPU
+        want = [HC.G1.mul(pt, (k0 + lam * k1) % ORDER_R)
+                for pt, k0, k1 in zip(pts, k0s, k1s)]
+        assert DC.decode_g1_points(got) == want
+        # XLA fallback path agrees
+        import os
+        os.environ["DRAND_TPU_PALLAS"] = "0"
+        try:
+            got2 = DC.g1_glv_msm_terms(p, b0, b1)
+        finally:
+            os.environ["DRAND_TPU_PALLAS"] = "interp"
+        assert DC.decode_g1_points(got2) == want
