@@ -93,9 +93,19 @@ class ChainStore:
             self._new_beacon.notify_all()
 
     def wait_for_round(self, round_: int, timeout: float) -> Optional[Beacon]:
-        """Block until the chain reaches `round_` (real-time timeout)."""
+        """Block until the chain reaches `round_`.
+
+        The timeout is *starvation-aware*: on a loaded box (e.g. sibling
+        test workers cold-compiling XLA programs on the one host core) a
+        0.1 s condition wait can take seconds of wall time while this
+        process is descheduled.  Charging raw wall time against the
+        deadline makes tests flake exactly when the machine is busy — so
+        each iteration charges at most 2x the requested wait, i.e. the
+        deadline counts (mostly-)scheduled time.  A hard wall cap of 20x
+        still bounds genuine deadlocks."""
         import time as _t
-        deadline = _t.monotonic() + timeout
+        charged = 0.0
+        wall_deadline = _t.monotonic() + 20 * timeout
         while True:
             try:
                 last = self.last()
@@ -108,11 +118,13 @@ class ChainStore:
                         return None  # trimmed/skipped (e.g. memdb ring buffer)
             except ErrNoBeaconStored:
                 pass
-            remaining = deadline - _t.monotonic()
-            if remaining <= 0:
+            if charged >= timeout or _t.monotonic() >= wall_deadline:
                 return None
+            step = min(timeout - charged, 0.1)
+            t0 = _t.monotonic()
             with self._new_beacon:
-                self._new_beacon.wait(min(remaining, 0.1))
+                self._new_beacon.wait(step)
+            charged += min(_t.monotonic() - t0, 2 * step)
 
     # -- aggregation ---------------------------------------------------------
 

@@ -89,6 +89,14 @@ class Handler:
             partial_verifier=cfg.verifier_factory(
                 self.scheme, self.vault.get_pub(), len(cfg.group)))
         self.ticker = Ticker(cfg.clock, cfg.group.period, cfg.group.genesis_time)
+        # Fast-forward on each stored beacon (node.go:368-403): while the
+        # chain lags the wall-clock round, every new beacon immediately
+        # triggers the next partial — catching up must not wait for the
+        # (possibly frozen fake-clock) catchup timer.  Without this, a node
+        # that consumes a tick while still aggregating the previous round
+        # never signs the ticked round and a thr-sized network deadlocks.
+        self.chain.cbstore.add_callback(
+            f"fastforward-{self.index}", self._on_beacon_stored)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._catchup_thread: Optional[threading.Thread] = None
@@ -170,6 +178,22 @@ class Handler:
                 # fast-forward us (node.go:358-367)
                 self._sync_needed(tick.round)
             self.broadcast_next_partial(last)
+
+    def _on_beacon_stored(self, beacon: Beacon) -> None:
+        """Store-driven catchup (node.go:368-403 fast-forward): if we are
+        still behind the wall clock after storing `beacon`, sign and
+        broadcast the next round's partial right away."""
+        if self._stop.is_set() or not self.running:
+            return
+        try:
+            last = self.chain.last()
+        except ErrNoBeaconStored:
+            return
+        if beacon.round != last.round:
+            return  # mid-sync backlog: only the head triggers a partial
+        self._maybe_transition()
+        if beacon.round < self.ticker.current_round():
+            self.broadcast_next_partial(beacon)
 
     def _run_catchup(self) -> None:
         """While behind the wall clock, rebroadcast the next partial every

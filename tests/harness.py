@@ -105,6 +105,13 @@ class BeaconScenario:
             f"node {index} never reached round {round_}"
         return b
 
+    def wait_all(self, round_, timeout=60):
+        """Wait until EVERY live node stored `round_` — advance the fake
+        clock only after this, or lagging nodes consume the next tick while
+        still aggregating (core/util_test.go waits all nodes the same way)."""
+        return [self.wait_round(i, round_, timeout)
+                for i in sorted(self.handlers)]
+
     def kill(self, index):
         self.net.kill(index)
         h = self.handlers.pop(index)

@@ -49,9 +49,9 @@ def served():
     sc = BeaconScenario(n=3, thr=2, period=30)
     sc.start_all()
     sc.advance_to_genesis()
-    sc.wait_round(0, 1, timeout=120)   # generous under full-suite CPU load
+    sc.wait_all(1, timeout=120)        # generous under full-suite CPU load
     sc.advance_round()
-    sc.wait_round(0, 2, timeout=120)
+    sc.wait_all(2, timeout=120)
     bp = _ShimBP(sc)
     server = RestServer(_ShimDaemon(bp), "127.0.0.1:0")
     server.start()
