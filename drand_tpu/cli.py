@@ -66,7 +66,7 @@ def cmd_start(args) -> int:
         private_listen=args.private_listen,
         public_listen=args.public_listen or "",
         control_port=args.control,
-        metrics_port=args.metrics or 0,
+        metrics_port=args.metrics,
         db_engine=args.db,
         pg_dsn=getattr(args, "pg_dsn", ""),
         insecure=not (args.tls_cert and args.tls_key),
@@ -288,7 +288,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="node-to-node gRPC bind address")
     p.add_argument("--public-listen", default="",
                    help="REST edge bind address (empty = off)")
-    p.add_argument("--metrics", type=int, default=0)
+    p.add_argument("--metrics", type=int, default=None,
+                   help="metrics HTTP port (omit = disabled; 0 = ephemeral)")
     p.add_argument("--db", default="sqlite",
                    choices=["sqlite", "memdb", "postgres"])
     p.add_argument("--pg-dsn", default=_env("pg_dsn", ""),

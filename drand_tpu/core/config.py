@@ -34,7 +34,7 @@ class Config:
     private_listen: str = "127.0.0.1:0"  # node-to-node gRPC bind
     public_listen: str = ""              # REST edge bind ("" = disabled)
     control_port: int = DEFAULT_CONTROL_PORT
-    metrics_port: int = 0                # 0 = disabled
+    metrics_port: Optional[int] = None   # None = disabled; 0 = ephemeral
     tls_cert: Optional[str] = None
     tls_key: Optional[str] = None
     trusted_certs: tuple = ()

@@ -46,7 +46,7 @@ class DrandDaemon:
         self.control = ControlListener(ControlService(self),
                                        port=cfg.control_port)
         self.metrics: Optional[MetricsServer] = None
-        if cfg.metrics_port:
+        if cfg.metrics_port is not None:
             self.metrics = MetricsServer(cfg.metrics_port,
                                          peer_metrics=self._peer_metrics)
         self.http_server = None          # attached by the REST edge (L8)
@@ -132,10 +132,22 @@ class DrandDaemon:
         return bp
 
     def _peer_metrics(self, addr: str) -> bytes:
-        """Federation: fetch a group member's metrics over gRPC
-        (metrics.go:408-492) — here via its Home endpoint's metrics twin."""
-        from ..metrics import scrape
-        return scrape("group")
+        """Federation: fetch a group member's metrics over the gRPC plane
+        (metrics.go:408-492 lazyPeerHandler).  Like the reference, only
+        known group members can be scraped — the address must appear in a
+        loaded group (metrics.go:447-459); unknown addresses 404."""
+        with self._lock:
+            procs = list(self.processes.values())
+        for bp in procs:
+            group = bp.group
+            if group is None:
+                continue
+            for node in group.nodes:
+                if node.identity.addr == addr:
+                    return self.gateway.client.metrics(
+                        Peer(node.identity.addr, node.identity.tls),
+                        bp.beacon_id)
+        raise KeyError(f"{addr} is not a member of any loaded group")
 
 
 def _route(daemon: DrandDaemon, context, metadata):
@@ -206,6 +218,18 @@ class ProtocolService:
     def status(self, req, context):
         bp = _route(self.daemon, context, req.metadata)
         return _status_response(self.daemon, bp, req)
+
+    def metrics(self, req, context):
+        """Serve the local GroupMetrics snapshot to a federating peer
+        (the reference side of net/listener.go:88).  The leading comment
+        line identifies the serving node so federated scrapes are
+        attributable."""
+        from ..metrics import scrape
+        banner = (f"# federated metrics served by "
+                  f"{self.daemon.gateway.listen_addr}\n").encode()
+        return pb.MetricsResponse(
+            metrics=banner + scrape("group"),
+            metadata=convert.metadata())
 
 
 class PublicService:

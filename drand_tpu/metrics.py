@@ -165,6 +165,9 @@ class MetricsServer:
                 except KeyError:
                     self.send_error(404)
                     return
+                except Exception as e:   # peer unreachable etc.
+                    self.send_error(502, explain=str(e))
+                    return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
                 self.end_headers()

@@ -141,6 +141,12 @@ class ProtocolClient:
                                                    tls=p.tls))
         return self._protocol(peer).status(req, timeout=self.timeout)
 
+    def metrics(self, peer: Peer, beacon_id: str = "") -> bytes:
+        """Fetch a peer's GroupMetrics snapshot (federation; the reference
+        proxies HTTP over the gRPC conn instead, client_grpc.go:352-361)."""
+        req = pb.MetricsRequest(metadata=convert.metadata(beacon_id))
+        return self._protocol(peer).metrics(req, timeout=self.timeout).metrics
+
     # -- Public service ------------------------------------------------------
 
     def public_rand(self, peer: Peer, round_: int = 0,

@@ -12,7 +12,21 @@ from ..key.group import Group, Node
 from ..key.keys import DistPublic, Identity
 from ..protos import drand_pb2 as pb
 
-VERSION = pb.NodeVersion(major=2, minor=0, patch=0)
+def _version_from_env() -> pb.NodeVersion:
+    """Advertised protocol version; DRAND_NODE_VERSION=maj.min.patch
+    overrides (mixed-version rollout testing, demo/regression/main.go)."""
+    import os
+    raw = os.environ.get("DRAND_NODE_VERSION", "")
+    if raw:
+        try:
+            maj, mino, pat = (int(x) for x in raw.split("."))
+            return pb.NodeVersion(major=maj, minor=mino, patch=pat)
+        except ValueError:
+            pass
+    return pb.NodeVersion(major=2, minor=0, patch=0)
+
+
+VERSION = _version_from_env()
 
 
 def metadata(beacon_id: str = "", chain_hash: bytes = b"") -> pb.Metadata:

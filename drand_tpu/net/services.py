@@ -16,6 +16,9 @@ PROTOCOL = ServiceSpec("drand.Protocol", [
     Method("PartialBeacon", pb.PartialBeaconPacket, pb.Empty),
     Method("SyncChain", pb.SyncRequest, pb.BeaconPacket, server_stream=True),
     Method("Status", pb.StatusRequest, pb.StatusResponse),
+    # Federation: GroupMetrics snapshot over the node-to-node plane
+    # (reference serves HTTP-over-gRPC instead: net/listener.go:88).
+    Method("Metrics", pb.MetricsRequest, pb.MetricsResponse),
 ])
 
 PUBLIC = ServiceSpec("drand.Public", [
@@ -24,6 +27,11 @@ PUBLIC = ServiceSpec("drand.Public", [
            server_stream=True),
     Method("ChainInfo", pb.ChainInfoRequest, pb.ChainInfoPacket),
     Method("Home", pb.HomeRequest, pb.HomeResponse),
+])
+
+# Relay gossip overlay (lp2p gossipsub equivalent, see drand_tpu/relay.py)
+GOSSIP = ServiceSpec("drand.Gossip", [
+    Method("Publish", pb.GossipBeaconPacket, pb.Empty),
 ])
 
 CONTROL = ServiceSpec("drand.Control", [

@@ -99,7 +99,7 @@ def _wait_round(client, addr, round_, timeout=90, beacon_id="default"):
 
 @pytest.fixture()
 def trio(tmp_path):
-    daemons = [_mk_daemon(tmp_path, i) for i in range(3)]
+    daemons = [_mk_daemon(tmp_path, i, metrics_port=0) for i in range(3)]
     yield daemons
     for d in daemons:
         d.stop()
@@ -139,6 +139,63 @@ def test_dkg_beacons_and_sync(trio):
     conns = dict(st.connections)
     assert conns[trio[1].gateway.listen_addr] is True
     assert conns["127.0.0.1:1"] is False
+
+    # metrics federation: scrape node 1's group series THROUGH node 0's
+    # /peer/<addr>/metrics route (metrics.go:408-492).  The serving-node
+    # banner proves the bytes really came from node 1 over gRPC.
+    import urllib.error
+    import urllib.request
+    addr1 = trio[1].gateway.listen_addr
+    base = f"http://127.0.0.1:{trio[0].metrics.port}"
+    body = urllib.request.urlopen(f"{base}/peer/{addr1}/metrics").read()
+    assert f"served by {addr1}".encode() in body
+    assert b"last_beacon_round" in body
+    # non-members 404 (reference: only group members are scrapable)
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(f"{base}/peer/127.0.0.1:1/metrics")
+
+
+def test_version_skew_gate(trio):
+    """Version interceptor over real gRPC (drand_daemon_interceptors.go:
+    19-89): an incompatible-major peer is rejected on both the public and
+    protocol planes; a compatible-minor mix keeps the network producing."""
+    import grpc
+
+    from drand_tpu.net import services
+
+    _run_dkg(trio, n=3, thr=2)
+    pc = ProtocolClient()
+    addr = trio[0].gateway.listen_addr
+    _wait_round(pc, addr, 1)
+
+    chan = grpc.insecure_channel(addr)
+    pub = services.PUBLIC.stub(chan)
+    proto = services.PROTOCOL.stub(chan)
+
+    def md(maj, mino=0):
+        return pb.Metadata(
+            node_version=pb.NodeVersion(major=maj, minor=mino, patch=0),
+            beaconID="default")
+
+    # incompatible major: rejected before any routing happens
+    with pytest.raises(grpc.RpcError) as ei:
+        pub.public_rand(pb.PublicRandRequest(round=1, metadata=md(3)))
+    assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+    assert "incompatible" in ei.value.details()
+
+    # an incompatible node's partials are refused on the protocol plane
+    with pytest.raises(grpc.RpcError) as ei:
+        proto.partial_beacon(pb.PartialBeaconPacket(
+            round=2, partial_sig=b"\x00\x01" + b"\x00" * 48,
+            metadata=md(3)))
+    assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+
+    # compatible minor skew (2.7) is served normally...
+    got = pub.public_rand(pb.PublicRandRequest(round=1, metadata=md(2, 7)))
+    assert got.round == 1
+    # ...and the network keeps producing beacons for it
+    nxt = _wait_round(pc, addr, 2)
+    assert nxt.round >= 2
 
 
 def test_sync_chain_stream(trio):

@@ -118,6 +118,9 @@ class _Protocol:
     def push_dkg_info(self, req, ctx):
         return pb.Empty()
 
+    def metrics(self, req, ctx):
+        return pb.MetricsResponse(metrics=b"# loopback\n")
+
     def broadcast_dkg(self, req, ctx):
         return pb.Empty()
 
