@@ -1,0 +1,89 @@
+"""Embedded DBAPI shim with the psycopg2 surface PostgresStore uses.
+
+psycopg2 (and any C postgres driver) is absent from this environment, so
+the reference's storage-matrix strategy (the same suite over bolt/memdb/
+postgres, Makefile:61-75) would leave the postgres store code forever
+unexecuted.  This shim implements the exact psycopg2 API subset the store
+consumes — connect/autocommit/context-managers/%s placeholders — over
+sqlite3, translating the few postgres-isms in the store's SQL.  Tests
+inject it via `PostgresStore(driver=...)`; against a real server the store
+uses psycopg2 unchanged, since the shim mimics psycopg2, not the reverse.
+"""
+
+import re
+import sqlite3
+import threading
+
+
+def _translate(sql: str) -> str:
+    sql = sql.replace("%s", "?")
+    sql = re.sub(r"\bSERIAL PRIMARY KEY\b",
+                 "INTEGER PRIMARY KEY AUTOINCREMENT", sql)
+    sql = re.sub(r"\bBYTEA\b", "BLOB", sql)
+    return sql
+
+
+class _Cursor:
+    def __init__(self, conn: "_Connection"):
+        self._conn = conn
+        self._cur = conn._db.cursor()
+
+    def execute(self, sql, args=()):
+        sql = _translate(sql)
+        with self._conn._lock:
+            if args == () and sql.count(";") > 1:
+                self._cur.executescript(sql)
+            else:
+                self._cur.execute(sql, tuple(args))
+        return self
+
+    def fetchone(self):
+        return self._cur.fetchone()
+
+    def fetchall(self):
+        return self._cur.fetchall()
+
+    def close(self):
+        self._cur.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class _Connection:
+    def __init__(self, dsn: str):
+        # the "dsn" is a sqlite path here; ":memory:" or a file path both work
+        path = dsn or ":memory:"
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.RLock()
+        self.autocommit = False
+
+    def cursor(self):
+        return _Cursor(self)
+
+    def commit(self):
+        self._db.commit()
+
+    def rollback(self):
+        self._db.rollback()
+
+    def close(self):
+        self._db.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        if exc_type is None:
+            self.commit()
+        else:
+            self.rollback()
+        return False
+
+
+def connect(dsn: str) -> _Connection:
+    return _Connection(dsn)

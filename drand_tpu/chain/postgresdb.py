@@ -31,16 +31,19 @@ CREATE TABLE IF NOT EXISTS beacon_ids (
 
 class PostgresStore(Store):
     def __init__(self, dsn: str, beacon_id: str = "default",
-                 require_previous: bool = False):
-        try:
-            import psycopg2  # noqa: F401
-        except ImportError as e:
-            raise RuntimeError(
-                "PostgresStore requires psycopg2, which is not available in "
-                "this environment; use the sqlite or memdb backends "
-                "(core.Config.db_engine)") from e
-        import psycopg2
-        self.conn = psycopg2.connect(dsn)
+                 require_previous: bool = False, driver=None):
+        """`driver` is any module exposing psycopg2's `connect` (tests
+        inject chain/_pgcompat.py so this store's CRUD/cursor code runs in
+        the storage matrix without a postgres server)."""
+        if driver is None:
+            try:
+                import psycopg2 as driver  # noqa: F811
+            except ImportError as e:
+                raise RuntimeError(
+                    "PostgresStore requires psycopg2, which is not available "
+                    "in this environment; use the sqlite or memdb backends "
+                    "(core.Config.db_engine), or inject a DBAPI driver") from e
+        self.conn = driver.connect(dsn)
         # reads must not pin an open transaction (VACUUM blockage /
         # idle_in_transaction timeouts on long-lived daemons)
         self.conn.autocommit = True

@@ -128,10 +128,19 @@ def _mk_chain(n, start=0):
     return out
 
 
-@pytest.fixture(params=["memdb", "sqlite", "sqlite-prev"])
+@pytest.fixture(params=["memdb", "sqlite", "sqlite-prev",
+                        "postgres", "postgres-prev"])
 def store(request, tmp_path):
+    """The reference's storage matrix (Makefile:61-75: the same suite over
+    bolt/memdb/postgres).  The postgres store runs its real CRUD/cursor
+    SQL through the embedded DBAPI shim (chain/_pgcompat.py)."""
     if request.param == "memdb":
         s = MemDBStore(buffer_size=100)
+    elif request.param.startswith("postgres"):
+        from drand_tpu.chain import _pgcompat
+        from drand_tpu.chain.postgresdb import PostgresStore
+        s = PostgresStore(str(tmp_path / "pg.db"), driver=_pgcompat,
+                          require_previous=request.param.endswith("prev"))
     else:
         s = SqliteStore(str(tmp_path / "chain.db"),
                         require_previous=request.param.endswith("prev"))
@@ -222,6 +231,39 @@ def test_sqlite_persistence(tmp_path):
     s2 = SqliteStore(path)
     assert len(s2) == 4 and s2.last().round == 3
     s2.close()
+
+
+def test_postgres_previous_reconstruction(tmp_path):
+    """Trimmed-format parity over the postgres schema: previous_sig is
+    reconstructed from round-1 (migration-1.04 behavior, pgdb.go)."""
+    from drand_tpu.chain import _pgcompat
+    from drand_tpu.chain.postgresdb import PostgresStore
+    s = PostgresStore(str(tmp_path / "pg.db"), driver=_pgcompat,
+                      require_previous=True)
+    chain = _mk_chain(5)
+    for b in chain:
+        s.put(b)
+    assert s.get(3).previous_sig == chain[2].signature
+    assert s.get(0).previous_sig is None
+    s.delete(2)
+    assert s.get(3).previous_sig is None
+    s.close()
+
+
+def test_postgres_beacon_id_isolation(tmp_path):
+    """Two beacon ids share tables but not rounds (beacon_ids join)."""
+    from drand_tpu.chain import _pgcompat
+    from drand_tpu.chain.postgresdb import PostgresStore
+    path = str(tmp_path / "pg.db")
+    a = PostgresStore(path, beacon_id="alpha", driver=_pgcompat)
+    b = PostgresStore(path, beacon_id="beta", driver=_pgcompat)
+    for bc in _mk_chain(3):
+        a.put(bc)
+    assert len(a) == 3 and len(b) == 0
+    with pytest.raises(ErrNoBeaconStored):
+        b.last()
+    a.close()
+    b.close()
 
 
 def test_postgres_store_gated():
