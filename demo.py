@@ -28,16 +28,23 @@ SECRET = b"demo-secret"
 
 
 class Node:
-    """One daemon subprocess (demo/node/node_subprocess.go pattern)."""
+    """One daemon subprocess (demo/node/node_subprocess.go pattern).
 
-    def __init__(self, folder: str, index: int):
+    `version` overrides the advertised protocol version — the
+    demo/regression/main.go upgrade scenario simulated by version skew
+    (one codebase stands in for old/new binaries)."""
+
+    def __init__(self, folder: str, index: int, version: str = "",
+                 listen: str = "127.0.0.1:0"):
         self.index = index
         self.folder = folder
         env = dict(os.environ, JAX_PLATFORMS="cpu")
+        if version:
+            env["DRAND_NODE_VERSION"] = version
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "drand_tpu.cli", "start",
              "--folder", folder, "--control", "0",
-             "--private-listen", "127.0.0.1:0", "--db", "memdb",
+             "--private-listen", listen, "--db", "memdb",
              "--no-tpu", "--dkg-timeout", "3"],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
             env=env)
@@ -116,6 +123,9 @@ def main() -> int:
     ap.add_argument("--threshold", type=int, default=2)
     ap.add_argument("--period", type=int, default=6)
     ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--regression", action="store_true",
+                    help="run the upgrade/version-skew regression after the "
+                         "basic demo (demo/regression/main.go analogue)")
     args = ap.parse_args()
 
     tmp = tempfile.mkdtemp(prefix="drand-demo-")
@@ -164,10 +174,62 @@ def main() -> int:
                     killed = True
         print("* demo complete: chain advanced with a node down; "
               "randomness verified against the collective key")
+        if args.regression:
+            return regression(nodes, pc, args)
         return 0
     finally:
         for n in nodes:
             n.stop()
+
+
+def regression(nodes, pc, args) -> int:
+    """Upgrade/version-skew regression (demo/regression/main.go:37-90 +
+    demo/lib/orchestrator.go:417 UpdateBinary, simulated by version skew):
+
+      1. rolling upgrade: restart a node advertising a newer COMPATIBLE
+         minor version; the mixed network must keep producing beacons
+      2. an incompatible-major "new binary" restart is locked out by the
+         version interceptors (drand_daemon_interceptors.go:19-89): its
+         catch-up sync is refused and the rest of the network advances
+    """
+    victim = next(i for i, n in enumerate(nodes) if n.proc.poll() is None
+                  and i != 0)
+
+    def last_round(addr):
+        try:
+            return pc.public_rand(Peer(addr), 0, "default").round
+        except Exception:
+            return 0
+
+    print(f"* regression 1: rolling upgrade of node {victim} to v2.9.9")
+    old = nodes[victim]
+    old.stop()
+    nodes[victim] = Node(old.folder, victim, version="2.9.9",
+                         listen=old.address)
+    base = last_round(nodes[0].address)
+    deadline = time.time() + 12 * args.period
+    while time.time() < deadline:
+        time.sleep(1)
+        if last_round(nodes[victim].address) > base:
+            break
+    upgraded = last_round(nodes[victim].address)
+    assert upgraded > base, "mixed-minor network stopped producing"
+    print(f"  ok: v2.9.9 node caught up + serving round {upgraded}")
+
+    print(f"* regression 2: incompatible upgrade of node {victim} to v3.0.0")
+    old = nodes[victim]
+    old.stop()
+    nodes[victim] = Node(old.folder, victim, version="3.0.0",
+                         listen=old.address)
+    time.sleep(4 * args.period)
+    behind = last_round(nodes[victim].address)
+    ahead = last_round(nodes[0].address)
+    assert ahead > behind, (
+        f"v3 node kept up ({behind} vs {ahead}) — version gate broken")
+    print(f"  ok: v3.0.0 node locked out at round {behind}; "
+          f"network at {ahead}")
+    print("* regression complete")
+    return 0
 
 
 if __name__ == "__main__":

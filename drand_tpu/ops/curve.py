@@ -158,6 +158,107 @@ class DevCurve:
         out = self._select(inf2, p, out)
         return out
 
+    def add_mixed(self, p, q_aff):
+        """Complete mixed addition: q = (X2, Y2) affine, NEVER infinity
+        (callers substitute the generator into dead slots).  Z2 = 1 drops
+        5 of the generic add's 23 staged products; the P==Q doubling
+        fallback and inf-accumulator cases stay select-based."""
+        f = self.f
+        X1, Y1, Z1 = p
+        X2, Y2 = q_aff
+        # stage 1 (dA/dB/dt feed the completeness double, as in add())
+        Z1Z1, dA, dB, dt = f.mul_many(
+            [(Z1, Z1), (X1, X1), (Y1, Y1), (Y1, Z1)])
+        XB = f.add(X1, dB)
+        U2, t2, dC, dU = f.mul_many(
+            [(X2, Z1Z1), (Z1, Z1Z1), (dB, dB), (XB, XB)])
+        dD = f.sub(f.sub(dU, dA), dC)
+        dD = f.add(dD, dD)
+        dE = f.add(f.add(dA, dA), dA)
+        S2, dFv = f.mul_many([(Y2, t2), (dE, dE)])
+        H = f.sub(U2, X1)
+        HH = f.add(H, H)
+        rr = f.sub(S2, Y1)
+        rr = f.add(rr, rr)
+        dX3 = f.sub(dFv, f.add(dD, dD))
+        I, dY3a = f.mul_many([(HH, HH), (dE, f.sub(dD, dX3))])
+        dC2 = f.add(dC, dC)
+        dC4 = f.add(dC2, dC2)
+        dY3 = f.sub(dY3a, f.add(dC4, dC4))
+        dZ3 = f.add(dt, dt)
+        J, V, RR, Z3 = f.mul_many(
+            [(H, I), (X1, I), (rr, rr), (Z1, HH)])
+        X3 = f.sub(f.sub(RR, J), f.add(V, V))
+        Y3a, S1J = f.mul_many([(rr, f.sub(V, X3)), (Y1, J)])
+        Y3 = f.sub(Y3a, f.add(S1J, S1J))
+        out = (X3, Y3, Z3)
+
+        inf1 = self.is_infinity(p)
+        same_x = f.eq(U2, X1) & ~inf1
+        same_y = f.eq(S2, Y1)
+        dbl = (dX3, dY3, dZ3)
+        shape = self.f.batch_shape(self._leaf(X1))
+        infp = self.infinity(shape)
+        one = f.ones(shape)
+        out = self._select(same_x & same_y, dbl, out)
+        out = self._select(same_x & ~same_y, infp, out)
+        out = self._select(inf1, (X2, Y2, one), out)
+        return out
+
+    def batch_inverse(self, z):
+        """Simultaneous inversion over the leading batch axis: ONE Fermat
+        chain + ~3 muls/element via a product tree (Montgomery's trick,
+        tree-shaped so every level is a wide vector op).  0 -> 0."""
+        f = self.f
+        zero = f.is_zero(z)
+        shape = f.batch_shape(self._leaf(z))
+        z = f.select(zero, f.ones(shape), z)
+        levels = []
+        cur = z
+        while self._leaf(cur).shape[0] > 1:
+            n = self._leaf(cur).shape[0]
+            half = n // 2
+            levels.append((cur, half, n))
+            a = jax.tree.map(lambda t: t[:half], cur)
+            b = jax.tree.map(lambda t: t[half:2 * half], cur)
+            (prod,) = f.mul_many([(a, b)])
+            if n % 2:
+                rest = jax.tree.map(lambda t: t[2 * half:], cur)
+                prod = jax.tree.map(
+                    lambda x, y: jnp.concatenate([x, y], 0), prod, rest)
+            cur = prod
+        inv = f.inv(cur)
+        for cur_lvl, half, n in reversed(levels):
+            a = jax.tree.map(lambda t: t[:half], cur_lvl)
+            b = jax.tree.map(lambda t: t[half:2 * half], cur_lvl)
+            pinv = jax.tree.map(lambda t: t[:half], inv)
+            (ia, ib) = f.mul_many([(pinv, b), (pinv, a)])
+            out = jax.tree.map(lambda x, y: jnp.concatenate([x, y], 0), ia, ib)
+            if n % 2:
+                rest = jax.tree.map(lambda t: t[half:], inv)
+                out = jax.tree.map(
+                    lambda x, y: jnp.concatenate([x, y], 0), out, rest)
+            inv = out
+        return self._select_field(zero, self._zeros_like(z), inv)
+
+    def _select_field(self, cond, a, b):
+        return self.f.select(cond, a, b)
+
+    def _zeros_like(self, z):
+        return self.f.zeros(self.f.batch_shape(self._leaf(z)))
+
+    def to_affine_batch(self, p):
+        """Batched to_affine using the shared-chain batch inversion —
+        O(1) Fermat chains for the whole batch instead of one per lane
+        group.  Returns (x, y, is_inf); infinity maps to (0, 0, True)."""
+        f = self.f
+        X1, Y1, Z1 = p
+        zi = self.batch_inverse(Z1)
+        zi2 = f.sqr(zi)
+        (x, zi3) = f.mul_many([(X1, zi2), (zi2, zi)])
+        (y,) = f.mul_many([(Y1, zi3)])
+        return (x, y, self.is_infinity(p))
+
     def neg(self, p):
         return (p[0], self.f.neg(p[1]), p[2])
 

@@ -48,10 +48,8 @@ _Z2 = T.encode_fp2(Z2)
 from ..crypto.host import field as HF
 
 # x1 constant for the tv2 == 0 exceptional case:  B / (Z*A)
-_X1_EXC_G1 = L.encode_mont(ISO_B1 * pow(Z1 * ISO_A1 % P, P - 2, P) % P)
 _X1_EXC_G2 = T.encode_fp2(HF.fp2_mul((ISO_B2[0], ISO_B2[1]), HF.fp2_inv(HF.fp2_mul(Z2, ISO_A2))))
 # -B/A precomputed
-_NBA_G1 = L.encode_mont((P - ISO_B1) * pow(ISO_A1, P - 2, P) % P)
 _NBA_G2 = T.encode_fp2(HF.fp2_mul(HF.fp2_neg(ISO_B2), HF.fp2_inv(ISO_A2)))
 
 _SQRT_EXP = (P + 1) // 4
@@ -125,35 +123,95 @@ def fp2_sqrt(a):
 
 
 # ---------------------------------------------------------------------------
-# Simplified SWU (branchless, generic shape over the two fields)
+# Simplified SWU for G1 — RFC 9380 F.2.1.2 straight-line version (q = 3 mod 4)
+#
+# One (p-3)/4 pow replaces the generic path's field inversion (1/tv2) AND the
+# dual-candidate sqrt: sqrt_ratio(gx1, gxd) yields both the square test and
+# the root from a single chain.  The map emits x PROJECTIVELY (xn/xd) and the
+# isogeny is evaluated on homogenized polynomials, so the whole
+# hash-to-curve pipeline contains no inversion at all.
+#
+# The pow input is exposed via pre/post halves so callers can stack this
+# chain with other (p-3)/4 chains (signature decompression) into ONE scan —
+# pow scans cost the same per step at any lane width.
 # ---------------------------------------------------------------------------
 
-def _sswu_g1(u):
-    A, B, Z = (jnp.broadcast_to(_A1, u.shape), jnp.broadcast_to(_B1, u.shape),
-               jnp.broadcast_to(_Z1, u.shape))
-    u2 = L.mont_sqr(u)
-    tv1 = L.mont_mul(Z, u2)
-    tv2 = L.add_mod(L.mont_sqr(tv1), tv1)
-    x1b = L.mont_mul(jnp.broadcast_to(_NBA_G1, u.shape),
-                     L.add_mod(jnp.broadcast_to(L.ONE_M, u.shape), L.inv_mod(tv2)))
-    x1 = L.select(L.is_zero(tv2), jnp.broadcast_to(_X1_EXC_G1, u.shape), x1b)
+_C1_EXP = (P - 3) // 4
+_c2_int = pow((-(Z1 ** 3)) % P, (P + 1) // 4, P)
+assert _c2_int * _c2_int % P == (-(Z1 ** 3)) % P, "c2 = sqrt(-Z^3) must exist"
+_C2_G1 = L.encode_mont(_c2_int)
+_NA1 = L.encode_mont(P - ISO_A1)
+_ZA_G1 = L.encode_mont(Z1 * ISO_A1 % P)
 
-    def g(x):
-        return L.add_mod(L.add_mod(L.mont_mul(L.mont_sqr(x), x), L.mont_mul(A, x)), B)
 
-    gx1 = g(x1)
-    x2 = L.mont_mul(tv1, x1)
-    gx2 = g(x2)
-    # One stacked sqrt scan covers both candidates; the Legendre test is
-    # free as y1^2 == gx1 (pow scans are latency-bound, so 2x width costs
-    # nothing while a second scan would double the wall time).
-    ys = fp_sqrt(jnp.stack([gx1, gx2]))
-    sq1 = L.eq(L.mont_sqr(ys[0]), gx1)
-    x = L.select(sq1, x1, x2)
-    y = L.select(sq1, ys[0], ys[1])
+def _sswu_g1_pre(u):
+    """Front half: everything up to the sqrt_ratio pow input tv4 = gx1·gxd³."""
+    bc = lambda c: jnp.broadcast_to(c, u.shape)
+    tv1 = L.mont_sqr(u)                               # u²
+    tv3 = L.mont_mul(bc(_Z1), tv1)                    # Z·u²
+    xd = L.add_mod(L.mont_sqr(tv3), tv3)              # Z²u⁴ + Zu²
+    x1n = L.mont_mul(L.add_mod(xd, bc(L.ONE_M)), bc(_B1))
+    xd = L.mont_mul(bc(_NA1), xd)                     # -A·(Z²u⁴+Zu²)
+    xd = L.select(L.is_zero(xd), bc(_ZA_G1), xd)      # exceptional case
+    xd2 = L.mont_sqr(xd)
+    gxd, axd2, gx1a = L.mul_many(
+        [(xd2, xd), (bc(_A1), xd2), (x1n, x1n)])      # xd³, A·xd², x1n²
+    gx1 = L.mont_mul(L.add_mod(gx1a, axd2), x1n)      # x1n³ + A·x1n·xd²
+    gx1 = L.add_mod(gx1, L.mont_mul(bc(_B1), gxd))    # … + B·xd³
+    tv4a, tv2e = L.mul_many([(gxd, gxd), (gx1, gxd)])  # gxd², gx1·gxd
+    tv4 = L.mont_mul(tv4a, tv2e)                      # gx1·gxd³
+    return tv4, (u, tv1, tv3, x1n, xd, gxd, gx1, tv2e)
+
+
+def _sswu_g1_post(e, ctx):
+    """Back half: e = tv4^((p-3)/4) -> projective (xn, xd, y_affine)."""
+    u, tv1, tv3, x1n, xd, gxd, gx1, tv2e = ctx
+    bc = lambda c: jnp.broadcast_to(c, u.shape)
+    y1, x2n, tv1u = L.mul_many(
+        [(e, tv2e), (tv3, x1n), (tv1, u)])            # cand. sqrt(gx1/gxd)
+    y2, ysq = L.mul_many([(L.mont_mul(y1, bc(_C2_G1)), tv1u), (y1, y1)])
+    e2 = L.eq(L.mont_mul(ysq, gxd), gx1)              # gx1/gxd was square?
+    xn = L.select(e2, x1n, x2n)
+    y = L.select(e2, y1, y2)
     flip = fp_sgn0(u) != fp_sgn0(y)
     y = L.select(flip, L.neg_mod(y), y)
-    return x, y
+    return xn, xd, y
+
+
+def _iso_g1_proj(xn, xd, y):
+    """11-isogeny on projective x = xn/xd, affine y — homogenized Horner,
+    Jacobian output, zero inversions (the generated coefficients are the
+    same _iso_g1 constants the affine path uses)."""
+    kxn, kxd, kyn, kyd = _G1_ISO                      # const-term-first
+    bshape = xn.shape
+    bc = lambda c: jnp.broadcast_to(c, bshape)
+    # powers of xd up to max degree 15
+    maxd = max(len(kxn), len(kxd), len(kyn), len(kyd)) - 1
+    xdp = [None, xd]
+    for i in range(2, maxd + 1):
+        xdp.append(L.mont_mul(xdp[i // 2], xdp[i - i // 2]) if i > 2
+                   else L.mont_sqr(xd))
+    polys = [list(kxn), list(kxd), list(kyn), list(kyd)]
+    degs = [len(p) - 1 for p in polys]
+    accs = [bc(p[-1]) for p in polys]
+    for r in range(max(degs)):
+        pairs, meta = [], []
+        for j, p in enumerate(polys):
+            i = degs[j] - 1 - r                       # next coeff index
+            if i < 0:
+                continue
+            pairs.append((accs[j], xn))
+            pairs.append((bc(p[i]), xdp[degs[j] - i]))
+            meta.append(j)
+        prods = L.mul_many(pairs)
+        for k, j in enumerate(meta):
+            accs[j] = L.add_mod(prods[2 * k], prods[2 * k + 1])
+    xn_h, xd_h, yn_h, yd_h = accs
+    d1, yd2 = L.mul_many([(xd, xd_h), (yd_h, yd_h)])  # full x-denominator
+    z, d12, yyn = L.mul_many([(d1, yd_h), (d1, d1), (y, yn_h)])
+    X, d13 = L.mul_many([(xn_h, L.mont_mul(d1, yd2)), (d12, d1)])
+    Y = L.mont_mul(yyn, L.mont_mul(d13, yd2))
+    return (X, Y, z)
 
 
 def _sswu_g2(u):
@@ -222,9 +280,9 @@ def _iso_jacobian(x, y, iso, mul, sqr, add):
 
 def map_to_g1_jac(u):
     """SSWU + 11-isogeny: field element batch -> Jacobian points on E1."""
-    x, y = _sswu_g1(u)
-    X, Y, Z = _iso_jacobian(x, y, _G1_ISO, L.mont_mul, L.mont_sqr, L.add_mod)
-    return (X, Y, Z)
+    tv4, ctx = _sswu_g1_pre(u)
+    e = L.pow_fixed(tv4, _C1_EXP)
+    return _iso_g1_proj(*_sswu_g1_post(e, ctx))
 
 
 def map_to_g2_jac(u):
@@ -306,21 +364,56 @@ def hash_to_g1_jac(u0, u1):
 _HALF1_DEV = jnp.asarray(np.asarray(L.int_to_limbs((P + 1) // 2)))
 
 
-def g1_recover_y(x_can, sign_bit):
-    """x (canonical limbs, batch), sign flag (0/1) -> (Jacobian point, ok).
-
-    ok is False where x**3 + 4 is a non-residue (not on curve); y parity
-    follows the zcash larger-half convention (host serialize.py:18-19)."""
+def _g1_y2(x_can):
+    """Decompression front half: wire x -> (x_mont, y² = x³ + 4)."""
     xm = L.to_mont(x_can)
     b = jnp.broadcast_to(DC.G1_DEV.b, xm.shape)
-    y2 = L.add_mod(L.mont_mul(L.mont_sqr(xm), xm), b)
-    y = fp_sqrt(y2)
+    return xm, L.add_mod(L.mont_mul(L.mont_sqr(xm), xm), b)
+
+
+def _g1_recover_post(xm, y2, e, sign_bit):
+    """Back half: e = y2^((p-3)/4) -> (Jacobian point, ok).
+
+    y = e·y2 = y2^((p+1)/4) — the sqrt when y2 is a residue; sharing the
+    (p-3)/4 exponent lets decompression ride the SSWU sqrt_ratio scan."""
+    y = L.mont_mul(e, y2)
     ok = L.eq(L.mont_sqr(y), y2)
     larger = _fp_ge_half1(y)
     flip = larger ^ (sign_bit == 1)
     y = L.select(flip, L.neg_mod(y), y)
     one = jnp.broadcast_to(L.ONE_M, xm.shape)
     return (xm, y, one), ok
+
+
+def g1_recover_y(x_can, sign_bit):
+    """x (canonical limbs, batch), sign flag (0/1) -> (Jacobian point, ok).
+
+    ok is False where x**3 + 4 is a non-residue (not on curve); y parity
+    follows the zcash larger-half convention (host serialize.py:18-19)."""
+    xm, y2 = _g1_y2(x_can)
+    e = L.pow_fixed(y2, _C1_EXP)
+    return _g1_recover_post(xm, y2, e, sign_bit)
+
+
+def g1_decompress_and_hash(sig_x_can, sign_bit, u0, u1):
+    """Fused G1 front end: signature decompression + hash_to_curve(u0, u1)
+    with ONE (p-3)/4 pow scan across all three chains (width 3N) — pow
+    scans cost per *step*, not per lane, so stacking is the free lunch.
+
+    Returns (sig_jac, parse_ok, hm_jac) for the verification equation
+    e(S, -g2)·e(H(m), pk) == 1 (crypto/schemes.go:166-204 scheme family)."""
+    u = jnp.concatenate([u0, u1], 0)
+    tv4, ctx = _sswu_g1_pre(u)
+    xm, y2 = _g1_y2(sig_x_can)
+    e = L.pow_fixed(jnp.concatenate([tv4, y2], 0), _C1_EXP)
+    n2 = u.shape[0]
+    q = _iso_g1_proj(*_sswu_g1_post(e[:n2], ctx))
+    sig_jac, ok = _g1_recover_post(xm, y2, e[n2:], sign_bit)
+    n = u0.shape[0]
+    q0 = jax.tree.map(lambda t: t[:n], q)
+    q1 = jax.tree.map(lambda t: t[n:], q)
+    hm = DC.g1_clear_cofactor(DC.G1_DEV.add(q0, q1))
+    return sig_jac, ok, hm
 
 
 def g2_recover_y(x0_can, x1_can, sign_bit):

@@ -1188,12 +1188,13 @@ def sum_points(kind: str, p):
 # ---------------------------------------------------------------------------
 
 
-def _ladder_glv_math(getrow0, getrow1, pt, phi, p3, nbits: int):
-    """Joint ladder over precomputed tables {P, phi(P), P+phi(P)} (the
-    tables are built OUTSIDE the kernel in XLA — the in-kernel beta multiply
-    and table add crashed the Mosaic compiler)."""
+def _ladder_glv_mixed_math(getrow0, getrow1, pt, phi, p3, nbits: int):
+    """Joint ladder over precomputed AFFINE tables {P, phi(P), P+phi(P)}
+    (built outside the kernel in XLA — the in-kernel beta multiply and
+    table add crashed the Mosaic compiler).  Affine bases make every
+    table add a mixed addition: 18 vs 23 staged products."""
     curve = G1_PF
-    acc0 = curve.infinity((_flat_point(pt)[0].shape[-1],))
+    acc0 = curve.infinity((pt[0].shape[-1],))
 
     def sel(cond, a, b):
         return jax.tree.map(lambda x, y: jnp.where(cond, x, y), a, b)
@@ -1203,26 +1204,24 @@ def _ladder_glv_math(getrow0, getrow1, pt, phi, p3, nbits: int):
         b0 = getrow0(i) == 1                        # (1, B)
         b1 = getrow1(i) == 1
         t = sel(b0, sel(b1, p3, pt), sel(b1, phi, pt))
-        added = curve.add(acc, t)
+        added = curve.add_mixed(acc, t)
         return sel(b0 | b1, added, acc)
-
-
 
     return jax.lax.fori_loop(0, nbits, step, acc0)
 
 
 @lru_cache(maxsize=None)
-def _ladder_glv_call(nbits: int, btot: int):
+def _ladder_glv_mixed_call(nbits: int, btot: int):
     def kernel(p_ref, one_ref, *refs):
         with _kernel_consts(p=p_ref[:, 0:1], one=one_ref[:, 0:1]):
-            ins, outs = refs[:9], refs[11:]
-            b0_ref, b1_ref = refs[9], refs[10]
-            pt = tuple(r[:] for r in ins[:3])
-            phi = tuple(r[:] for r in ins[3:6])
-            p3 = tuple(r[:] for r in ins[6:9])
-            acc = _ladder_glv_math(lambda i: b0_ref[pl.ds(i, 1), :],
-                                   lambda i: b1_ref[pl.ds(i, 1), :],
-                                   pt, phi, p3, nbits)
+            ins, outs = refs[:6], refs[8:]
+            b0_ref, b1_ref = refs[6], refs[7]
+            pt = (ins[0][:], ins[1][:])
+            phi = (ins[2][:], ins[3][:])
+            p3 = (ins[4][:], ins[5][:])
+            acc = _ladder_glv_mixed_math(lambda i: b0_ref[pl.ds(i, 1), :],
+                                         lambda i: b1_ref[pl.ds(i, 1), :],
+                                         pt, phi, p3, nbits)
             for o, v in zip(outs, _flat_point(acc)):
                 o[:] = v
 
@@ -1231,7 +1230,7 @@ def _ladder_glv_call(nbits: int, btot: int):
     gs = pl.GridSpec(
         grid=(btot // TILE,),
         in_specs=[pl.BlockSpec((NL, TILE), lambda i: (0, 0))] * 2
-        + [spec] * 9 + [bspec, bspec],
+        + [spec] * 6 + [bspec, bspec],
         out_specs=[spec] * 3,
     )
     return pl.pallas_call(
@@ -1240,13 +1239,14 @@ def _ladder_glv_call(nbits: int, btot: int):
 
 
 @lru_cache(maxsize=None)
-def _ladder_glv_direct(nbits: int):
+def _ladder_glv_mixed_direct(nbits: int):
     @jax.jit
     def run(b0, b1, *arrs):
-        pt, phi, p3 = tuple(arrs[:3]), tuple(arrs[3:6]), tuple(arrs[6:9])
+        pt, phi, p3 = ((arrs[0], arrs[1]), (arrs[2], arrs[3]),
+                       (arrs[4], arrs[5]))
         sl = lambda b: (lambda i: jax.lax.dynamic_slice_in_dim(b, i, 1, 0))
         return tuple(_flat_point(
-            _ladder_glv_math(sl(b0), sl(b1), pt, phi, p3, nbits)))
+            _ladder_glv_mixed_math(sl(b0), sl(b1), pt, phi, p3, nbits)))
 
     return run
 
@@ -1254,12 +1254,28 @@ def _ladder_glv_direct(nbits: int):
 def scalar_mul_glv_g1(p, bits0, bits1):
     """(k0 + lambda*k1)-weighted points, bits MSB-first (nbits,) + batch.
 
-    The {P, phi(P), P+phi(P)} tables are built in XLA (one wide multiply and
-    one complete add); the 64-step joint ladder is the fused kernel."""
+    The {P, phi(P), P+phi(P)} tables are normalized to AFFINE in XLA (one
+    shared-chain batch inversion for P and P+phi(P) together, curve.py
+    to_affine_batch), so every ladder step uses the cheaper complete mixed
+    addition (18 vs 23 staged products)."""
     from . import curve as DC
-    phi = DC.g1_phi(p)
-    p3 = DC.G1_DEV.add(p, phi)
-    flat = list(p) + list(phi) + list(p3)
+    import jax.numpy as jn
+    phi_jac = DC.g1_phi(p)
+    p3_jac = DC.G1_DEV.add(p, phi_jac)
+    cat = lambda a, b: jn.concatenate([a, b], 0)
+    ax, ay, _ = DC.G1_DEV.to_affine_batch(
+        (cat(p[0], p3_jac[0]), cat(p[1], p3_jac[1]), cat(p[2], p3_jac[2])))
+    n = p[0].shape[0]
+    pt = (ax[:n], ay[:n])
+    p3 = (ax[n:], ay[n:])
+    phi = (jn.asarray(L.mont_mul(jn.broadcast_to(DC._BETA_DEV, pt[0].shape),
+                                 pt[0])), pt[1])
+    return scalar_mul_glv_g1_mixed(pt, phi, p3, bits0, bits1)
+
+
+def scalar_mul_glv_g1_mixed(pt, phi, p3, bits0, bits1):
+    """Joint GLV ladder over affine tables {P, phi(P), P+phi(P)}."""
+    flat = [pt[0], pt[1], phi[0], phi[1], p3[0], p3[1]]
     arrs = []
     shape = b = None
     for x in flat:
@@ -1274,8 +1290,8 @@ def scalar_mul_glv_g1(p, bits0, bits1):
 
     b0, b1 = prep(bits0), prep(bits1)
     if _use_kernels():
-        out = _ladder_glv_call(nbits, btot)(_P_FULL, _ONE_FULL,
-                                            *arrs, b0, b1)
+        out = _ladder_glv_mixed_call(nbits, btot)(_P_FULL, _ONE_FULL,
+                                                  *arrs, b0, b1)
     else:
-        out = _ladder_glv_direct(nbits)(b0, b1, *arrs)
+        out = _ladder_glv_mixed_direct(nbits)(b0, b1, *arrs)
     return _point_from_lanes("G1", out, shape, b)

@@ -141,3 +141,27 @@ def test_recover_batch_matches_host(scheme_id):
 
     got = batch.recover_batch(sch, indices, partials)
     assert got == expected
+
+
+def test_verify_stream_chunks_and_localizes():
+    """verify_stream (BASELINE config 5 path): double-buffered chunked
+    replay delivers per-chunk verdicts and still localizes a corruption."""
+    from drand_tpu.crypto import batch, schemes
+    from drand_tpu.chain.beacon import Beacon
+
+    sch = schemes.scheme_from_name(schemes.SHORT_SIG_SCHEME_ID)
+    sec, pub = sch.keypair(seed=b"stream-test")
+    ver = batch.BatchBeaconVerifier(sch, sch.public_bytes(pub))
+    n = 24
+    msgs = [sch.digest_beacon(r, None) for r in range(1, n + 1)]
+    sigs = batch.sign_batch(sch, sec, msgs)
+    beacons = [Beacon(round=r, signature=s)
+               for r, s in zip(range(1, n + 1), sigs)]
+    beacons[13] = Beacon(round=14, signature=sigs[2])   # corrupt one round
+    got_rounds, oks = [], []
+    for rounds, ok in ver.verify_stream(iter(beacons), chunk_size=8):
+        got_rounds.extend(rounds)
+        oks.extend(ok.tolist())
+    assert got_rounds == list(range(1, n + 1))
+    assert oks[13] is False or oks[13] == False  # noqa: E712
+    assert sum(1 for o in oks if not o) == 1

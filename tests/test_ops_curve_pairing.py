@@ -9,6 +9,7 @@ device kernels to real beacon data.  Compiles are cached persistently
 import random
 
 import jax
+import jax.numpy as jnp
 import pytest
 
 from drand_tpu.crypto.host import curve as C
@@ -120,3 +121,23 @@ class TestPairing:
         bad = jax.jit(DP.pairing_product_is_one)(
             [(px, py), (px, py)], [(qx, qy), (qx, qy)])
         assert not any(bool(v) for v in bad)
+
+
+def test_g1_recover_y_roundtrip():
+    """Standalone G1 decompression API (kept alongside the fused
+    g1_decompress_and_hash): wire x + sign -> point, vs host serialize."""
+    import numpy as np
+    from drand_tpu.crypto.host import serialize as S
+    from drand_tpu.crypto.host.params import G1_GEN
+    from drand_tpu.crypto.host import curve as HC
+    from drand_tpu.ops import h2c as DH
+    from drand_tpu.ops import limbs as L
+
+    pts = [HC.G1.mul(G1_GEN, k) for k in (1, 7, 12345)]
+    wires = [S.g1_to_bytes(p) for p in pts]
+    xs = np.stack([np.asarray(L.int_to_limbs(p[0])) for p in pts])
+    signs = jnp.asarray(np.array(
+        [(w[0] >> 5) & 1 for w in wires], dtype=np.uint32))
+    jac, ok = jax.jit(DH.g1_recover_y)(jnp.asarray(xs), signs)
+    assert np.asarray(ok).all()
+    assert DC.decode_g1_points(jac) == pts

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Stage-by-stage wall-clock profile of the G1-sig RLC verify pipeline.
+
+Each stage is jitted separately and timed warm (median of reps) with
+intermediates left on device; a trivial no-op program measures the axon
+RPC dispatch overhead to subtract.  Run on the real chip:
+
+    python tools/profile_stages.py [N ...]
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/drand_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/drand_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+REPS = int(os.environ.get("REPS", "5"))
+
+
+def timed(label, fn, *args):
+    out = fn(*args)                     # compile + warm
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    ms = sorted(ts)[len(ts) // 2] * 1e3
+    print(f"  {label:28s} {ms:9.1f} ms")
+    return out, ms
+
+
+def profile(n):
+    from drand_tpu.crypto import batch, schemes
+    from drand_tpu.ops import curve as DC
+    from drand_tpu.ops import h2c as DH
+    from drand_tpu.ops import pairing as DP
+
+    print(f"\n=== N = {n} ===")
+    sch = schemes.scheme_from_name(schemes.SHORT_SIG_SCHEME_ID)
+    sec, pub = sch.keypair(seed=b"profile")
+    ver = batch.BatchBeaconVerifier(sch, sch.public_bytes(pub))
+    rounds = list(range(1, n + 1))
+    msgs = [sch.digest_beacon(r, None) for r in rounds]
+    sigs = batch.sign_batch(sch, sec, msgs)
+
+    # host packing
+    t0 = time.perf_counter()
+    enc, bad = ver._encode(sigs, msgs, batch._pad_len(n))
+    jax.block_until_ready(enc)
+    print(f"  {'host _encode (cold)':28s} {(time.perf_counter()-t0)*1e3:9.1f} ms")
+    sig_x, sign, u0, u1 = enc
+    bits = batch._rlc_scalars(n, batch._pad_len(n), glv=True)
+
+    # dispatch overhead
+    _, rpc = timed("axon rpc overhead (noop)", jax.jit(lambda x: x + 1),
+                   jnp.zeros((8, 128), jnp.uint32))
+
+    stages = {}
+    (sig_jac, parse_ok), stages["recover_y"] = timed(
+        "g1_recover_y (sqrt)", jax.jit(DH.g1_recover_y), sig_x, sign)
+    _, stages["subgroup"] = timed(
+        "g1_in_subgroup", jax.jit(DC.g1_in_subgroup), sig_jac)
+    hm, stages["h2c"] = timed(
+        "hash_to_g1_jac (sswu+iso)", jax.jit(DH.hash_to_g1_jac), u0, u1)
+
+    both = jax.jit(
+        lambda s, h: jax.tree.map(lambda a, b: jnp.concatenate([a, b], 0), s, h)
+    )(sig_jac, hm)
+    b0, b1 = bits
+    bits2 = (jnp.concatenate([b0, b0], axis=1), jnp.concatenate([b1, b1], axis=1))
+    mult, stages["glv_ladder"] = timed(
+        "GLV MSM ladder (2N)", jax.jit(DC.g1_glv_msm_terms), both, *bits2)
+    red, stages["sums"] = timed(
+        "sum_points x2", jax.jit(lambda m: (
+            DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[:n], m)),
+            DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[n:], m)))), mult)
+    aff, stages["to_affine"] = timed(
+        "to_affine x2", jax.jit(lambda ab: (
+            DC.G1_DEV.to_affine(ab[0]), DC.G1_DEV.to_affine(ab[1]))), red)
+
+    def pair(affs):
+        (ax, ay, _), (bx, by, _) = affs
+        px = jnp.stack([ax, bx])
+        py = jnp.stack([ay, by])
+        qx = jax.tree.map(lambda a, b: jnp.stack([a, b]),
+                          ver.fixed_aff[0], ver.pk_aff[0])
+        qy = jax.tree.map(lambda a, b: jnp.stack([a, b]),
+                          ver.fixed_aff[1], ver.pk_aff[1])
+        return DP.paired_product_is_one(px, py, (qx, qy), 2)
+
+    ok, stages["pairing"] = timed("pairing product", jax.jit(pair), aff)
+    assert bool(np.asarray(ok)), "pipeline verify failed"
+
+    total = sum(stages.values())
+    print(f"  {'-- stage sum':28s} {total:9.1f} ms   "
+          f"(minus {len(stages)}x rpc {rpc:.0f} = "
+          f"{total - len(stages)*rpc:.1f} ms)")
+
+    # end-to-end single program (the real path)
+    _, e2e = timed("end-to-end _rlc_ok program",
+                   lambda: ver._rlc_ok(enc, n))
+    print(f"  {'=> rounds/s (e2e program)':28s} {n/ (e2e/1e3):9.1f}")
+
+
+if __name__ == "__main__":
+    for n in [int(a) for a in sys.argv[1:]] or [4096]:
+        profile(n)
