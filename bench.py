@@ -280,12 +280,20 @@ def main():
             configs[name] = None
             stats[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]
 
-    headline = configs.get("streamed_store") or \
-        configs.get("unchained_resident") or \
-        max((v for v in configs.values() if v), default=0.0)
+    headline, headline_config = 0.0, None
+    for name in ("streamed_store", "unchained_resident"):
+        if configs.get(name):
+            headline, headline_config = configs[name], name
+            break
+    else:
+        for name, v in configs.items():
+            if v:
+                headline, headline_config = v, name
+                break
     out = {
         "metric": "beacon_verify_rounds_per_sec",
         "value": headline,
+        "headline_config": headline_config,
         "unit": "rounds/s",
         "vs_baseline": round(headline / BASELINE_RPS, 3),
         "configs": configs,

@@ -216,18 +216,35 @@ def regression(nodes, pc, args) -> int:
     assert upgraded > base, "mixed-minor network stopped producing"
     print(f"  ok: v2.9.9 node caught up + serving round {upgraded}")
 
+    # restore full strength before the lockout test so the REST of the
+    # network still meets the threshold without the victim — otherwise the
+    # "network advances while v3 is locked out" claim is vacuous
+    for i, n in enumerate(nodes):
+        if i != victim and n.proc.poll() is not None:
+            nodes[i] = Node(n.folder, i, listen=n.address)
+    deadline = time.time() + 12 * args.period
+    while time.time() < deadline:
+        if last_round(nodes[0].address) > upgraded:
+            break
+        time.sleep(1)
+
     print(f"* regression 2: incompatible upgrade of node {victim} to v3.0.0")
     old = nodes[victim]
     old.stop()
     nodes[victim] = Node(old.folder, victim, version="3.0.0",
                          listen=old.address)
-    time.sleep(4 * args.period)
-    behind = last_round(nodes[victim].address)
-    ahead = last_round(nodes[0].address)
-    assert ahead > behind, (
-        f"v3 node kept up ({behind} vs {ahead}) — version gate broken")
-    print(f"  ok: v3.0.0 node locked out at round {behind}; "
-          f"network at {ahead}")
+    time.sleep(3 * args.period)
+    behind1 = last_round(nodes[victim].address)
+    ahead1 = last_round(nodes[0].address)
+    time.sleep(3 * args.period)
+    behind2 = last_round(nodes[victim].address)
+    ahead2 = last_round(nodes[0].address)
+    assert ahead2 > ahead1, "network stalled without the v3 node"
+    assert (ahead2 - behind2) > (ahead1 - behind1) or behind2 == behind1, (
+        f"v3 node kept up ({behind1}->{behind2} vs {ahead1}->{ahead2}) — "
+        "version gate broken")
+    print(f"  ok: v3.0.0 node locked out at round {behind2}; "
+          f"network advanced {ahead1}->{ahead2}")
     print("* regression complete")
     return 0
 

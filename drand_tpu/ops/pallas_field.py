@@ -239,37 +239,33 @@ def _lohi25(prod):
     return lo + hi
 
 
-def _conv(a, b):
-    """Schoolbook product columns (..., 48, B); every column < 2^22."""
+def pf_mul(a, b):
+    """CIOS-fused Montgomery multiply: one pass interleaves the operand
+    product and the word-wise reduction, so each of the 24 iterations does
+    a single full-width accumulate (t += lohi(a_i·b) + lohi(m_i·p))
+    instead of conv and REDC each doing their own — the wide adds, not the
+    multiplies, dominate the kernel's VPU traffic.
+
+    Bounds: a lohi25 column is < 2^17; two of them per iteration over 24
+    iterations keeps every column < 24·2^18 < 2^23 — no uint32 overflow.
+    m_i = (t_i + low16(a_i·b_0))·n0' mod 2^16 uses uint32 wrap (2^16 | 2^32
+    keeps the low half exact), exactly as the split _redc did."""
     shape = jnp.broadcast_shapes(a.shape, b.shape)
     a = jnp.broadcast_to(a, shape)
     b = jnp.broadcast_to(b, shape)
+    p = _p_lane()
     t = jnp.zeros(shape[:-2] + (2 * NL, shape[-1]), U32)
     for i in range(NL):
         prod = a[..., i:i + 1, :] * b        # exact uint32 (16x16-bit)
-        t = t + _embed(_lohi25(prod), i, 2 * NL)
-    return t
-
-
-def _redc(t):
-    """Word-wise Montgomery reduction of (..., 48, B) columns -> (..., 24, B).
-
-    Same flow as limbs.mont_reduce, but limb i's cleared value is pushed into
-    limb i+1 with wide ops only (no per-limb sequential carry scan).  Row i
-    is never read again after iteration i, so it is left dirty rather than
-    zeroed (only rows 24..47 feed the result)."""
-    for i in range(NL):
-        m = (t[..., i:i + 1, :] * _N0) & MASK       # uint32 wrap: low 16 exact
-        t = t + _embed(_lohi25(m * _p_lane()), i, 2 * NL)
+        ti = t[..., i:i + 1, :] + (prod[..., 0:1, :] & MASK)
+        m = (ti * _N0) & MASK
+        addend = _lohi25(prod) + _lohi25(m * p)
+        t = t + _embed(addend, i, 2 * NL)
         carry = t[..., i:i + 1, :] >> 16
         t = jnp.concatenate(
             [t[..., :i + 1, :], t[..., i + 1:i + 2, :] + carry,
              t[..., i + 2:, :]], axis=-2)
     return _cond_sub_p(_norm(t[..., NL:, :], NL))
-
-
-def pf_mul(a, b):
-    return _redc(_conv(a, b))
 
 
 def pf_sqr(a):
@@ -1270,7 +1266,12 @@ def scalar_mul_glv_g1(p, bits0, bits1):
     p3 = (ax[n:], ay[n:])
     phi = (jn.asarray(L.mont_mul(jn.broadcast_to(DC._BETA_DEV, pt[0].shape),
                                  pt[0])), pt[1])
-    return scalar_mul_glv_g1_mixed(pt, phi, p3, bits0, bits1)
+    out = scalar_mul_glv_g1_mixed(pt, phi, p3, bits0, bits1)
+    # totality: k·infinity = infinity (affine tables cannot express it, so
+    # restore it after the ladder; production inputs are never infinity)
+    inf_in = DC.G1_DEV.is_infinity(p)
+    return DC.G1_DEV._select(
+        inf_in, DC.G1_DEV.infinity(DC.G1_DEV.f.batch_shape(p[0])), out)
 
 
 def scalar_mul_glv_g1_mixed(pt, phi, p3, bits0, bits1):

@@ -1,9 +1,10 @@
 #!/usr/bin/env python
-"""Stage-by-stage wall-clock profile of the G1-sig RLC verify pipeline.
+"""Stage-by-stage wall-clock profile of the G1-sig RLC verify pipeline
+(round-3 structure: fused decompress+h2c front end, mixed GLV ladder).
 
 Each stage is jitted separately and timed warm (median of reps) with
 intermediates left on device; a trivial no-op program measures the axon
-RPC dispatch overhead to subtract.  Run on the real chip:
+RPC dispatch overhead.  Run on the real chip:
 
     python tools/profile_stages.py [N ...]
 """
@@ -11,6 +12,8 @@ RPC dispatch overhead to subtract.  Run on the real chip:
 import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/drand_tpu_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
@@ -35,7 +38,7 @@ def timed(label, fn, *args):
         jax.block_until_ready(out)
         ts.append(time.perf_counter() - t0)
     ms = sorted(ts)[len(ts) // 2] * 1e3
-    print(f"  {label:28s} {ms:9.1f} ms")
+    print(f"  {label:30s} {ms:9.1f} ms", flush=True)
     return out, ms
 
 
@@ -45,7 +48,7 @@ def profile(n):
     from drand_tpu.ops import h2c as DH
     from drand_tpu.ops import pairing as DP
 
-    print(f"\n=== N = {n} ===")
+    print(f"\n=== N = {n} ===", flush=True)
     sch = schemes.scheme_from_name(schemes.SHORT_SIG_SCHEME_ID)
     sec, pub = sch.keypair(seed=b"profile")
     ver = batch.BatchBeaconVerifier(sch, sch.public_bytes(pub))
@@ -53,25 +56,22 @@ def profile(n):
     msgs = [sch.digest_beacon(r, None) for r in rounds]
     sigs = batch.sign_batch(sch, sec, msgs)
 
-    # host packing
     t0 = time.perf_counter()
     enc, bad = ver._encode(sigs, msgs, batch._pad_len(n))
     jax.block_until_ready(enc)
-    print(f"  {'host _encode (cold)':28s} {(time.perf_counter()-t0)*1e3:9.1f} ms")
+    print(f"  {'host _encode':30s} {(time.perf_counter()-t0)*1e3:9.1f} ms")
     sig_x, sign, u0, u1 = enc
     bits = batch._rlc_scalars(n, batch._pad_len(n), glv=True)
 
-    # dispatch overhead
     _, rpc = timed("axon rpc overhead (noop)", jax.jit(lambda x: x + 1),
                    jnp.zeros((8, 128), jnp.uint32))
 
     stages = {}
-    (sig_jac, parse_ok), stages["recover_y"] = timed(
-        "g1_recover_y (sqrt)", jax.jit(DH.g1_recover_y), sig_x, sign)
+    (sig_jac, parse_ok, hm), stages["front"] = timed(
+        "fused decompress+h2c front", jax.jit(DH.g1_decompress_and_hash),
+        sig_x, sign, u0, u1)
     _, stages["subgroup"] = timed(
-        "g1_in_subgroup", jax.jit(DC.g1_in_subgroup), sig_jac)
-    hm, stages["h2c"] = timed(
-        "hash_to_g1_jac (sswu+iso)", jax.jit(DH.hash_to_g1_jac), u0, u1)
+        "g1_in_subgroup (per-elt)", jax.jit(DC.g1_in_subgroup), sig_jac)
 
     both = jax.jit(
         lambda s, h: jax.tree.map(lambda a, b: jnp.concatenate([a, b], 0), s, h)
@@ -79,13 +79,14 @@ def profile(n):
     b0, b1 = bits
     bits2 = (jnp.concatenate([b0, b0], axis=1), jnp.concatenate([b1, b1], axis=1))
     mult, stages["glv_ladder"] = timed(
-        "GLV MSM ladder (2N)", jax.jit(DC.g1_glv_msm_terms), both, *bits2)
+        "GLV mixed ladder (2N, incl. affine tables)",
+        jax.jit(DC.g1_glv_msm_terms), both, *bits2)
     red, stages["sums"] = timed(
         "sum_points x2", jax.jit(lambda m: (
             DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[:n], m)),
             DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[n:], m)))), mult)
     aff, stages["to_affine"] = timed(
-        "to_affine x2", jax.jit(lambda ab: (
+        "to_affine x2 (tail)", jax.jit(lambda ab: (
             DC.G1_DEV.to_affine(ab[0]), DC.G1_DEV.to_affine(ab[1]))), red)
 
     def pair(affs):
@@ -102,14 +103,13 @@ def profile(n):
     assert bool(np.asarray(ok)), "pipeline verify failed"
 
     total = sum(stages.values())
-    print(f"  {'-- stage sum':28s} {total:9.1f} ms   "
+    print(f"  {'-- stage sum':30s} {total:9.1f} ms   "
           f"(minus {len(stages)}x rpc {rpc:.0f} = "
           f"{total - len(stages)*rpc:.1f} ms)")
 
-    # end-to-end single program (the real path)
     _, e2e = timed("end-to-end _rlc_ok program",
                    lambda: ver._rlc_ok(enc, n))
-    print(f"  {'=> rounds/s (e2e program)':28s} {n/ (e2e/1e3):9.1f}")
+    print(f"  {'=> rounds/s (e2e program)':30s} {n/ (e2e/1e3):9.1f}")
 
 
 if __name__ == "__main__":
