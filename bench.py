@@ -272,13 +272,30 @@ def main():
         4: ("mixed_4chains", bench_mixed_4chains),
         5: ("streamed_store", lambda: bench_streamed_store(stats)),
     }
+    import signal
+
+    budget = int(os.environ.get("DRAND_TPU_BENCH_CONFIG_TIMEOUT", "2400"))
+
+    class _Timeout(Exception):
+        pass
+
+    def _alarm(sig, frame):
+        raise _Timeout(f"config exceeded {budget}s budget")
+
+    signal.signal(signal.SIGALRM, _alarm)
     for idx in sorted(which):
         name, fn = runners[idx]
+        print(f"# config {idx} ({name})...", file=sys.stderr, flush=True)
+        signal.alarm(budget)
         try:
             configs[name] = round(fn(), 1)
-        except Exception as e:  # a failed config must not hide the others
-            configs[name] = None
+            print(f"#   -> {configs[name]} rounds/s", file=sys.stderr,
+                  flush=True)
+        except (Exception, _Timeout) as e:  # one failed config must not
+            configs[name] = None            # hide the others
             stats[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]
+        finally:
+            signal.alarm(0)
 
     headline, headline_config = 0.0, None
     for name in ("streamed_store", "unchained_resident"):
