@@ -92,20 +92,26 @@ class ChainStore:
         with self._new_beacon:
             self._new_beacon.notify_all()
 
-    def wait_for_round(self, round_: int, timeout: float) -> Optional[Beacon]:
+    def wait_for_round(self, round_: int, timeout: float,
+                       scheduled_time: bool = False) -> Optional[Beacon]:
         """Block until the chain reaches `round_`.
 
-        The timeout is *starvation-aware*: on a loaded box (e.g. sibling
-        test workers cold-compiling XLA programs on the one host core) a
-        0.1 s condition wait can take seconds of wall time while this
-        process is descheduled.  Charging raw wall time against the
-        deadline makes tests flake exactly when the machine is busy — so
-        each iteration charges at most 2x the requested wait, i.e. the
-        deadline counts (mostly-)scheduled time.  A hard wall cap of 20x
-        still bounds genuine deadlocks."""
+        With ``scheduled_time=False`` (default) the timeout is plain wall
+        time — what an RPC-deadline caller expects.
+
+        ``scheduled_time=True`` (used by the test harness) makes the
+        timeout *starvation-aware*: on a loaded box (e.g. sibling test
+        workers cold-compiling XLA programs on the one host core) a 0.1 s
+        condition wait can take seconds of wall time while this process is
+        descheduled.  Charging raw wall time against the deadline makes
+        tests flake exactly when the machine is busy — so each iteration
+        charges at most 2x the requested wait, i.e. the deadline counts
+        (mostly-)scheduled time.  A hard wall cap of 20x the timeout still
+        bounds genuine deadlocks."""
         import time as _t
         charged = 0.0
-        wall_deadline = _t.monotonic() + 20 * timeout
+        wall_cap = (20 if scheduled_time else 1) * timeout
+        wall_deadline = _t.monotonic() + wall_cap
         while True:
             try:
                 last = self.last()

@@ -84,6 +84,13 @@ class GrpcRelayNode:
         self._cache = {}                 # round -> Result (bounded)
         self._buffer = buffer
         self._latest = 0
+        # Eviction watermark: highest round ever evicted from the serving
+        # cache.  Dedup must NOT rely on cache membership alone — a replayed
+        # historical round would be inserted, instantly evicted as
+        # min(_cache), and re-forwarded forever (self-sustaining packet
+        # storm; the lp2p reference keeps a seen-TTL cache independent of
+        # delivery state).  Rounds <= the watermark count as already seen.
+        self._evicted = 0
         self._lock = threading.Lock()
         self._new = threading.Condition(self._lock)
         self._stop = threading.Event()
@@ -106,12 +113,18 @@ class GrpcRelayNode:
         """Insert one validated round into the serving cache; returns False
         for duplicates (already delivered)."""
         with self._lock:
-            if res.round in self._cache:
+            if res.round in self._cache or res.round <= self._evicted:
                 return False
             self._cache[res.round] = res
             self._latest = max(self._latest, res.round)
+            # anything at or below latest - buffer counts as seen even
+            # before the cache ever overflows (a fresh node must not
+            # re-forward replayed historical rounds during warm-up)
+            self._evicted = max(self._evicted, self._latest - self._buffer)
             while len(self._cache) > self._buffer:
-                del self._cache[min(self._cache)]
+                mn = min(self._cache)
+                self._evicted = max(self._evicted, mn)
+                del self._cache[mn]
             self._new.notify_all()
             return True
 
@@ -224,8 +237,12 @@ class GossipRelayNode(GrpcRelayNode):
         self._gossip_impl = _GossipService(self)
         super().__init__(client, listen, log=log, buffer=buffer, info=info,
                          extra_services=[(services.GOSSIP, self._gossip_impl)])
+        from concurrent.futures import ThreadPoolExecutor
+
         self.peers = list(peers)
         self.fanout = fanout
+        self._send_pool = ThreadPoolExecutor(
+            max_workers=max(4, 2 * fanout), thread_name_prefix="gossip-send")
         self._channels = {}
         self._chan_lock = threading.Lock()
         self._chain_hash = self.info.hash()
@@ -257,7 +274,7 @@ class GossipRelayNode(GrpcRelayNode):
         if pkt.chain_hash != self._chain_hash:
             raise ValueError("gossip for unknown chain")
         with self._lock:
-            if pkt.round in self._cache:
+            if pkt.round in self._cache or pkt.round <= self._evicted:
                 self.stats["dup"] += 1
                 return                       # seen: suppress re-broadcast
         beacon = Beacon(round=pkt.round, signature=pkt.signature,
@@ -280,12 +297,18 @@ class GossipRelayNode(GrpcRelayNode):
         if len(targets) > self.fanout:
             targets = random.sample(targets, self.fanout)
         for addr in targets:
-            threading.Thread(target=self._send, args=(addr, res),
-                             daemon=True, name=f"gossip-{addr}").start()
+            # bounded sender pool, not thread-per-send: slow peers (5 s
+            # timeout each) must queue, not pile up hundreds of threads
+            self._send_pool.submit(self._send, addr, res)
 
     def _send(self, addr: str, res: Result) -> None:
         from .protos import drand_pb2 as pb
 
+        # staleness drop: if newer rounds were delivered while this send sat
+        # queued behind slow/blackholed peers, forwarding it helps nobody and
+        # keeps the queue from draining (unlocked read — heuristic only)
+        if res.round < self._latest - 1:
+            return
         pkt = pb.GossipBeaconPacket(
             chain_hash=self._chain_hash, round=res.round,
             signature=res.signature,
@@ -308,6 +331,10 @@ class GossipRelayNode(GrpcRelayNode):
                 stub = services.GOSSIP.stub(chan)
                 self._channels[addr] = stub
             return stub
+
+    def stop(self) -> None:
+        super().stop()
+        self._send_pool.shutdown(wait=False, cancel_futures=True)
 
 
 class _GossipService:

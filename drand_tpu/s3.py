@@ -56,8 +56,11 @@ class SigV4Signer:
             f"{k}={urllib.parse.quote(v, safe='~')}"
             for k, v in sorted(urllib.parse.parse_qsl(
                 u.query, keep_blank_values=True)))
+        # S3 canonical URIs must NOT be double-encoded: u.path is already
+        # percent-encoded by _url(), so it goes in verbatim (re-quoting
+        # would corrupt keys containing space/%/non-ASCII).
         canonical = "\n".join([
-            method, urllib.parse.quote(u.path or "/", safe="/~"),
+            method, u.path or "/",
             canonical_query, canonical_headers, signed_headers, payload_hash])
         scope = f"{datestamp}/{self.region}/s3/aws4_request"
         to_sign = "\n".join(["AWS4-HMAC-SHA256", amzdate, scope,

@@ -100,7 +100,8 @@ class BeaconScenario:
         self.clock.advance(self.period)
 
     def wait_round(self, index, round_, timeout=60):
-        b = self.handlers[index].chain.wait_for_round(round_, timeout)
+        b = self.handlers[index].chain.wait_for_round(
+            round_, timeout, scheduled_time=True)
         assert b is not None, \
             f"node {index} never reached round {round_}"
         return b

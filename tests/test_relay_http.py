@@ -120,6 +120,44 @@ class _FakeS3(threading.Thread):
         self.httpd.server_close()
 
 
+def test_sigv4_canonical_uri_single_encoded():
+    """The canonical URI must be the already-encoded URL path verbatim —
+    re-quoting double-encodes keys with space/%/non-ASCII and AWS rejects
+    the signature.  Pinned against an independent reference computation."""
+    import datetime
+    import hashlib
+    import hmac as hmac_mod
+
+    from drand_tpu.s3 import SigV4Signer
+
+    signer = SigV4Signer("AK", "SK", "r1")
+    now = datetime.datetime(2026, 1, 2, 3, 4, 5,
+                            tzinfo=datetime.timezone.utc)
+    # key "a b.txt" -> once-encoded path /bkt/a%20b.txt (as _url builds it)
+    url = "https://s3.test/bkt/a%20b.txt"
+    hdrs = signer.sign("PUT", url, {}, b"payload", now=now)
+    sig = hdrs["Authorization"].rsplit("Signature=", 1)[1]
+
+    # independent AWS SigV4 reference: canonical URI is the single-encoded
+    # path, NOT quote()d again
+    payload_hash = hashlib.sha256(b"payload").hexdigest()
+    canonical = "\n".join([
+        "PUT", "/bkt/a%20b.txt", "",
+        "host:s3.test\n"
+        f"x-amz-content-sha256:{payload_hash}\n"
+        "x-amz-date:20260102T030405Z\n",
+        "host;x-amz-content-sha256;x-amz-date", payload_hash])
+    scope = "20260102/r1/s3/aws4_request"
+    to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", "20260102T030405Z", scope,
+        hashlib.sha256(canonical.encode()).hexdigest()])
+    k = b"AWS4SK"
+    for part in ("20260102", "r1", "s3", "aws4_request"):
+        k = hmac_mod.new(k, part.encode(), hashlib.sha256).digest()
+    expect = hmac_mod.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+    assert sig == expect
+
+
 def test_s3_relay_backfill_and_latest(chain):
     """The S3 backend end-to-end: SigV4-signed PUT/HEAD/GET against an
     S3-compatible endpoint, backfill skipping existing objects, immutable
