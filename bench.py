@@ -1,14 +1,15 @@
 #!/usr/bin/env python
 """Round benchmark: all five BASELINE.json configs on one chip.
 
-Prints exactly ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
-     "configs": {...}, "n": {...}}
+Output protocol (VERDICT r3 #1 — a driver kill must never erase finished
+results): after EVERY config the parent prints a FULL cumulative JSON
+result line to stdout (flushed).  The last line parses as the round
+result whenever the process dies; configs not yet run are null.
 
 The headline metric is config 5 — STREAMED verification of fresh beacons
 replayed from a populated SqliteStore with host packing double-buffered
-against device compute (BASELINE config 5 / VERDICT r2 #10: the honest
-end-to-end number, not a warm re-verify of one resident batch).
+against device compute (BASELINE config 5: the honest end-to-end number,
+not a warm re-verify of one resident batch).
 
 The baseline anchor is the serial-CPU figure from BASELINE.md: a single
 pairing-based verification is milliseconds-scale on one core, pinned at
@@ -17,15 +18,25 @@ pairing-based verification is milliseconds-scale on one core, pinned at
 Configs (BASELINE.json north_star):
   1. chained_catchup   1k  pedersen-bls-chained rounds (client/verify.go
                        :139-160 walk, batched; linkage checked host-side)
-  2. unchained_resident 16k bls-unchained-on-g1 rounds, resident batch
+  2. unchained_resident 8k bls-unchained-on-g1 rounds, resident batch
                        (kernel throughput; the r1/r2 headline, kept for
                        continuity)
-  3. partials_recover  2k rounds x t=7-of-13: batched partial verify +
-                       Lagrange recovery (chainstore.go:202-207)
+  3. partials_recover  10k rounds x t=7-of-13 in 2048-round chunks:
+                       batched partial verify + Lagrange recovery
+                       (chainstore.go:202-207), recovered sigs re-verified
   4. mixed_4chains     4 concurrent chains (2 schemes x {chained,
                        unchained} x {G1,G2} mix) verified chunk-interleaved
-  5. streamed_store    >=100k rounds streamed from SqliteStore, double
-                       buffered (the headline)
+  5. streamed_store    106,496 rounds (13 x 8192) streamed from
+                       SqliteStore, double buffered (the headline; an
+                       exact chunk multiple so every chunk shares ONE
+                       compiled program shape)
+
+Compiled-program economy: every verifier pads to PAD=8192 (pad_to), so
+the whole bench needs exactly four on-chip programs — G1-RLC@8192,
+G2-RLC@8192, partials-verify@(2048x7), recover@(256,7,2048) — plus the
+fixture signing pipelines.  All configs run inside ONE child process so
+each program compiles (or cache-loads) at most once; the parent restarts
+the child for the remaining configs if it hangs or dies.
 
 Fixture chains are generated once and cached under /tmp/drand_tpu_bench
 (generation is setup, not measurement).  DRAND_TPU_BENCH_CONFIGS=1,5
@@ -39,21 +50,20 @@ import time
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/drand_tpu_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
-import jax  # noqa: E402
-
-jax.config.update("jax_compilation_cache_dir", "/tmp/drand_tpu_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 
 BASELINE_RPS = 500.0  # serial kyber CPU anchor (BASELINE.md)
 CACHE = "/tmp/drand_tpu_bench"
 GENESIS_PREV = b"\x09" * 32  # chained fixture genesis-seed stand-in
-N_STREAM = int(os.environ.get("DRAND_TPU_BENCH_N", "102400"))
-# default == CHUNK so configs 2 and 5 share one compiled program shape
-N_RESIDENT = int(os.environ.get("DRAND_TPU_BENCH_N_RESIDENT", "8192"))
+PAD = int(os.environ.get("DRAND_TPU_BENCH_PAD", "8192"))
+# 13 x 8192: >=100k (BASELINE spec) AND an exact multiple of the chunk so
+# the streamed path never compiles a second (tail-sized) program
+N_STREAM = int(os.environ.get("DRAND_TPU_BENCH_N", str(13 * PAD)))
+N_RESIDENT = int(os.environ.get("DRAND_TPU_BENCH_N_RESIDENT", str(PAD)))
 N_CHAINED = int(os.environ.get("DRAND_TPU_BENCH_N_CHAINED", "1024"))
-N_PARTIAL_ROUNDS = int(os.environ.get("DRAND_TPU_BENCH_N_PARTIALS", "2048"))
+N_PARTIAL_ROUNDS = int(os.environ.get("DRAND_TPU_BENCH_N_PARTIALS", "10240"))
+PARTIAL_CHUNK = int(os.environ.get("DRAND_TPU_BENCH_PARTIAL_CHUNK", "2048"))
 N_MIXED = int(os.environ.get("DRAND_TPU_BENCH_N_MIXED", "4096"))
-CHUNK = int(os.environ.get("DRAND_TPU_BENCH_CHUNK", "8192"))
+CHUNK = int(os.environ.get("DRAND_TPU_BENCH_CHUNK", str(PAD)))
 
 
 def _configs():
@@ -64,6 +74,22 @@ def _configs():
         if x.isdigit() and 1 <= int(x) <= 5:
             out.add(int(x))
     return out or {1, 2, 3, 4, 5}
+
+
+def _jax_setup():
+    import jax
+
+    plat = os.environ.get("DRAND_TPU_BENCH_PLATFORM")
+    if plat:
+        # the axon sitecustomize force-sets jax_platforms at interpreter
+        # start, overriding the env var — pin at config level (CPU smoke
+        # tests of the bench protocol; the driver runs without this)
+        from jax.extend.backend import clear_backends
+
+        jax.config.update("jax_platforms", plat)
+        clear_backends()
+    jax.config.update("jax_compilation_cache_dir", "/tmp/drand_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 
 
 # ---------------------------------------------------------------------------
@@ -132,15 +158,19 @@ def _chained_chain(n):
     return sch, sch.public_bytes(pub), beacons
 
 
+def _verifier(sch, pub):
+    from drand_tpu.crypto import batch
+
+    return batch.BatchBeaconVerifier(sch, pub, pad_to=PAD)
+
+
 # ---------------------------------------------------------------------------
 # Configs
 # ---------------------------------------------------------------------------
 
 def bench_chained_catchup():
-    from drand_tpu.crypto import batch
-
     sch, pub, beacons = _chained_chain(N_CHAINED)
-    ver = batch.BatchBeaconVerifier(sch, pub)
+    ver = _verifier(sch, pub)
     ok, _ = ver.verify_chain(beacons)         # warm/compile
     assert ok
     t0 = time.perf_counter()
@@ -151,13 +181,13 @@ def bench_chained_catchup():
 
 
 def bench_unchained_resident():
-    from drand_tpu.crypto import batch, schemes
+    from drand_tpu.crypto import schemes
 
     sch, pub, store = _unchained_store(
         schemes.SHORT_SIG_SCHEME_ID, N_RESIDENT, b"drand-tpu-bench", "g1")
     rounds = list(range(1, N_RESIDENT + 1))
     sigs = [store.get(r).signature for r in rounds]
-    ver = batch.BatchBeaconVerifier(sch, pub)
+    ver = _verifier(sch, pub)
     assert ver.verify_batch(rounds, sigs).all()   # warm/compile
     t0 = time.perf_counter()
     ok = ver.verify_batch(rounds, sigs)
@@ -175,47 +205,57 @@ def bench_partials_recover():
     poly = tbls.PriPoly.random(t, secret=0xBE7C4)
     shares = poly.shares(n_nodes)
     pub_poly = poly.commit(sch.key_group)
-    nr = N_PARTIAL_ROUNDS
+    nr, ck = N_PARTIAL_ROUNDS, PARTIAL_CHUNK
     msgs = [sch.digest_beacon(r, None) for r in range(1, nr + 1)]
-    # t partials per round from signers 0..t-1 (device-signed per signer)
-    per_signer = [batch.sign_batch(sch, shares[j].value, msgs)
-                  for j in range(t)]
+    # t partials per round from signers 0..t-1 (device-signed per signer,
+    # in chunk-sized batches so signing shares the ck-shaped program)
+    per_signer = []
+    for j in range(t):
+        sigs = []
+        for lo in range(0, nr, ck):
+            sigs.extend(batch.sign_batch(sch, shares[j].value,
+                                         msgs[lo:lo + ck]))
+        per_signer.append(sigs)
     rows = [[j.to_bytes(2, "big") + per_signer[j][r] for j in range(t)]
             for r in range(nr)]
-    indices = [[j for j in range(t)]] * nr
+    indices = [[j for j in range(t)]] * ck
     raw_grid = [[per_signer[j][r] for j in range(t)] for r in range(nr)]
 
     bpv = BatchPartialVerifier(sch, pub_poly, n_nodes)
 
     def run():
-        okm = bpv.verify_partials(msgs, rows)
-        assert okm.all()
-        sigs = batch.recover_batch(sch, indices, raw_grid)
-        return sigs
+        out = []
+        for lo in range(0, nr, ck):
+            okm = bpv.verify_partials(msgs[lo:lo + ck], rows[lo:lo + ck])
+            assert okm.all()
+            out.extend(batch.recover_batch(sch, indices,
+                                           raw_grid[lo:lo + ck]))
+        return out
 
     sigs = run()                               # warm/compile
     t0 = time.perf_counter()
     sigs = run()
     dt = time.perf_counter() - t0
     # recovered signatures must verify against the collective key
-    ver = batch.BatchBeaconVerifier(
-        sch, sch.key_group.to_bytes(pub_poly.public_key()))
-    assert ver.verify_batch(list(range(1, nr + 1)), sigs).all()
+    ver = _verifier(sch, sch.key_group.to_bytes(pub_poly.public_key()))
+    for lo in range(0, nr, ck):
+        assert ver.verify_batch(list(range(lo + 1, lo + ck + 1)),
+                                sigs[lo:lo + ck]).all()
     return nr / dt
 
 
 def bench_mixed_4chains():
-    from drand_tpu.crypto import batch, schemes
+    from drand_tpu.crypto import schemes
 
     chains = []
     sch, pub, beacons = _chained_chain(N_CHAINED)
-    chains.append((batch.BatchBeaconVerifier(sch, pub), beacons))
+    chains.append((_verifier(sch, pub), beacons))
     for scheme_id, tag in ((schemes.UNCHAINED_SCHEME_ID, "g2u"),
                            (schemes.SHORT_SIG_SCHEME_ID, "g1"),
                            (schemes.SHORT_SIG_SCHEME_ID, "g1b")):
         s, p, store = _unchained_store(scheme_id, N_MIXED, tag.encode(), tag)
         bs = [store.get(r) for r in range(1, N_MIXED + 1)]
-        chains.append((batch.BatchBeaconVerifier(s, p), bs))
+        chains.append((_verifier(s, p), bs))
 
     def run_all():
         total = 0
@@ -233,12 +273,12 @@ def bench_mixed_4chains():
 
 
 def bench_streamed_store(stats):
-    from drand_tpu.crypto import batch, schemes
+    from drand_tpu.crypto import schemes
 
     sch, pub, store = _unchained_store(
         schemes.SHORT_SIG_SCHEME_ID, N_STREAM, b"drand-tpu-bench-stream",
         "g1stream")
-    ver = batch.BatchBeaconVerifier(sch, pub)
+    ver = _verifier(sch, pub)
 
     def replay():
         def it():
@@ -270,68 +310,39 @@ _RUNNERS = {
     4: "mixed_4chains",
     5: "streamed_store",
 }
-# Warm-first order: config 2 compiles the shared G1 verify program that 5
-# reuses; the G2 configs (1, 4) go last — their first-ever chip compile has
-# been observed to exceed 90 min through the tunnel, so they must not
-# starve the rest of the budget.
+# Order: config 2 compiles/loads the shared G1@PAD program that 5, 3 and
+# 4 reuse; G2 (1, then 4) go after the G1 family so a G2 compile overrun
+# cannot starve the G1 numbers.
 _ORDER = [2, 5, 3, 1, 4]
 
 
-def _run_one(idx: int):
-    """Child-process entry: run one config, print one JSON result line."""
-    stats = {}
-    fns = {
-        1: bench_chained_catchup,
-        2: bench_unchained_resident,
-        3: bench_partials_recover,
-        4: bench_mixed_4chains,
-        5: lambda: bench_streamed_store(stats),
-    }
-    value = fns[idx]()
-    print(json.dumps({"value": round(value, 1), "stats": stats}))
-
-
-def main():
-    import subprocess
-
-    which = _configs()
-    configs, stats = {}, {}
-    budget = int(os.environ.get("DRAND_TPU_BENCH_CONFIG_TIMEOUT", "2400"))
-    total_budget = int(os.environ.get("DRAND_TPU_BENCH_TOTAL_TIMEOUT",
-                                      "5400"))
-    t_start = time.monotonic()
-    for idx in [i for i in _ORDER if i in which]:
-        name = _RUNNERS[idx]
-        left = total_budget - (time.monotonic() - t_start)
-        if left < 60:
-            configs[name] = None
-            stats[f"{name}_error"] = "skipped: total bench budget exhausted"
-            continue
-        print(f"# config {idx} ({name})...", file=sys.stderr, flush=True)
-        # subprocess isolation: a hung compile RPC cannot be interrupted by
-        # signals inside the process (blocked in native code), but a child
-        # can always be killed on timeout
+def _child(indices):
+    """Child: run the given configs IN ONE PROCESS (compiled programs are
+    shared), printing one flushed JSON line per finished config."""
+    _jax_setup()
+    for idx in indices:
+        stats = {}
+        fns = {
+            1: bench_chained_catchup,
+            2: bench_unchained_resident,
+            3: bench_partials_recover,
+            4: bench_mixed_4chains,
+            5: lambda: bench_streamed_store(stats),
+        }
+        t0 = time.monotonic()
         try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__),
-                 "--config", str(idx)],
-                capture_output=True, text=True,
-                timeout=min(budget, left), env=dict(os.environ))
-            if proc.returncode != 0:
-                raise RuntimeError(
-                    f"exit {proc.returncode}: {proc.stderr[-200:]}")
-            res = json.loads(proc.stdout.strip().splitlines()[-1])
-            configs[name] = res["value"]
-            stats.update(res.get("stats", {}))
-            print(f"#   -> {configs[name]} rounds/s", file=sys.stderr,
-                  flush=True)
-        except subprocess.TimeoutExpired:
-            configs[name] = None
-            stats[f"{name}_error"] = f"timeout after {min(budget, left):.0f}s"
+            value = fns[idx]()
+            stats[f"{_RUNNERS[idx]}_wall_s"] = round(time.monotonic() - t0, 1)
+            print(json.dumps({"config": idx, "value": round(value, 1),
+                              "stats": stats}), flush=True)
         except Exception as e:  # one failed config must not hide the others
-            configs[name] = None
-            stats[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]
+            print(json.dumps({"config": idx, "value": None,
+                              "error": f"{type(e).__name__}: {e}"[:200]}),
+                  flush=True)
 
+
+def _emit(configs, stats):
+    """Print the full cumulative result line (the driver parses the last)."""
     headline, headline_config = 0.0, None
     for name in ("streamed_store", "unchained_resident"):
         if configs.get(name):
@@ -355,13 +366,108 @@ def main():
               "mixed_4chains": N_CHAINED + 3 * N_MIXED,
               **stats},
     }
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)
+    return headline
+
+
+def main():
+    import subprocess
+    import threading
+
+    which = _configs()
+    order = [i for i in _ORDER if i in which]
+    configs = {_RUNNERS[i]: None for i in order}
+    stats = {}
+    # per-config ceiling (a hung compile RPC blocks in native code and can
+    # only be killed from outside) and a whole-bench budget
+    cfg_budget = int(os.environ.get("DRAND_TPU_BENCH_CONFIG_TIMEOUT", "2400"))
+    total_budget = int(os.environ.get("DRAND_TPU_BENCH_TOTAL_TIMEOUT", "5400"))
+    deadline = time.monotonic() + total_budget
+
+    # children must see a clean accelerator env: a driver-exported
+    # XLA_FLAGS / JAX_PLATFORMS would change the compilation-cache key and
+    # force a from-scratch compile of every program (r3 postmortem).
+    # DRAND_TPU_BENCH_PLATFORM pins the child platform explicitly (local
+    # CPU smoke tests of the bench protocol).
+    env = dict(os.environ)
+    for k in ("XLA_FLAGS", "JAX_PLATFORMS"):
+        env.pop(k, None)
+    plat = os.environ.get("DRAND_TPU_BENCH_PLATFORM")
+    if plat:
+        env["JAX_PLATFORMS"] = plat
+
+    remaining = list(order)
+    attempt = 0
+    while remaining and time.monotonic() < deadline - 30 and attempt < 4:
+        attempt += 1
+        print(f"# child {attempt}: configs {remaining}", file=sys.stderr,
+              flush=True)
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--run",
+             ",".join(map(str, remaining))],
+            stdout=subprocess.PIPE, stderr=sys.stderr, text=True, env=env)
+
+        done_here = []
+        last_progress = time.monotonic()
+
+        def _reader():
+            nonlocal last_progress
+            for line in proc.stdout:
+                try:
+                    res = json.loads(line)
+                except ValueError:
+                    continue
+                idx = res.get("config")
+                name = _RUNNERS.get(idx)
+                if name is None:
+                    continue
+                last_progress = time.monotonic()
+                done_here.append(idx)
+                if res.get("value"):
+                    configs[name] = res["value"]
+                elif res.get("error"):
+                    stats[f"{name}_error"] = res["error"]
+                stats.update(res.get("stats", {}))
+                print(f"#   {name} -> {res.get('value')}", file=sys.stderr,
+                      flush=True)
+                _emit(configs, stats)
+
+        th = threading.Thread(target=_reader, daemon=True)
+        th.start()
+        while proc.poll() is None:
+            now = time.monotonic()
+            if now > deadline or now - last_progress > cfg_budget:
+                which_cfg = next((i for i in remaining
+                                  if i not in done_here), None)
+                if which_cfg is not None:
+                    stats[f"{_RUNNERS[which_cfg]}_error"] = (
+                        "timeout: killed after "
+                        f"{now - last_progress:.0f}s without progress")
+                proc.kill()
+                break
+            time.sleep(1.0)
+        proc.wait()
+        th.join(timeout=10)
+        # drop finished configs; on timeout also drop the one that hung
+        remaining = [i for i in remaining if i not in done_here]
+        if remaining and proc.returncode != 0:
+            hung = remaining[0]
+            if f"{_RUNNERS[hung]}_error" not in stats:
+                stats[f"{_RUNNERS[hung]}_error"] = (
+                    f"child exit {proc.returncode}")
+            remaining = remaining[1:]
+
+    for idx in remaining:                 # never attempted: say why
+        name = _RUNNERS[idx]
+        if f"{name}_error" not in stats:
+            stats[f"{name}_error"] = "skipped: total bench budget exhausted"
+    headline = _emit(configs, stats)
     if headline == 0.0:
         sys.exit(1)
 
 
 if __name__ == "__main__":
-    if len(sys.argv) == 3 and sys.argv[1] == "--config":
-        _run_one(int(sys.argv[2]))
+    if len(sys.argv) == 3 and sys.argv[1] == "--run":
+        _child([int(x) for x in sys.argv[2].split(",")])
     else:
         main()

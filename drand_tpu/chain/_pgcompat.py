@@ -16,11 +16,30 @@ import threading
 
 
 def _translate(sql: str) -> str:
+    # Dialect guard (VERDICT r3 #8): the store must emit PORTABLE postgres
+    # SQL — psycopg2 placeholders only ('?' would pass here but fail on a
+    # live server), and only upsert forms valid in BOTH dialects (postgres
+    # requires a conflict target for DO UPDATE; bare DO NOTHING is fine).
+    if "?" in re.sub(r"'[^']*'", "", sql):
+        raise AssertionError(
+            "store SQL uses sqlite-style '?' placeholders; psycopg2 needs %s")
+    if re.search(r"ON CONFLICT DO UPDATE", sql, re.IGNORECASE):
+        raise AssertionError(
+            "postgres requires a conflict target for ON CONFLICT DO UPDATE")
     sql = sql.replace("%s", "?")
     sql = re.sub(r"\bSERIAL PRIMARY KEY\b",
                  "INTEGER PRIMARY KEY AUTOINCREMENT", sql)
     sql = re.sub(r"\bBYTEA\b", "BLOB", sql)
     return sql
+
+
+def _pgrow(row):
+    """psycopg2 returns bytea columns as memoryview, not bytes — mimic it
+    so store code that forgets a bytes() wrap fails HERE, in the matrix,
+    instead of on a live server."""
+    if row is None:
+        return None
+    return tuple(memoryview(v) if isinstance(v, bytes) else v for v in row)
 
 
 class _Cursor:
@@ -38,10 +57,10 @@ class _Cursor:
         return self
 
     def fetchone(self):
-        return self._cur.fetchone()
+        return _pgrow(self._cur.fetchone())
 
     def fetchall(self):
-        return self._cur.fetchall()
+        return [_pgrow(r) for r in self._cur.fetchall()]
 
     def close(self):
         self._cur.close()

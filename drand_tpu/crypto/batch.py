@@ -121,23 +121,26 @@ def _pad_len(n: int) -> int:
     return m
 
 
-def _rlc_scalars(n: int, pad: int, glv: bool = False):
+def _rlc_scalars(n: int, pad: int, split: int = 1):
     # numpy PCG seeded with 128 bits of OS entropy: the randomizers only
     # need to be unpredictable to the adversary, and the Python-int path
     # costs ~35us/round of host time at scale.
-    # glv=True returns the coefficient in SAMPLED split form (b0, b1) with
-    # k = k0 + lambda*k1, k0/k1 uniform 64-bit — injective in (k0, k1), so
-    # per-coefficient soundness stays 2^-SECURITY_BITS while the ladder
-    # runs 64 joint steps instead of 128.
+    # split=2 returns the coefficient in SAMPLED split form (b0, b1) with
+    # k = k0 + lambda*k1, k0/k1 uniform 64-bit (the G1 phi eigenvalue) —
+    # injective in (k0, k1), so per-coefficient soundness stays
+    # 2^-SECURITY_BITS while the ladder runs 64 joint steps instead of 128.
+    # split=4 likewise samples k = k0 + x·k1 + x²·k2 + x³·k3 with uniform
+    # 32-bit quarters (the G2 psi eigenvalue x; |x| > 2^32 makes the map
+    # injective by the base-x digit argument) — a 32-step joint ladder.
     rng = np.random.default_rng(secrets.randbits(128))
     raw = rng.integers(0, 256, size=(pad, SECURITY_BITS // 8), dtype=np.uint8)
     raw[n:] = 0
     bits = np.unpackbits(raw, axis=1)            # MSB-first per byte
     bits = np.ascontiguousarray(bits.T, dtype=np.uint32)
-    if glv:
-        half = SECURITY_BITS // 2
-        return (jax.numpy.asarray(bits[:half]),
-                jax.numpy.asarray(bits[half:]))
+    if split > 1:
+        part = SECURITY_BITS // split
+        return tuple(jax.numpy.asarray(bits[i * part:(i + 1) * part])
+                     for i in range(split))
     return jax.numpy.asarray(bits)
 
 
@@ -157,16 +160,27 @@ def _gen_sub(curve, gen, pt, ok):
 
 
 def _rlc_run_g2sig(sig_x, sign, u0, u1, bits, pk_aff, neg_g1_aff):
-    """Scheme family with sigs on G2, keys on G1 (chained/unchained)."""
-    sig_jac, parse_ok = DH.g2_recover_y(sig_x[0], sig_x[1], sign)
+    """Scheme family with sigs on G2, keys on G1 (chained/unchained).
+
+    Front end: ONE Fp2 sqrt_ratio scan fuses decompression + both SSWU
+    maps (ops/h2c.py g2_decompress_and_hash).  MSM: psi-split 4-way GLV —
+    the 128-bit coefficient is sampled as base-x quarters (b0..b3); lanes
+    [S, psi(S), H, psi(H)] run a 32-step psi²-joint mixed ladder and the
+    sum trees fold the psi lanes back in (A over the S-half, B over the
+    H-half)."""
+    sig_jac, parse_ok, hm = DH.g2_decompress_and_hash(
+        sig_x[0], sig_x[1], sign, u0, u1)
     sig_jac = _gen_sub(DC.G2_DEV, _GEN_JAC_G2, sig_jac, parse_ok)
     sub_ok = DC.g2_in_subgroup(sig_jac) & parse_ok
-    hm = DH.hash_to_g2_jac(u0, u1)
-    # one ladder for both MSMs: stack sigs and H(m)s along the batch axis
-    both = jax.tree.map(lambda a, b: jax.numpy.concatenate([a, b], 0), sig_jac, hm)
-    bits2 = jax.numpy.concatenate([bits, bits], axis=1)
-    mult = DC.G2_DEV.scalar_mul_bits(both, bits2)
-    n = bits.shape[1]
+    cat = lambda *ts: jax.numpy.concatenate(ts, 0)
+    # lane order [S, psiS, H, psiH]: A sums the first half, B the second
+    base = jax.tree.map(cat, sig_jac, DC.g2_psi(sig_jac),
+                        hm, DC.g2_psi(hm))
+    b0, b1, b2, b3 = bits
+    bl = jax.numpy.concatenate([b0, b1, b0, b1], axis=1)
+    bh = jax.numpy.concatenate([b2, b3, b2, b3], axis=1)
+    mult = DC.g2_glv_msm_terms(base, bl, bh)
+    n = 2 * b0.shape[1]
     A = DC.G2_DEV.sum_points(jax.tree.map(lambda t: t[:n], mult))
     B = DC.G2_DEV.sum_points(jax.tree.map(lambda t: t[n:], mult))
     ax, ay, _ = DC.G2_DEV.to_affine(A)
@@ -206,10 +220,10 @@ def _rlc_run_g1sig(sig_x, sign, u0, u1, bits, pk_aff, neg_g2_aff):
 
 def _exact_run_g2sig(sig_x, sign, u0, u1, pk_aff, neg_g1_aff):
     """Per-round exact check (fallback path): e(-g1,S_i)·e(pk,H_i) == 1."""
-    sig_jac, parse_ok = DH.g2_recover_y(sig_x[0], sig_x[1], sign)
+    sig_jac, parse_ok, hm = DH.g2_decompress_and_hash(
+        sig_x[0], sig_x[1], sign, u0, u1)
     sig_jac = _gen_sub(DC.G2_DEV, _GEN_JAC_G2, sig_jac, parse_ok)
     sub_ok = DC.g2_in_subgroup(sig_jac) & parse_ok
-    hm = DH.hash_to_g2_jac(u0, u1)
     sx, sy, _ = DC.G2_DEV.to_affine(sig_jac)
     hx, hy, _ = DC.G2_DEV.to_affine(hm)
     n = u0[0].shape[0]
@@ -235,6 +249,24 @@ def _exact_run_g1sig_jac(sig_jac, u0, u1, pk_aff, neg_g2_aff):
     produces recovered points directly, no wire decompression involved."""
     hm = DH.hash_to_g1_jac(u0, u1)
     return _exact_g1sig_core(sig_jac, hm, pk_aff, neg_g2_aff)
+
+
+def _exact_run_g2sig_jac(sig_jac, u0, u1, pk_aff, neg_g1_aff):
+    """G2-sig mirror of _exact_run_g1sig_jac (the default chained/unchained
+    schemes' aggregation path)."""
+    hm = DH.hash_to_g2_jac(u0, u1)
+    sub_ok = DC.g2_in_subgroup(sig_jac)
+    sx, sy, _ = DC.G2_DEV.to_affine(sig_jac)
+    hx, hy, _ = DC.G2_DEV.to_affine(hm)
+    n = u0[0].shape[0]
+    px = jax.numpy.stack([jax.numpy.broadcast_to(neg_g1_aff[0], (n, L.NLIMB)),
+                          jax.numpy.broadcast_to(pk_aff[0], (n, L.NLIMB))])
+    py = jax.numpy.stack([jax.numpy.broadcast_to(neg_g1_aff[1], (n, L.NLIMB)),
+                          jax.numpy.broadcast_to(pk_aff[1], (n, L.NLIMB))])
+    qx = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), sx, hx)
+    qy = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), sy, hy)
+    ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
+    return sub_ok & ok
 
 
 def _exact_g1sig_core(sig_jac, hm, pk_aff, neg_g2_aff):
@@ -285,9 +317,15 @@ class BatchBeaconVerifier:
     The drand-side analogue would be the `BatchVerifyBeacon` extension of
     crypto.Scheme described in BASELINE.json's north star."""
 
-    def __init__(self, scheme: Scheme, public_key_bytes: bytes):
+    def __init__(self, scheme: Scheme, public_key_bytes: bytes,
+                 pad_to: int | None = None):
         self.scheme = scheme
         self.g2sig = scheme.sig_group is GroupG2
+        # pad_to: optional canonical batch width.  Batches pad UP to it so
+        # differently-sized chains share one compiled program (the bench
+        # pads every config to 8192: compile count is the scarce resource
+        # on-chip, and pad slots cost ~linear device time but zero compiles)
+        self.pad_to = pad_to
         self.pub_point = scheme.key_group.from_bytes(public_key_bytes)
         if self.g2sig:
             self.pk_aff = (L.encode_mont(self.pub_point[0]), L.encode_mont(self.pub_point[1]))
@@ -389,7 +427,8 @@ class BatchBeaconVerifier:
 
     def _rlc_ok(self, enc, n) -> bool:
         """One RLC check over an encoded range; True iff all n rounds verify."""
-        bits = _rlc_scalars(n, _pad_len(n), glv=not self.g2sig)
+        bits = _rlc_scalars(n, self._leaf_len(enc),
+                            split=4 if self.g2sig else 2)
         enc, bits = self._shard_round_axis(enc, bits)
         sig_x, sign, u0, u1 = enc
         pipe = _rlc_pipeline_g2sig() if self.g2sig else _rlc_pipeline_g1sig()
@@ -410,9 +449,12 @@ class BatchBeaconVerifier:
     # chunk.  Compiled shapes stay bounded: every level is a power of two.
     _BISECT_MIN = 64
 
-    def _verify_range(self, enc, lo, hi, bad) -> np.ndarray:
+    def _verify_range(self, enc, lo, hi, bad, top=False) -> np.ndarray:
         n = hi - lo
-        sub = self._slice_enc(enc, lo, hi)
+        # top level: use the batch encoding at its full pad (which may
+        # exceed _pad_len(n) when pad_to is set — sharing one compiled
+        # program shape across chains); bisection re-pads sub-ranges
+        sub = enc if top else self._slice_enc(enc, lo, hi)
         if not bad[lo:hi].any() and self._rlc_ok(sub, n):
             return np.ones(n, dtype=bool)
         if n <= self._BISECT_MIN:
@@ -436,8 +478,9 @@ class BatchBeaconVerifier:
         if prev_sigs is None:
             prev_sigs = [None] * n
         msgs = self._messages(rounds, prev_sigs)
-        enc, bad = self._encode(sigs, msgs, _pad_len(n))
-        return self._verify_range(enc, 0, n, bad)
+        enc, bad = self._encode(sigs, msgs,
+                                max(_pad_len(n), self.pad_to or 0))
+        return self._verify_range(enc, 0, n, bad, top=True)
 
     def verify_stream(self, beacons, chunk_size: int = 8192):
         """Streamed verification of an iterable of beacons (BASELINE
@@ -453,7 +496,8 @@ class BatchBeaconVerifier:
             prevs = [b.previous_sig for b in chunk]
             sigs = [b.signature for b in chunk]
             msgs = self._messages(rounds, prevs)
-            enc, bad = self._encode(sigs, msgs, _pad_len(len(chunk)))
+            enc, bad = self._encode(
+                sigs, msgs, max(_pad_len(len(chunk)), self.pad_to or 0))
             return rounds, enc, bad
 
         def chunks():
@@ -472,11 +516,11 @@ class BatchBeaconVerifier:
                 nxt = ex.submit(pack, chunk)
                 if pending is not None:
                     rounds, enc, bad = pending.result()
-                    yield rounds, self._verify_range(enc, 0, len(rounds), bad)
+                    yield rounds, self._verify_range(enc, 0, len(rounds), bad, top=True)
                 pending = nxt
             if pending is not None:
                 rounds, enc, bad = pending.result()
-                yield rounds, self._verify_range(enc, 0, len(rounds), bad)
+                yield rounds, self._verify_range(enc, 0, len(rounds), bad, top=True)
 
     def verify_chain(self, beacons):
         """Verify a chained sequence of (round, sig, prev_sig) host-side

@@ -414,6 +414,17 @@ _PSI_CY_DEV = T.encode_fp2(HF.fp2_inv(HF.fp2_pow(HF.XI, (FP_P - 1) // 2)))
 # G1 GLV endomorphism phi(x, y) = (beta*x, y), beta = 2^((p-1)/3).
 _BETA_DEV = L.encode_mont(pow(2, (FP_P - 1) // 3, FP_P))
 
+# psi^2 scales affine coords by Fp constants: psi^2(x, y) = (n_x·x, n_y·y)
+# with n_x = c_x·conj(c_x), n_y = c_y·conj(c_y) (both norms land in Fp);
+# eigenvalue x^2 on G2 (psi acts as x — the g2_in_subgroup identity).
+_psi_cx_h = HF.fp2_inv(HF.fp2_pow(HF.XI, (FP_P - 1) // 3))
+_psi_cy_h = HF.fp2_inv(HF.fp2_pow(HF.XI, (FP_P - 1) // 2))
+_nx_h = HF.fp2_mul(_psi_cx_h, (_psi_cx_h[0], FP_P - _psi_cx_h[1] if _psi_cx_h[1] else 0))
+_ny_h = HF.fp2_mul(_psi_cy_h, (_psi_cy_h[0], FP_P - _psi_cy_h[1] if _psi_cy_h[1] else 0))
+assert _nx_h[1] == 0 and _ny_h[1] == 0
+_PSI2_NX_DEV = L.encode_mont(_nx_h[0])
+_PSI2_NY_DEV = L.encode_mont(_ny_h[0])
+
 
 def g2_psi(p):
     X2, Y2, Z2 = p
@@ -422,6 +433,14 @@ def g2_psi(p):
         T.fp2_mul(_PSI_CY_DEV, T.fp2_conj(Y2)),
         T.fp2_conj(Z2),
     )
+
+
+def g2_psi2(p):
+    """psi∘psi on Jacobian coords: per-coordinate Fp scalings, Z unchanged."""
+    X2, Y2, Z2 = p
+    return (T.fp2_mul_fp(X2, jnp.broadcast_to(_PSI2_NX_DEV, X2[0].shape)),
+            T.fp2_mul_fp(Y2, jnp.broadcast_to(_PSI2_NY_DEV, Y2[0].shape)),
+            Z2)
 
 
 def g1_phi(p):
@@ -447,6 +466,33 @@ def g1_glv_msm_terms(p, bits0, bits1):
                            G1_DEV._select(b1 == 1, phi, p))
         added = G1_DEV.add(acc, t)
         return G1_DEV._select((b0 | b1) == 1, added, acc), None
+
+    acc, _ = jax.lax.scan(step, acc0, (bits0, bits1))
+    return acc
+
+
+def g2_glv_msm_terms(p, bits0, bits1):
+    """(k0 + x²·k1)-weighted G2 points for the RLC (x² = the psi² eigenvalue).
+
+    32-step joint double-and-add when the caller also splits across psi by
+    lane duplication (crypto/batch.py): k = k0 + x·k1 + x²·k2 + x³·k3 with
+    uniform 32-bit quarters — injective (|x| > 2^32, base-x digits), so
+    per-coefficient soundness stays 2^-128.  Dispatches to the fused Pallas
+    GLV kernel when enabled."""
+    from . import pallas_field as PF
+    if PF.enabled():
+        return PF.scalar_mul_glv_g2(p, bits0, bits1)
+    psi2 = g2_psi2(p)
+    p3 = G2_DEV.add(p, psi2)
+    acc0 = G2_DEV.infinity(G2_DEV.f.batch_shape(G2_DEV._leaf(p[0])))
+
+    def step(acc, bb):
+        b0, b1 = bb
+        acc = G2_DEV.double(acc)
+        t = G2_DEV._select(b0 == 1, G2_DEV._select(b1 == 1, p3, p),
+                           G2_DEV._select(b1 == 1, psi2, p))
+        added = G2_DEV.add(acc, t)
+        return G2_DEV._select((b0 | b1) == 1, added, acc), None
 
     acc, _ = jax.lax.scan(step, acc0, (bits0, bits1))
     return acc

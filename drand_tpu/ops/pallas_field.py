@@ -548,6 +548,49 @@ def _pow_direct(e: int):
     return run
 
 
+def _pow2_math(getbit, x, nbits: int):
+    acc0 = pf2_ones((x[0].shape[-1],))
+
+    def step(i, acc):
+        acc = pf2_sqr(acc)
+        return _maybe_cond(getbit(i), lambda a: pf2_mul(a, x), acc)
+
+    return jax.lax.fori_loop(0, nbits, step, acc0)
+
+
+@lru_cache(maxsize=None)
+def _pow2_call(e: int, btot: int):
+    nbits = max(e.bit_length(), 1)
+
+    def kernel(bits_ref, p_ref, one_ref, x0_ref, x1_ref, o0_ref, o1_ref):
+        with _kernel_consts(p=p_ref[:, 0:1], one=one_ref[:, 0:1]):
+            r = _pow2_math(lambda i: bits_ref[i], (x0_ref[:], x1_ref[:]),
+                           nbits)
+            o0_ref[:] = r[0]
+            o1_ref[:] = r[1]
+
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(btot // TILE,),
+        in_specs=[_CONST_SPEC, _CONST_SPEC, _DATA_SPEC, _DATA_SPEC],
+        out_specs=[_DATA_SPEC, _DATA_SPEC],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=gs,
+        out_shape=[jax.ShapeDtypeStruct((NL, btot), U32)] * 2)
+
+
+@lru_cache(maxsize=None)
+def _pow2_direct(e: int):
+    nbits = max(e.bit_length(), 1)
+
+    @jax.jit
+    def run(bits, x0, x1):
+        return _pow2_math(lambda i: bits[i], (x0, x1), nbits)
+
+    return run
+
+
 @lru_cache(maxsize=None)
 def _ladder_var_call(kind: str, nbits: int, btot: int):
     nc = _ncoord(kind)
@@ -657,6 +700,19 @@ def pow_fixed(a, e: int):
     else:
         out = _pow_direct(e)(bits, x)
     return _from_lanes(out, shape, b)
+
+
+def pow_fixed_fp2(a, e: int):
+    """Drop-in for tower.fp2_pow_fixed: the whole Fp2 square-and-multiply
+    chain as one Pallas kernel (the G2 sqrt_ratio scan)."""
+    x0, shape, b = _to_lanes(a[0])
+    x1, _, _ = _to_lanes(a[1])
+    bits = jnp.asarray(_exp_bits_np(e))
+    if _use_kernels():
+        out = _pow2_call(e, x0.shape[1])(bits, _P_FULL, _ONE_FULL, x0, x1)
+    else:
+        out = _pow2_direct(e)(bits, x0, x1)
+    return (_from_lanes(out[0], shape, b), _from_lanes(out[1], shape, b))
 
 
 def _point_to_lanes(p):
@@ -1155,42 +1211,74 @@ def _sum_call(kind: str, btot: int):
 
 
 def sum_points(kind: str, p):
-    """Drop-in for DevCurve.sum_points (leading-axis point reduction)."""
+    """Drop-in for DevCurve.sum_points (leading-axis point reduction).
+
+    Recursive: each kernel call reduces every TILE-lane tile to one point;
+    the per-tile partials feed the next call (zero-padded lanes read as
+    infinity, inert) until one tile remains.  At 8192 lanes that is TWO
+    kernel dispatches and zero XLA-level group adds — the old single-level
+    version folded 31 partials per sum with ~30 sequential XLA complete
+    adds, which dominated both the HLO graph (compile time) and the
+    sums-stage wall time (PERF.md r3 stage table)."""
     from . import curve as DC
     xla_curve = DC.G1_DEV if kind == "G1" else DC.G2_DEV
     shape = _flat_point(p)[0].shape[:-1]
     if len(shape) != 1 or not _use_kernels():
         return None                                  # caller falls back to XLA
     arrs, _, b = _point_to_lanes(p)
-    btot = arrs[0].shape[1]
-    # pad lanes beyond n are all-zero: Z = 0 reads as infinity, inert
-    out = _sum_call(kind, btot)(_P_FULL, _ONE_FULL, *arrs)
-    out = [x[:, ::TILE] for x in out]                # lane 0 of each tile
-    partials = _point_from_lanes(kind, out, (btot // TILE,), btot // TILE)
-    # fold the per-tile partials (few) with the XLA complete add
-    acc = jax.tree.map(lambda t: t[0], partials)
-    for i in range(1, btot // TILE):
-        acc = xla_curve.add(acc, jax.tree.map(lambda t: t[i], partials))
-    return acc
+    while True:
+        btot = arrs[0].shape[1]
+        out = _sum_call(kind, btot)(_P_FULL, _ONE_FULL, *arrs)
+        ntiles = btot // TILE
+        out = [x[:, ::TILE] for x in out]            # lane 0 of each tile
+        if ntiles == 1:
+            partials = _point_from_lanes(kind, out, (1,), 1)
+            return jax.tree.map(lambda t: t[0], partials)
+        if ntiles <= 4:
+            partials = _point_from_lanes(kind, out, (ntiles,), ntiles)
+            acc = jax.tree.map(lambda t: t[0], partials)
+            for i in range(1, ntiles):
+                acc = xla_curve.add(acc, jax.tree.map(lambda t: t[i], partials))
+            return acc
+        # next level: per-tile partials become the lanes of a smaller call
+        arrs = [jnp.pad(x, ((0, 0), (0, TILE - ntiles % TILE)))
+                if ntiles % TILE else x for x in out]
 
 
 # ---------------------------------------------------------------------------
-# GLV joint ladder for G1 RLC coefficients: k = k0 + lambda*k1 with uniform
-# 64-bit halves (lambda = -x^2 mod r, the phi eigenvalue: ops/curve.py
-# g1_in_subgroup identity).  64 double+add steps instead of 128 — the RLC
-# randomizers are SAMPLED in split form, so no decomposition is needed and
-# per-coefficient soundness stays 2^-128 (the map (k0,k1) -> k0+lambda*k1
-# is injective on [0,2^64)^2).
+# GLV joint ladders for RLC coefficients.
+#
+# G1: k = k0 + lambda*k1 with uniform 64-bit halves (lambda = -x^2 mod r,
+# the phi eigenvalue: ops/curve.py g1_in_subgroup identity).  64 double+add
+# steps instead of 128 — the RLC randomizers are SAMPLED in split form, so
+# no decomposition is needed and per-coefficient soundness stays 2^-128
+# (the map (k0,k1) -> k0+lambda*k1 is injective on [0,2^64)^2).
+#
+# G2: the same joint-ladder machinery with the psi^2 endomorphism
+# (eigenvalue x^2; psi^2 scales affine coords by Fp constants, so the
+# affine-table construction carries over verbatim).  Callers split the
+# 128-bit coefficient 4 ways across psi via lane duplication (curve.py
+# g2_glv_msm_terms), so nbits = 32 here.
 # ---------------------------------------------------------------------------
 
 
-def _ladder_glv_mixed_math(getrow0, getrow1, pt, phi, p3, nbits: int):
-    """Joint ladder over precomputed AFFINE tables {P, phi(P), P+phi(P)}
-    (built outside the kernel in XLA — the in-kernel beta multiply and
+def _pack_affine(kind: str, arrs):
+    if kind == "G1":
+        return (arrs[0], arrs[1])
+    return ((arrs[0], arrs[1]), (arrs[2], arrs[3]))
+
+
+def _naff(kind: str) -> int:
+    return 2 if kind == "G1" else 4
+
+
+def _ladder_glv_mixed_math(kind, getrow0, getrow1, pt, phi, p3, nbits: int):
+    """Joint ladder over precomputed AFFINE tables {P, endo(P), P+endo(P)}
+    (built outside the kernel in XLA — the in-kernel endo multiply and
     table add crashed the Mosaic compiler).  Affine bases make every
     table add a mixed addition: 18 vs 23 staged products."""
-    curve = G1_PF
-    acc0 = curve.infinity((pt[0].shape[-1],))
+    curve = _curve_of(kind)
+    acc0 = curve.infinity((_flat_point(pt)[0].shape[-1],))
 
     def sel(cond, a, b):
         return jax.tree.map(lambda x, y: jnp.where(cond, x, y), a, b)
@@ -1207,15 +1295,20 @@ def _ladder_glv_mixed_math(getrow0, getrow1, pt, phi, p3, nbits: int):
 
 
 @lru_cache(maxsize=None)
-def _ladder_glv_mixed_call(nbits: int, btot: int):
+def _ladder_glv_mixed_call(kind: str, nbits: int, btot: int):
+    na = _naff(kind)
+    nc = _ncoord(kind)
+
     def kernel(p_ref, one_ref, *refs):
         with _kernel_consts(p=p_ref[:, 0:1], one=one_ref[:, 0:1]):
-            ins, outs = refs[:6], refs[8:]
-            b0_ref, b1_ref = refs[6], refs[7]
-            pt = (ins[0][:], ins[1][:])
-            phi = (ins[2][:], ins[3][:])
-            p3 = (ins[4][:], ins[5][:])
-            acc = _ladder_glv_mixed_math(lambda i: b0_ref[pl.ds(i, 1), :],
+            ins = refs[:3 * na]
+            b0_ref, b1_ref = refs[3 * na], refs[3 * na + 1]
+            outs = refs[3 * na + 2:]
+            pt = _pack_affine(kind, [r[:] for r in ins[:na]])
+            phi = _pack_affine(kind, [r[:] for r in ins[na:2 * na]])
+            p3 = _pack_affine(kind, [r[:] for r in ins[2 * na:]])
+            acc = _ladder_glv_mixed_math(kind,
+                                         lambda i: b0_ref[pl.ds(i, 1), :],
                                          lambda i: b1_ref[pl.ds(i, 1), :],
                                          pt, phi, p3, nbits)
             for o, v in zip(outs, _flat_point(acc)):
@@ -1226,23 +1319,26 @@ def _ladder_glv_mixed_call(nbits: int, btot: int):
     gs = pl.GridSpec(
         grid=(btot // TILE,),
         in_specs=[pl.BlockSpec((NL, TILE), lambda i: (0, 0))] * 2
-        + [spec] * 6 + [bspec, bspec],
-        out_specs=[spec] * 3,
+        + [spec] * (3 * na) + [bspec, bspec],
+        out_specs=[spec] * nc,
     )
     return pl.pallas_call(
         kernel, grid_spec=gs,
-        out_shape=[jax.ShapeDtypeStruct((NL, btot), U32)] * 3)
+        out_shape=[jax.ShapeDtypeStruct((NL, btot), U32)] * nc)
 
 
 @lru_cache(maxsize=None)
-def _ladder_glv_mixed_direct(nbits: int):
+def _ladder_glv_mixed_direct(kind: str, nbits: int):
+    na = _naff(kind)
+
     @jax.jit
     def run(b0, b1, *arrs):
-        pt, phi, p3 = ((arrs[0], arrs[1]), (arrs[2], arrs[3]),
-                       (arrs[4], arrs[5]))
+        pt = _pack_affine(kind, arrs[:na])
+        phi = _pack_affine(kind, arrs[na:2 * na])
+        p3 = _pack_affine(kind, arrs[2 * na:])
         sl = lambda b: (lambda i: jax.lax.dynamic_slice_in_dim(b, i, 1, 0))
         return tuple(_flat_point(
-            _ladder_glv_mixed_math(sl(b0), sl(b1), pt, phi, p3, nbits)))
+            _ladder_glv_mixed_math(kind, sl(b0), sl(b1), pt, phi, p3, nbits)))
 
     return run
 
@@ -1266,7 +1362,7 @@ def scalar_mul_glv_g1(p, bits0, bits1):
     p3 = (ax[n:], ay[n:])
     phi = (jn.asarray(L.mont_mul(jn.broadcast_to(DC._BETA_DEV, pt[0].shape),
                                  pt[0])), pt[1])
-    out = scalar_mul_glv_g1_mixed(pt, phi, p3, bits0, bits1)
+    out = scalar_mul_glv_mixed("G1", pt, phi, p3, bits0, bits1)
     # totality: k·infinity = infinity (affine tables cannot express it, so
     # restore it after the ladder; production inputs are never infinity)
     inf_in = DC.G1_DEV.is_infinity(p)
@@ -1274,9 +1370,37 @@ def scalar_mul_glv_g1(p, bits0, bits1):
         inf_in, DC.G1_DEV.infinity(DC.G1_DEV.f.batch_shape(p[0])), out)
 
 
-def scalar_mul_glv_g1_mixed(pt, phi, p3, bits0, bits1):
-    """Joint GLV ladder over affine tables {P, phi(P), P+phi(P)}."""
-    flat = [pt[0], pt[1], phi[0], phi[1], p3[0], p3[1]]
+def scalar_mul_glv_g2(p, bits0, bits1):
+    """(k0 + x^2*k1)-weighted G2 points via the psi^2 joint ladder.
+
+    psi^2 acts on affine coords as (n_x·x, n_y·y) with n_x, n_y in Fp
+    (curve.py _PSI2_NX/_PSI2_NY), so the affine tables {Q, psi^2(Q),
+    Q+psi^2(Q)} are built exactly like the G1 phi tables."""
+    from . import curve as DC
+    import jax.numpy as jn
+    psi2_jac = DC.g2_psi2(p)
+    p3_jac = DC.G2_DEV.add(p, psi2_jac)
+    cat3 = lambda a, b: jax.tree.map(
+        lambda x, y: jn.concatenate([x, y], 0), a, b)
+    ax, ay, _ = DC.G2_DEV.to_affine_batch(cat3(p, p3_jac))
+    n = p[0][0].shape[0]
+    half = lambda c, lo: jax.tree.map(
+        lambda t: t[:n] if lo else t[n:], c)
+    pt = (half(ax, True), half(ay, True))
+    p3 = (half(ax, False), half(ay, False))
+    mulc = lambda c, k: jn.asarray(
+        L.mont_mul(jn.broadcast_to(k, c.shape), c))
+    phi = ((mulc(pt[0][0], DC._PSI2_NX_DEV), mulc(pt[0][1], DC._PSI2_NX_DEV)),
+           (mulc(pt[1][0], DC._PSI2_NY_DEV), mulc(pt[1][1], DC._PSI2_NY_DEV)))
+    out = scalar_mul_glv_mixed("G2", pt, phi, p3, bits0, bits1)
+    inf_in = DC.G2_DEV.is_infinity(p)
+    return DC.G2_DEV._select(
+        inf_in, DC.G2_DEV.infinity(DC.G2_DEV.f.batch_shape(p[0][0])), out)
+
+
+def scalar_mul_glv_mixed(kind, pt, phi, p3, bits0, bits1):
+    """Joint GLV ladder over affine tables {P, endo(P), P+endo(P)}."""
+    flat = _flat_point(pt) + _flat_point(phi) + _flat_point(p3)
     arrs = []
     shape = b = None
     for x in flat:
@@ -1291,8 +1415,8 @@ def scalar_mul_glv_g1_mixed(pt, phi, p3, bits0, bits1):
 
     b0, b1 = prep(bits0), prep(bits1)
     if _use_kernels():
-        out = _ladder_glv_mixed_call(nbits, btot)(_P_FULL, _ONE_FULL,
-                                                  *arrs, b0, b1)
+        out = _ladder_glv_mixed_call(kind, nbits, btot)(_P_FULL, _ONE_FULL,
+                                                        *arrs, b0, b1)
     else:
-        out = _ladder_glv_mixed_direct(nbits)(b0, b1, *arrs)
-    return _point_from_lanes("G1", out, shape, b)
+        out = _ladder_glv_mixed_direct(kind, nbits)(b0, b1, *arrs)
+    return _point_from_lanes(kind, out, shape, b)

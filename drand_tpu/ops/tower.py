@@ -113,6 +113,28 @@ def fp2_inv(a):
     return (r[0], L.neg_mod(r[1]))
 
 
+def fp2_pow_fixed(a, e: int):
+    """a^e in Fp2 (Montgomery) for a *static* exponent via an MSB-first
+    square-and-multiply `lax.scan`.  Long chains (the G2 sqrt_ratio
+    exponent (p^2-9)/16) dispatch to the fused Pallas Fp2 pow kernel."""
+    import jax
+
+    if e.bit_length() >= 64:
+        from . import pallas_field as PF
+        if PF.enabled():
+            return PF.pow_fixed_fp2(a, e)
+    bits = jnp.asarray(L._exp_bits(e))
+    acc0 = fp2_ones(a[0].shape[:-1])
+
+    def step(acc, bit):
+        acc = fp2_sqr(acc)
+        acc = fp2_select(bit == 1, fp2_mul(acc, a), acc)
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, acc0, bits)
+    return acc
+
+
 def fp2_is_zero(a):
     return L.is_zero(a[0]) & L.is_zero(a[1])
 

@@ -296,18 +296,23 @@ class GossipRelayNode(GrpcRelayNode):
         targets = [p for p in self.peers if p not in exclude]
         if len(targets) > self.fanout:
             targets = random.sample(targets, self.fanout)
+        enq = time.monotonic()
         for addr in targets:
             # bounded sender pool, not thread-per-send: slow peers (5 s
             # timeout each) must queue, not pile up hundreds of threads
-            self._send_pool.submit(self._send, addr, res)
+            self._send_pool.submit(self._send, addr, res, enq)
 
-    def _send(self, addr: str, res: Result) -> None:
+    # sends that sat queued longer than this behind slow/blackholed peers
+    # are dropped — the round is stale to the mesh by then, and dropping
+    # keeps the queue draining.  Gated on QUEUE AGE, not round recency: a
+    # catch-up burst delivers many rounds back-to-back and every one of
+    # them must still be forwarded when the pool is keeping up.
+    SEND_MAX_QUEUE_AGE = 10.0
+
+    def _send(self, addr: str, res: Result, enq: float = 0.0) -> None:
         from .protos import drand_pb2 as pb
 
-        # staleness drop: if newer rounds were delivered while this send sat
-        # queued behind slow/blackholed peers, forwarding it helps nobody and
-        # keeps the queue from draining (unlocked read — heuristic only)
-        if res.round < self._latest - 1:
+        if enq and time.monotonic() - enq > self.SEND_MAX_QUEUE_AGE:
             return
         pkt = pb.GossipBeaconPacket(
             chain_hash=self._chain_hash, round=res.round,

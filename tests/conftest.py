@@ -26,6 +26,30 @@ if os.environ.get("DRAND_TPU_TEST_CACHE", "1") != "0":
     jax.config.update("jax_compilation_cache_dir",
                       "/tmp/drand_tpu_jax_cache_cpu")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+
+    # jax's filesystem cache writes are a bare write_bytes with NO lock
+    # when eviction is disabled (jax/_src/lru_cache.py) — two xdist
+    # workers cold-compiling the same program race the same file and the
+    # interleaved result is a plausible-looking entry that SEGFAULTS the
+    # deserializer on every later read (the round-4 "poisoned cache"
+    # postmortem: reproducible worker crashes in get_executable_and_time
+    # until the entry is deleted).  Make writes atomic: unique temp file
+    # + os.replace, last full write wins.
+    import uuid
+
+    from jax._src import lru_cache as _jlc
+
+    def _atomic_put(self, key, val):
+        if not key:
+            raise ValueError("key cannot be empty")
+        cache_path = self.path / f"{key}{_jlc._CACHE_SUFFIX}"
+        if cache_path.exists():
+            return
+        tmp = self.path / f".tmp-{uuid.uuid4().hex}"
+        tmp.write_bytes(val)
+        os.replace(str(tmp), str(cache_path))
+
+    _jlc.LRUCache.put = _atomic_put
 else:
     jax.config.update("jax_enable_compilation_cache", False)
 # Under axon the sitecustomize registers the TPU plugin at interpreter start
@@ -47,3 +71,44 @@ _HEAVY = ("test_batch", "test_multichip", "test_ops_curve_pairing",
 
 def pytest_collection_modifyitems(config, items):
     items.sort(key=lambda it: any(h in it.nodeid for h in _HEAVY))
+
+
+# XLA's CPU compiler recurses deeply on the big scan/pairing programs.
+# Under xdist the test body runs on an execnet-spawned thread whose stack
+# is FIXED at creation (unlike the main thread's demand-grown stack), and
+# the deepest programs (e.g. the G2 sign pipeline: a 758-step Fp2 pow
+# scan nested under a 256-step ladder scan) segfault mid-compile there —
+# reproducibly under `-n 4`, never under `-n 0`.  Fix at the harness
+# level: run every test body in a fresh thread with a large explicit
+# stack when inside an xdist worker.
+_BIG_STACK = 512 * 1024 * 1024  # virtual reservation; touched pages only
+
+import pytest  # noqa: E402
+import threading  # noqa: E402
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    if os.environ.get("PYTEST_XDIST_WORKER") is None:
+        return None                      # main process: growable stack
+    result = {}
+
+    def run():
+        try:
+            fn = pyfuncitem.obj
+            kwargs = {name: pyfuncitem.funcargs[name]
+                      for name in pyfuncitem._fixtureinfo.argnames}
+            result["value"] = fn(**kwargs)
+        except BaseException as e:       # re-raised in the worker thread
+            result["exc"] = e
+
+    old = threading.stack_size(_BIG_STACK)
+    try:
+        th = threading.Thread(target=run, name="bigstack-test")
+        th.start()
+        th.join()
+    finally:
+        threading.stack_size(old)
+    if "exc" in result:
+        raise result["exc"]
+    return True

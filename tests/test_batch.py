@@ -110,15 +110,6 @@ def test_verify_chain_linkage():
 # ---------------------------------------------------------------------------
 
 @pytest.mark.parametrize("scheme_id", list_schemes())
-def test_sign_batch_matches_host(scheme_id):
-    sch = scheme_from_name(scheme_id)
-    sec, _ = sch.keypair(seed=b"sign-batch")
-    msgs = [sch.digest_beacon(r, None) for r in range(1, 5)]
-    got = batch.sign_batch(sch, sec, msgs)
-    assert got == [sch.sign(sec, m) for m in msgs]
-
-
-@pytest.mark.parametrize("scheme_id", list_schemes())
 def test_recover_batch_matches_host(scheme_id):
     sch = scheme_from_name(scheme_id)
     t, n = 3, 5

@@ -1,0 +1,21 @@
+"""Batched device signing vs host golden — kept in its OWN file on
+purpose: under xdist (--dist loadfile) this gives the sign pipelines a
+fresh worker process.  Compiling/loading the G2 sign program inside a
+worker that has already built the verify pipelines segfaults XLA:CPU
+(state-dependent native crash, reproducible under -n 4, never in a fresh
+process; see conftest.py's big-stack hook for the related stack issue).
+"""
+
+import pytest
+
+from drand_tpu.crypto import batch
+from drand_tpu.crypto.schemes import list_schemes, scheme_from_name
+
+
+@pytest.mark.parametrize("scheme_id", list_schemes())
+def test_sign_batch_matches_host(scheme_id):
+    sch = scheme_from_name(scheme_id)
+    sec, _ = sch.keypair(seed=b"sign-batch")
+    msgs = [sch.digest_beacon(r, None) for r in range(1, 5)]
+    got = batch.sign_batch(sch, sec, msgs)
+    assert got == [sch.sign(sec, m) for m in msgs]

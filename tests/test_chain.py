@@ -277,3 +277,41 @@ def test_postgres_store_gated():
     from drand_tpu.chain.postgresdb import PostgresStore
     with pytest.raises(RuntimeError, match="psycopg2"):
         PostgresStore("dbname=drand")
+
+
+def test_pg_dialect_guards(tmp_path):
+    """The shim enforces portable-postgres SQL (VERDICT r3 #8): sqlite-only
+    placeholders and target-less DO UPDATE are rejected at execute time,
+    and bytea columns come back as memoryview exactly like psycopg2 — a
+    missing bytes() wrap in store code fails in the matrix, not on a live
+    server."""
+    import pytest
+
+    from drand_tpu.chain import _pgcompat
+    from drand_tpu.chain.postgresdb import PostgresStore
+
+    s = PostgresStore(str(tmp_path / "pg.db"), driver=_pgcompat)
+    s.put(Beacon(round=1, signature=b"\x01" * 48))
+
+    # signatures surface as bytes in the public API despite memoryview rows
+    b = s.get(1)
+    assert type(b.signature) is bytes
+
+    # raw rows mimic psycopg2's bytea typing
+    with s.conn.cursor() as cur:
+        cur.execute("SELECT signature FROM beacons WHERE round=%s", (1,))
+        (sig,) = cur.fetchone()
+    assert isinstance(sig, memoryview)
+
+    # dialect violations are assertions, not silent sqlite successes
+    with s.conn.cursor() as cur:
+        with pytest.raises(AssertionError, match="placeholders"):
+            cur.execute("SELECT 1 WHERE 1=?", (1,))
+        with pytest.raises(AssertionError, match="conflict target"):
+            cur.execute("INSERT INTO beacon_ids (name) VALUES (%s) "
+                        "ON CONFLICT DO UPDATE SET name=excluded.name",
+                        ("x",))
+    # literal '?' inside a string constant is NOT a placeholder
+    with s.conn.cursor() as cur:
+        cur.execute("SELECT name FROM beacon_ids WHERE name = 'what?'")
+    s.close()

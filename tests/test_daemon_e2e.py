@@ -55,16 +55,24 @@ def _run_dkg(daemons, n, thr, period=4, beacon_id="default"):
             errors.append(e)
 
     def follower(i):
-        time.sleep(0.5)
+        # event-driven join (VERDICT r3 #9): retry until the leader's setup
+        # phase is accepting, instead of one fixed sleep that flakes when a
+        # loaded host delays the leader thread
         cc = ControlClient(daemons[i].control.port)
         req = pb.InitDKGPacket(
             info=pb.SetupInfo(leader=False, leader_address=leader_addr,
                               timeout_seconds=30, secret=SECRET),
             metadata=convert.metadata(beacon_id))
-        try:
-            results[i] = cc.stub.init_dkg(req, timeout=120)
-        except Exception as e:
-            errors.append(e)
+        join_deadline = time.time() + 30
+        while True:
+            try:
+                results[i] = cc.stub.init_dkg(req, timeout=120)
+                return
+            except Exception as e:
+                if time.time() >= join_deadline:
+                    errors.append(e)
+                    return
+                time.sleep(0.2)
 
     threads = [threading.Thread(target=leader)] + [
         threading.Thread(target=follower, args=(i,))
@@ -289,12 +297,16 @@ def test_reshare_add_node(tmp_path):
                 info=info,
                 old_group_path=str(old_path) if i == 3 else "",
                 metadata=convert.metadata("default"))
-            try:
-                if not leader:
-                    time.sleep(0.5)
-                results[i] = cc.stub.init_reshare(req, timeout=150)
-            except Exception as e:
-                errors.append((i, e))
+            join_deadline = time.time() + 30
+            while True:
+                try:
+                    results[i] = cc.stub.init_reshare(req, timeout=150)
+                    return
+                except Exception as e:
+                    if leader or time.time() >= join_deadline:
+                        errors.append((i, e))
+                        return
+                    time.sleep(0.2)  # leader setup not accepting yet: retry
 
         threads = [threading.Thread(target=reshare, args=(i, i == 0))
                    for i in range(4)]

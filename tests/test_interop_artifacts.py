@@ -1,0 +1,124 @@
+"""Wire-interop KATs against REAL reference artifacts (VERDICT r3 #7).
+
+Two pins:
+  * the original League of Entropy deploy group file
+    (/root/reference/deploy/latest/group.toml, key/group.go:196-299 format)
+    parses through the TOML codec, every node key and the collective key
+    decode to canonical subgroup points, and the codec round-trips;
+  * a PublicRandResponse hand-encoded at the protobuf WIRE level with the
+    reference's field numbers (protobuf/drand/api.proto:16-28) parses
+    through drand_pb2 and its signature verifies against the LoE mainnet
+    chain key (the crypto/schemes_test.go:81-130 vector).
+"""
+
+import os
+
+import pytest
+
+from drand_tpu.crypto import schemes
+from drand_tpu.crypto.host.serialize import g1_from_bytes
+from drand_tpu.key.group import Group
+from drand_tpu.protos import drand_pb2 as pb
+
+REF_GROUP = "/root/reference/deploy/latest/group.toml"
+
+# LoE mainnet chained-scheme vector (also pinned in test_host_crypto.py)
+MAINNET_PK = bytes.fromhex(
+    "868f005eb8e6e4ca0a47c8a77ceaa5309a47978a7c71bc5cce96366b5d7a5699"
+    "37c529eeda66c7293784a9402801af31")
+MAINNET_ROUND = 2634945
+MAINNET_SIG = bytes.fromhex(
+    "814778ed1e480406beb43b74af71ce2f0373e0ea1bfdfea8f9ed62c876c20fcb"
+    "c7f0163860e3da42ed2148756015f4551451898ffe06d384b4d002245025571b"
+    "6b7a752f7158b40ad92b13b6d703ad31922a617f2c7f6d960b84d56cf1d79eef")
+MAINNET_PREV = bytes.fromhex(
+    "8bd96294383b4d1e04e736360bd7a487f9f409f1e7bd800b720656a310d577b3"
+    "bdb1e1631af6c5782a1d8979c502f395036181eff4058960fc40bb7034cdae19"
+    "91d3eda518ab204a077d2f7e724974cf87b407e549bd815cf0b8e5a3832f675d")
+
+
+@pytest.mark.skipif(not os.path.exists(REF_GROUP),
+                    reason="reference deploy artifacts not present")
+def test_reference_group_toml_parses_and_pins():
+    """The 2019/2020 LoE deploy group file is the compatibility bar: a
+    v1-era file with no SchemeID/ID keys (defaults apply), TLS flags, no
+    node signatures, and a 6-coefficient [PublicKey] section."""
+    with open(REF_GROUP) as f:
+        text = f.read()
+    g = Group.from_toml(text)
+
+    # structural pins straight from the artifact
+    assert g.threshold == 6
+    assert g.period == 30
+    assert g.genesis_time == 1590032610
+    assert g.genesis_seed == bytes.fromhex(
+        "7653d86e0b5fe59da082f16991f951413156ecbeba2ddf5aab406ed26fe9d4ec")
+    assert g.scheme.id == "pedersen-bls-chained"   # absent SchemeID = default
+    assert len(g.nodes) == 10
+    assert [n.index for n in g.nodes] == list(range(10))
+    assert g.nodes[1].identity.addr == "drand.cloudflare.com:8080"
+    assert all(n.identity.tls for n in g.nodes)
+
+    # every node key and all 6 collective-key coefficients must decode to
+    # canonical, on-curve, in-subgroup G1 points (zcash serialization)
+    for n in g.nodes:
+        assert g1_from_bytes(n.identity.key, check_subgroup=True) is not None
+    assert g.public_key is not None
+    assert len(g.public_key.coefficients) == 6
+    for c in g.public_key.coefficients:
+        assert g1_from_bytes(c, check_subgroup=True) is not None
+
+    # codec round-trip preserves the group hash (group.go Hash())
+    g2 = Group.from_toml(g.to_toml())
+    assert g2.hash() == g.hash()
+    assert g2.public_key.coefficients == g.public_key.coefficients
+
+
+def _varint(x: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        if x:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field(num: int, wire: int, payload: bytes = b"", value: int = 0) -> bytes:
+    tag = _varint(num << 3 | wire)
+    if wire == 0:
+        return tag + _varint(value)
+    return tag + _varint(len(payload)) + payload
+
+
+def test_public_rand_response_wire_kat():
+    """A PublicRandResponse encoded at the raw protobuf wire level with
+    the reference field numbers (round=1 varint, signature=2 bytes,
+    previous_signature=3 bytes, randomness=4 bytes) parses through the
+    compiled drand_pb2 and verifies against the mainnet chain key."""
+    import hashlib
+
+    randomness = hashlib.sha256(MAINNET_SIG).digest()
+    wire = (_field(1, 0, value=MAINNET_ROUND)
+            + _field(2, 2, MAINNET_SIG)
+            + _field(3, 2, MAINNET_PREV)
+            + _field(4, 2, randomness))
+
+    msg = pb.PublicRandResponse()
+    msg.ParseFromString(wire)
+    assert msg.round == MAINNET_ROUND
+    assert msg.signature == MAINNET_SIG
+    assert msg.previous_signature == MAINNET_PREV
+    assert msg.randomness == randomness
+
+    # full cryptographic verification through the scheme layer
+    sch = schemes.scheme_from_name("pedersen-bls-chained")
+    assert sch.verify_beacon(MAINNET_PK, msg.round,
+                             msg.previous_signature, msg.signature)
+    assert schemes.randomness_from_signature(msg.signature) == randomness
+
+    # and the codec re-serializes to the identical wire bytes (fields in
+    # ascending order, no unknowns) — what a reference client would read
+    assert msg.SerializeToString() == wire
