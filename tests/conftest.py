@@ -50,6 +50,47 @@ if os.environ.get("DRAND_TPU_TEST_CACHE", "1") != "0":
         os.replace(str(tmp), str(cache_path))
 
     _jlc.LRUCache.put = _atomic_put
+
+    # Second failure mode (the "round-2 serialize segfault", back for the
+    # round-4 G2 programs): XLA:CPU executable SERIALIZATION segfaults on
+    # certain big programs — after a successful compile, during the cache
+    # write.  Run the whole serialize+write in a forked child: a crash
+    # there costs only the cache entry, never the test process.  The
+    # atomic temp+rename above makes a killed child harmless.
+    import time as _time
+
+    from jax._src import compilation_cache as _cc
+
+    _orig_put_exec = _cc.put_executable_and_time
+
+    def _forked_put_executable(cache_key, module_name, executable, backend,
+                               compile_time):
+        pid = os.fork()
+        if pid == 0:
+            code = 0
+            try:
+                _orig_put_exec(cache_key, module_name, executable, backend,
+                               compile_time)
+            except BaseException:
+                code = 1
+            finally:
+                os._exit(code)
+        deadline = _time.time() + 300
+        while _time.time() < deadline:
+            done, _status = os.waitpid(pid, os.WNOHANG)
+            if done:
+                return
+            _time.sleep(0.05)
+        os.kill(pid, 9)                      # fork-deadlocked child
+        os.waitpid(pid, 0)
+
+    _cc.put_executable_and_time = _forked_put_executable
+    # compiler.py binds the name at import time in some versions — patch
+    # its reference too if it resolved one
+    from jax._src import compiler as _jcompiler
+    if hasattr(_jcompiler, "compilation_cache"):
+        _jcompiler.compilation_cache.put_executable_and_time = \
+            _forked_put_executable
 else:
     jax.config.update("jax_enable_compilation_cache", False)
 # Under axon the sitecustomize registers the TPU plugin at interpreter start

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Round-4 on-chip validation: the new Mosaic kernels (Fp2 pow scan, G2
+psi-split GLV ladder, recursive sum reduction, fused G2 front end) have
+CPU-identical math (tests pin the direct lowering), but the compiled
+Mosaic kernels themselves only run on the TPU — this drives each through
+the package boundary at small N and cross-checks against the host golden
+code before any bench/prewarm run trusts them.
+
+    python tools/chip_validate_r4.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/drand_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/drand_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+t0 = time.time()
+
+
+def mark(s):
+    print(f"[{time.time() - t0:7.1f}s] {s}", flush=True)
+
+
+def main():
+    assert jax.default_backend() in ("tpu", "axon"), jax.default_backend()
+    mark(f"devices: {jax.devices()}")
+
+    import random
+
+    from drand_tpu.crypto.host import curve as C
+    from drand_tpu.crypto.host import field as HF
+    from drand_tpu.crypto.host import h2c as HH
+    from drand_tpu.crypto.host import serialize as S
+    from drand_tpu.crypto.host.params import DST_G2, P, R, X as BLS_X
+    from drand_tpu.ops import curve as DC
+    from drand_tpu.ops import h2c as DH
+    from drand_tpu.ops import tower as T
+
+    random.seed(7)
+
+    # 1. Fp2 pow kernel
+    xs = [(random.randrange(P), random.randrange(P)) for _ in range(4)]
+    e = (P * P - 9) // 16
+    a = (jnp.stack([T.encode_fp2(x)[0] for x in xs]),
+         jnp.stack([T.encode_fp2(x)[1] for x in xs]))
+    out = jax.jit(lambda a: T.fp2_pow_fixed(a, e))(a)
+    got = [T.decode_fp2((out[0][i], out[1][i])) for i in range(4)]
+    assert got == [HF.fp2_pow(x, e) for x in xs], "fp2 pow kernel"
+    mark("fp2 pow kernel ok")
+
+    # 2. fused G2 front end (sqrt_ratio scan + candidates + isogeny)
+    msgs = [b"chip-%d" % i for i in range(4)]
+    u0, u1 = DH.hash_msgs_to_field_g2(msgs, DST_G2)
+    pts = jax.jit(DH.hash_to_g2_jac)(u0, u1)
+    got = DC.decode_g2_points(pts)
+    assert got == [HH.hash_to_curve_g2(m, DST_G2) for m in msgs], "g2 h2c"
+    mark("G2 hash-to-curve kernel chain ok")
+
+    from drand_tpu.crypto.batch import _wire_parse
+    wire = [S.g2_to_bytes(p) for p in got]
+    xw, sign, bad = _wire_parse(wire, True)
+    sig_jac, ok, hm = jax.jit(DH.g2_decompress_and_hash)(
+        jnp.asarray(np.ascontiguousarray(xw[:, 0])),
+        jnp.asarray(np.ascontiguousarray(xw[:, 1])),
+        jnp.asarray(sign), u0, u1)
+    assert np.asarray(ok).all()
+    assert DC.decode_g2_points(sig_jac) == got
+    assert DC.decode_g2_points(hm) == got
+    mark("fused G2 decompress+hash ok")
+
+    # 3. psi-split GLV ladder kernel
+    ks = [random.randrange(1, R) for _ in range(2)]
+    host_pts = [C.G2.mul(C.G2.gen, k) for k in ks]
+    q = DC.encode_g2_points(host_pts)
+    k0 = [random.randrange(2 ** 32) for _ in range(2)]
+    k1 = [random.randrange(2 ** 32) for _ in range(2)]
+    b0 = DC.scalars_to_bits(k0, nbits=32)
+    b1 = DC.scalars_to_bits(k1, nbits=32)
+    gl = DC.decode_g2_points(jax.jit(DC.g2_glv_msm_terms)(q, b0, b1))
+    full = [k0[i] + BLS_X ** 2 * k1[i] for i in range(2)]
+    assert gl == [C.G2.mul(host_pts[i], full[i] % R) for i in range(2)], \
+        "g2 glv kernel"
+    mark("G2 psi-split GLV ladder kernel ok")
+
+    # 4. recursive sum reduction at a two-level width (1024 lanes)
+    n = 1024
+    ks1 = [random.randrange(1, R) for _ in range(8)]
+    hp = [C.G1.mul(C.G1.gen, k) for k in ks1]
+    rows = [hp[i % 8] for i in range(n)]
+    p1 = DC.encode_g1_points(rows)
+    s = jax.jit(DC.G1_DEV.sum_points)(p1)
+    want = None
+    for pt in rows:
+        want = C.G1.add(want, pt) if want else pt
+    assert DC.decode_g1_points(jax.tree.map(lambda t: t[None], s))[0] == want, \
+        "sum recursion"
+    mark("recursive sum_points kernel ok (1024 lanes, 2 levels)")
+
+    # 5. end-to-end small verify, both scheme families
+    from drand_tpu.crypto import batch, schemes
+    for sid in (schemes.SHORT_SIG_SCHEME_ID, schemes.UNCHAINED_SCHEME_ID):
+        sch = schemes.scheme_from_name(sid)
+        sec, pub = sch.keypair(seed=b"chipval")
+        ms = [sch.digest_beacon(r, None) for r in range(1, 9)]
+        sigs = batch.sign_batch(sch, sec, ms)
+        ver = batch.BatchBeaconVerifier(sch, sch.public_bytes(pub))
+        assert ver.verify_batch(list(range(1, 9)), sigs).all(), sid
+        # one corrupted signature must be caught
+        bad_sigs = list(sigs)
+        bad_sigs[3] = sigs[4]
+        got = ver.verify_batch(list(range(1, 9)), bad_sigs)
+        assert not got[3] and got.sum() == 7, (sid, got)
+        mark(f"end-to-end verify ok ({sid})")
+
+    print("CHIP VALIDATION: ALL OK")
+
+
+if __name__ == "__main__":
+    main()
