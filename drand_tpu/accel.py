@@ -1,0 +1,59 @@
+"""Accelerator pre-flight probe (shared by bench.py and __graft_entry__).
+
+Why a SUBPROCESS: against a wedged axon tunnel, backend initialization
+(`jax.devices()`) blocks indefinitely in native code while holding jax's
+global backend lock — a probe thread therefore poisons its own process
+(anything else that later touches the backend deadlocks on that lock),
+and an in-process probe with no timeout eats the whole caller budget
+(the round-4 driver bench spent its entire window inside backend init).
+A subprocess can simply be killed at a deadline; the caller's process
+never initializes a backend the probe didn't prove healthy.
+
+Why the config-level platform pin: the axon sitecustomize force-sets
+`jax_platforms` at interpreter start, overriding any JAX_PLATFORMS env
+var — pinning must happen via `jax.config.update` + `clear_backends`
+inside the probe interpreter itself.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_PROBE_CODE = (
+    "import os, json\n"
+    "plat = os.environ.get('DRAND_TPU_PROBE_PLATFORM')\n"
+    "import jax\n"
+    "if plat:\n"
+    "    from jax.extend.backend import clear_backends\n"
+    "    jax.config.update('jax_platforms', plat)\n"
+    "    clear_backends()\n"
+    "print('PROBE ' + json.dumps({'backend': jax.default_backend(),"
+    " 'devices': len(jax.devices())}), flush=True)\n"
+)
+
+
+def probe_backend(env=None, timeout=90, platform=None):
+    """Initialize a JAX backend in a throwaway subprocess.
+
+    Returns ``(info, detail)``: ``info`` is ``{"backend": str, "devices":
+    int}`` on success, else ``None``; ``detail`` is a short human-readable
+    string for logs/records (the probe JSON, the timeout notice, or the
+    last line of the failing probe's stderr).
+    """
+    env = dict(os.environ if env is None else env)
+    if platform:
+        env["DRAND_TPU_PROBE_PLATFORM"] = platform
+    try:
+        pr = subprocess.run([sys.executable, "-c", _PROBE_CODE], env=env,
+                            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, f"backend init hung >{timeout}s (tunnel wedged?)"
+    for line in pr.stdout.splitlines():
+        if line.startswith("PROBE "):
+            try:
+                return json.loads(line[6:]), line[6:]
+            except ValueError:
+                break
+    tail = (pr.stderr or pr.stdout).strip().splitlines()
+    return None, (tail[-1] if tail else f"probe exit {pr.returncode}")[:200]

@@ -14,13 +14,19 @@ import re
 import sqlite3
 import threading
 
+# Postgres string literal, including doubled-quote escapes ('it''s ok?' is
+# ONE literal).  Shared with tests/test_pg_dialect.py so the dialect guard
+# and the test pinning it cannot drift.
+LITERAL_RE = r"'(?:[^']|'')*'"
+
 
 def _translate(sql: str) -> str:
     # Dialect guard (VERDICT r3 #8): the store must emit PORTABLE postgres
     # SQL — psycopg2 placeholders only ('?' would pass here but fail on a
     # live server), and only upsert forms valid in BOTH dialects (postgres
     # requires a conflict target for DO UPDATE; bare DO NOTHING is fine).
-    if "?" in re.sub(r"'[^']*'", "", sql):
+    # strip string literals first before scanning for '?'
+    if "?" in re.sub(LITERAL_RE, "", sql):
         raise AssertionError(
             "store SQL uses sqlite-style '?' placeholders; psycopg2 needs %s")
     if re.search(r"ON CONFLICT DO UPDATE", sql, re.IGNORECASE):

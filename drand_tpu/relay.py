@@ -111,7 +111,18 @@ class GrpcRelayNode:
 
     def _deliver(self, res: Result) -> bool:
         """Insert one validated round into the serving cache; returns False
-        for duplicates (already delivered)."""
+        for duplicates (already delivered).
+
+        Eviction is a pure watermark (latest - buffer), deliberately: any
+        round at or below it is treated as seen even if it never arrived,
+        so a legitimately late straggler more than `buffer` behind latest
+        is dropped without ever being cached or forwarded.  That is the
+        anti-replay-storm tradeoff (a fresh node joining a mesh must not
+        re-gossip deep history at it); stragglers that recent clients still
+        need are served by the HTTP/gRPC catch-up path, not the gossip
+        fan-out.  The libp2p reference instead keeps a TTL'd seen-set
+        (lp2p/client) — switch to that if first-time delivery of deep
+        stragglers ever matters more than storm immunity."""
         with self._lock:
             if res.round in self._cache or res.round <= self._evicted:
                 return False

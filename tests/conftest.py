@@ -35,21 +35,42 @@ if os.environ.get("DRAND_TPU_TEST_CACHE", "1") != "0":
     # postmortem: reproducible worker crashes in get_executable_and_time
     # until the entry is deleted).  Make writes atomic: unique temp file
     # + os.replace, last full write wins.
+    # Both patches below reach into jax._src private modules (no public
+    # hook exists for either failure mode — docs/jax-cache-issues.md holds
+    # the upstream issue text and the remediation if a jax upgrade moves
+    # them).  Guard on the exact internals we touch: on mismatch, warn and
+    # fall back to stock behavior instead of breaking the suite obscurely.
+    import inspect
     import uuid
+    import warnings
 
-    from jax._src import lru_cache as _jlc
+    def _jax_internals_mismatch(what):
+        warnings.warn(
+            f"jax {jax.__version__}: internals changed ({what}); cache "
+            "hardening patch SKIPPED — expect rare cache races/segfaults "
+            "under xdist; see docs/jax-cache-issues.md", RuntimeWarning)
 
-    def _atomic_put(self, key, val):
-        if not key:
-            raise ValueError("key cannot be empty")
-        cache_path = self.path / f"{key}{_jlc._CACHE_SUFFIX}"
-        if cache_path.exists():
-            return
-        tmp = self.path / f".tmp-{uuid.uuid4().hex}"
-        tmp.write_bytes(val)
-        os.replace(str(tmp), str(cache_path))
+    try:
+        from jax._src import lru_cache as _jlc
+        _ok = (hasattr(_jlc, "LRUCache") and hasattr(_jlc, "_CACHE_SUFFIX")
+               and list(inspect.signature(_jlc.LRUCache.put).parameters)
+               == ["self", "key", "val"])
+    except ImportError:
+        _ok = False
+    if _ok:
+        def _atomic_put(self, key, val):
+            if not key:
+                raise ValueError("key cannot be empty")
+            cache_path = self.path / f"{key}{_jlc._CACHE_SUFFIX}"
+            if cache_path.exists():
+                return
+            tmp = self.path / f".tmp-{uuid.uuid4().hex}"
+            tmp.write_bytes(val)
+            os.replace(str(tmp), str(cache_path))
 
-    _jlc.LRUCache.put = _atomic_put
+        _jlc.LRUCache.put = _atomic_put
+    else:
+        _jax_internals_mismatch("jax._src.lru_cache.LRUCache.put")
 
     # Second failure mode (the "round-2 serialize segfault", back for the
     # round-4 G2 programs): XLA:CPU executable SERIALIZATION segfaults on
@@ -59,9 +80,17 @@ if os.environ.get("DRAND_TPU_TEST_CACHE", "1") != "0":
     # atomic temp+rename above makes a killed child harmless.
     import time as _time
 
-    from jax._src import compilation_cache as _cc
-
-    _orig_put_exec = _cc.put_executable_and_time
+    try:
+        from jax._src import compilation_cache as _cc
+        _orig_put_exec = _cc.put_executable_and_time
+        _ok = (list(inspect.signature(_orig_put_exec).parameters)
+               == ["cache_key", "module_name", "executable", "backend",
+                   "compile_time"])
+    except (ImportError, AttributeError):
+        _ok = False
+    if not _ok:
+        _jax_internals_mismatch(
+            "jax._src.compilation_cache.put_executable_and_time")
 
     def _forked_put_executable(cache_key, module_name, executable, backend,
                                compile_time):
@@ -84,13 +113,14 @@ if os.environ.get("DRAND_TPU_TEST_CACHE", "1") != "0":
         os.kill(pid, 9)                      # fork-deadlocked child
         os.waitpid(pid, 0)
 
-    _cc.put_executable_and_time = _forked_put_executable
-    # compiler.py binds the name at import time in some versions — patch
-    # its reference too if it resolved one
-    from jax._src import compiler as _jcompiler
-    if hasattr(_jcompiler, "compilation_cache"):
-        _jcompiler.compilation_cache.put_executable_and_time = \
-            _forked_put_executable
+    if _ok:
+        _cc.put_executable_and_time = _forked_put_executable
+        # compiler.py binds the name at import time in some versions — patch
+        # its reference too if it resolved one
+        from jax._src import compiler as _jcompiler
+        if hasattr(_jcompiler, "compilation_cache"):
+            _jcompiler.compilation_cache.put_executable_and_time = \
+                _forked_put_executable
 else:
     jax.config.update("jax_enable_compilation_cache", False)
 # Under axon the sitecustomize registers the TPU plugin at interpreter start
@@ -132,6 +162,9 @@ import threading  # noqa: E402
 def pytest_pyfunc_call(pyfuncitem):
     if os.environ.get("PYTEST_XDIST_WORKER") is None:
         return None                      # main process: growable stack
+    import inspect
+    if inspect.iscoroutinefunction(getattr(pyfuncitem, "obj", None)):
+        return None                      # let an async plugin drive it
     result = {}
 
     def run():
