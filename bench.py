@@ -216,11 +216,12 @@ def bench_unchained_resident():
             enc, _rlc_scalars(len(rounds), PAD, split=2))
         jax.block_until_ready(enc)
         encs.append((enc, len(rounds)))
-    assert ver._rlc_ok(*encs[0])                  # warm/compile
+    ok = ver._rlc_ok(*encs[0])                    # warm/compile
+    assert ok
     t0 = time.perf_counter()
-    for enc, n in encs:
-        assert ver._rlc_ok(enc, n)
+    oks = [ver._rlc_ok(enc, n) for enc, n in encs]
     dt = time.perf_counter() - t0
+    assert all(oks)
     return N_RESIDENT / dt
 
 

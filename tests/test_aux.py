@@ -98,3 +98,27 @@ def test_entropy_sources(tmp_path):
     bad.write_text("#!/bin/sh\nexit 1\n")
     bad.chmod(0o755)
     assert len(get_random(ScriptReader(str(bad)), 16)) == 16
+
+
+def test_accel_probe_backend_cpu():
+    """probe_backend must pin the platform at config level inside the
+    probe interpreter (env vars are overridden by the axon sitecustomize)
+    and report backend + device count without touching this process's
+    backend state."""
+    from drand_tpu.accel import probe_backend
+
+    info, detail = probe_backend(timeout=120, platform="cpu")
+    assert info is not None, detail
+    assert info["backend"] == "cpu"
+    assert info["devices"] >= 1
+    assert "cpu" in detail
+
+
+def test_accel_probe_backend_failure_modes():
+    from drand_tpu.accel import probe_backend
+
+    # a probe whose backend init fails must report the stderr tail, not
+    # hang or raise into the caller
+    info, detail = probe_backend(timeout=120, platform="no_such_platform")
+    assert info is None
+    assert detail

@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-5 on-chip sequence (PERF.md / VERDICT r4 #1): run the moment the
+# axon tunnel is reachable.  Each stage logs to /tmp/r5_chip_*.log and a
+# failure stops the sequence (later stages trust earlier ones).
+#
+#   1. chip_validate_r4  — every r4 Mosaic kernel vs host goldens ON CHIP
+#   2. bench.py          — all five configs; doubles as the cache prewarm
+#   3. profile_stages    — per-stage device tables for PERF.md (G1 + G2)
+#   4. 3M streamed replay — honest config-5 scale number (streamed_3m_s)
+#
+# After stage 2: do NOT edit drand_tpu/ops/*, crypto/batch.py, h2c.py or
+# any traced-kernel file — Mosaic cache keys embed file:line and every
+# edit forces a full recompile of every on-chip program (memory:
+# jax-cache-key-instability).  Freeze first, prewarm second.
+set -u
+cd "$(dirname "$0")/.."
+
+run() {
+  local name="$1"; shift
+  echo "=== $name: $* ==="
+  local t0=$SECONDS
+  "$@" > "/tmp/r5_chip_${name}.log" 2>&1
+  local rc=$?
+  echo "=== $name rc=$rc wall=$((SECONDS - t0))s (log /tmp/r5_chip_${name}.log)"
+  [ $rc -ne 0 ] && tail -5 "/tmp/r5_chip_${name}.log"
+  return $rc
+}
+
+run validate timeout 3600 python tools/chip_validate_r4.py || exit 1
+run bench timeout 5400 python bench.py || exit 1
+run profile_g1 timeout 1800 python tools/profile_stages.py 8192
+run profile_g2 timeout 2400 python tools/profile_stages.py --g2 8192
+# 366 x 8192 = 2,998,272 rounds streamed from a populated store; fixture
+# generation on first run is device-signed and cached in /tmp (setup, not
+# measurement) but adds real wall time — keep it last.
+DRAND_TPU_BENCH_CONFIGS=5 DRAND_TPU_BENCH_N=2998272 \
+  run stream3m timeout 9000 python bench.py
+echo "=== chip sequence done; see /tmp/r5_chip_*.log"
