@@ -67,6 +67,14 @@ N_MIXED = int(os.environ.get("DRAND_TPU_BENCH_N_MIXED", "4096"))
 CHUNK = int(os.environ.get("DRAND_TPU_BENCH_CHUNK", str(PAD)))
 
 
+def _progress(msg):
+    """Child -> parent heartbeat: config 3 is a chain of several big cold
+    compiles (the partials-verify program alone is tens of minutes on a
+    cold CPU cache), and the parent's no-progress watchdog must not kill
+    a config that is legitimately still compiling its next stage."""
+    print(json.dumps({"progress": msg}), flush=True)
+
+
 def _configs():
     raw = os.environ.get("DRAND_TPU_BENCH_CONFIGS", "1,2,3,4,5")
     out = set()
@@ -251,21 +259,27 @@ def bench_partials_recover():
 
     bpv = BatchPartialVerifier(sch, pub_poly, n_nodes)
 
-    def run():
+    def run(heartbeat=False):
         out = []
         for lo in range(0, nr, ck):
             grid = raw_grid[lo:lo + ck]       # ragged final chunk: size
             okm = bpv.verify_partials(msgs[lo:lo + ck], rows[lo:lo + ck])
             assert okm.all()
+            if heartbeat:                     # partials program compiled
+                _progress("partials_verify compiled")
             out.extend(batch.recover_batch(
                 sch, [list(range(t))] * len(grid), grid))
+            if heartbeat:
+                _progress("recover compiled")
+                heartbeat = False
         return out
 
-    sigs = run()                               # warm/compile
+    sigs = run(heartbeat=True)                 # warm/compile
     t0 = time.perf_counter()
     sigs = run()
     dt = time.perf_counter() - t0
     # recovered signatures must verify against the collective key
+    _progress("timed; re-verifying recovered sigs vs collective key")
     ver = _verifier(sch, sch.key_group.to_bytes(pub_poly.public_key()))
     for lo in range(0, nr, ck):
         part = sigs[lo:lo + ck]
@@ -474,6 +488,11 @@ def main():
                 try:
                     res = json.loads(line)
                 except ValueError:
+                    continue
+                if "progress" in res:         # intra-config heartbeat
+                    last_progress = time.monotonic()
+                    print(f"#   .. {res['progress']}", file=sys.stderr,
+                          flush=True)
                     continue
                 idx = res.get("config")
                 name = _RUNNERS.get(idx)

@@ -42,18 +42,23 @@ def _tile_rounds(tree_pt, k):
 
 def _masked_sums(curve, pts, onehot):
     """Per-signer sums: T_i = sum over slots with onehot[i]==1 (complete
-    adds; masked-out slots become infinity)."""
+    adds; masked-out slots become infinity).  Returns a stacked point
+    tree with leading axis n_nodes.
+
+    One `lax.scan` over the signer axis: the compiled graph contains a
+    SINGLE masked sum tree instead of n_nodes unrolled copies.  The
+    unrolled form made this the slowest-compiling program in the whole
+    framework (>40 min cold XLA:CPU at 13 signers — it blew the bench's
+    per-config watchdog on an idle core); the scan form is numerically
+    identical and costs one extra sequential step per signer at runtime."""
     inf = curve.infinity((onehot.shape[1],))
-    out = []
-    for i in range(onehot.shape[0]):
-        cond = onehot[i] == 1
-        sel = curve._select(cond, pts, inf)
-        out.append(curve.sum_points(sel))
-    return out
 
+    def body(carry, row):
+        sel = curve._select(row == 1, pts, inf)
+        return carry, curve.sum_points(sel)
 
-def _stack_points(pts):
-    return jax.tree.map(lambda *xs: jnp.stack(xs), *pts)
+    _, ts = jax.lax.scan(body, 0, onehot)
+    return ts
 
 
 def _rlc_partials_run_g2sig(sig_jac, u0, u1, bits, onehot, pk_sel, neg_g1_aff):
@@ -69,7 +74,9 @@ def _rlc_partials_run_g2sig(sig_jac, u0, u1, bits, onehot, pk_sel, neg_g1_aff):
     s_sum = DC.G2_DEV.sum_points(jax.tree.map(lambda t: t[:rk], mult))
     ch = jax.tree.map(lambda t: t[rk:], mult)
     ts = _masked_sums(DC.G2_DEV, ch, onehot)
-    qx_all, qy_all, _ = DC.G2_DEV.to_affine(_stack_points([s_sum] + ts))
+    allq = jax.tree.map(lambda s, t: jnp.concatenate([s[None], t], 0),
+                        s_sum, ts)
+    qx_all, qy_all, _ = DC.G2_DEV.to_affine(allq)
     px = jnp.concatenate([neg_g1_aff[0][None], pk_sel[0]], axis=0)
     py = jnp.concatenate([neg_g1_aff[1][None], pk_sel[1]], axis=0)
     ok = DP.paired_product_is_one(px, py, (qx_all, qy_all),
@@ -89,7 +96,9 @@ def _rlc_partials_run_g1sig(sig_jac, u0, u1, bits, onehot, pk_sel, neg_g2_aff):
     s_sum = DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[:rk], mult))
     ch = jax.tree.map(lambda t: t[rk:], mult)
     ts = _masked_sums(DC.G1_DEV, ch, onehot)
-    px_all, py_all, _ = DC.G1_DEV.to_affine(_stack_points([s_sum] + ts))
+    allp = jax.tree.map(lambda s, t: jnp.concatenate([s[None], t], 0),
+                        s_sum, ts)
+    px_all, py_all, _ = DC.G1_DEV.to_affine(allp)
     qx = jax.tree.map(lambda a, b: jnp.concatenate([a[None], b], axis=0),
                       neg_g2_aff[0], pk_sel[0])
     qy = jax.tree.map(lambda a, b: jnp.concatenate([a[None], b], axis=0),
