@@ -61,6 +61,12 @@ def _masked_sums(curve, pts, onehot):
     return ts
 
 
+def _prepend_point(single, stacked):
+    """Prepend one unbatched point to a (k, ...)-stacked point tree."""
+    return jax.tree.map(lambda s, t: jnp.concatenate([s[None], t], 0),
+                        single, stacked)
+
+
 def _rlc_partials_run_g2sig(sig_jac, u0, u1, bits, onehot, pk_sel, neg_g1_aff):
     """sigs on G2, pks on G1.  sig_jac: (rk,) G2 jac; u0/u1: (r,) fp2;
     bits: (SB, 2rk); onehot: (p, rk); pk_sel: ((p,24),(p,24)) G1 affine."""
@@ -74,9 +80,7 @@ def _rlc_partials_run_g2sig(sig_jac, u0, u1, bits, onehot, pk_sel, neg_g1_aff):
     s_sum = DC.G2_DEV.sum_points(jax.tree.map(lambda t: t[:rk], mult))
     ch = jax.tree.map(lambda t: t[rk:], mult)
     ts = _masked_sums(DC.G2_DEV, ch, onehot)
-    allq = jax.tree.map(lambda s, t: jnp.concatenate([s[None], t], 0),
-                        s_sum, ts)
-    qx_all, qy_all, _ = DC.G2_DEV.to_affine(allq)
+    qx_all, qy_all, _ = DC.G2_DEV.to_affine(_prepend_point(s_sum, ts))
     px = jnp.concatenate([neg_g1_aff[0][None], pk_sel[0]], axis=0)
     py = jnp.concatenate([neg_g1_aff[1][None], pk_sel[1]], axis=0)
     ok = DP.paired_product_is_one(px, py, (qx_all, qy_all),
@@ -96,9 +100,7 @@ def _rlc_partials_run_g1sig(sig_jac, u0, u1, bits, onehot, pk_sel, neg_g2_aff):
     s_sum = DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[:rk], mult))
     ch = jax.tree.map(lambda t: t[rk:], mult)
     ts = _masked_sums(DC.G1_DEV, ch, onehot)
-    allp = jax.tree.map(lambda s, t: jnp.concatenate([s[None], t], 0),
-                        s_sum, ts)
-    px_all, py_all, _ = DC.G1_DEV.to_affine(allp)
+    px_all, py_all, _ = DC.G1_DEV.to_affine(_prepend_point(s_sum, ts))
     qx = jax.tree.map(lambda a, b: jnp.concatenate([a[None], b], axis=0),
                       neg_g2_aff[0], pk_sel[0])
     qy = jax.tree.map(lambda a, b: jnp.concatenate([a[None], b], axis=0),
