@@ -129,6 +129,10 @@ def _unchained_store(scheme_id, n, seed, tag):
         sigs = batch.sign_batch(sch, sec, msgs)
         for r, s in zip(part, sigs):
             store.put(Beacon(round=r, signature=s))
+        if (lo // CHUNK) % 32 == 0:
+            # heartbeat: a multi-million-round fixture (the 3M replay)
+            # signs for longer than the parent's no-progress watchdog
+            _progress(f"fixture {tag}: {lo + len(part)}/{len(rounds)}")
     return sch, sch.public_bytes(pub), store
 
 
@@ -208,7 +212,6 @@ def bench_unchained_resident():
     sch, pub, store = _unchained_store(
         schemes.SHORT_SIG_SCHEME_ID, N_RESIDENT, b"drand-tpu-bench", "g1")
     ver = _verifier(sch, pub)
-    from drand_tpu.crypto.batch import _rlc_scalars
 
     encs = []
     for lo in range(0, N_RESIDENT, PAD):
@@ -220,8 +223,7 @@ def bench_unchained_resident():
         # pre-shard in SETUP so multi-device timed passes do no layout
         # moves (single chip: no-op); later device_puts to the same
         # sharding are then cheap no-transfers
-        enc, _ = ver._shard_round_axis(
-            enc, _rlc_scalars(len(rounds), PAD, split=2))
+        enc = ver._shard_round_axis(enc)
         jax.block_until_ready(enc)
         encs.append((enc, len(rounds)))
     ok = ver._rlc_ok(*encs[0])                    # warm/compile

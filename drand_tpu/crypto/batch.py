@@ -121,6 +121,36 @@ def _pad_len(n: int) -> int:
     return m
 
 
+def _rlc_keys() -> "np.ndarray":
+    """(2, 2) uint32: two independent 64-bit threefry keys (128 bits of key
+    material total) for the on-device randomizer stream."""
+    return np.frombuffer(secrets.token_bytes(16), np.uint32).reshape(2, 2)
+
+
+def _device_rlc_bits(keys, mask, split: int):
+    """Uniform RLC randomizer bits generated ON DEVICE, inside the verify
+    pipeline (r5: shipping the host-sampled (SECURITY_BITS, pad) uint32 bit
+    planes cost ~4 MB of interconnect per 8192-round chunk — more bytes
+    than the signatures themselves).  A single threefry2x32 key is only 64
+    bits, so the stream is the XOR of two independently-keyed streams:
+    predicting the randomizers requires both keys (2^-128), matching the
+    host path's 128-bit PCG seeding.  Lanes where `mask` is 0 get zero
+    coefficients (inert pad / invalid slots), preserving per-coefficient
+    soundness exactly as the host `_rlc_scalars` did."""
+    import jax.random as jr
+    jnp = jax.numpy
+    pad = mask.shape[0]
+    nw = SECURITY_BITS // 32
+    w = (jr.bits(jr.wrap_key_data(keys[0]), (nw, pad), jnp.uint32)
+         ^ jr.bits(jr.wrap_key_data(keys[1]), (nw, pad), jnp.uint32))
+    shifts = jnp.arange(31, -1, -1, dtype=jnp.uint32)
+    bits = (w[:, None, :] >> shifts[None, :, None]) & jnp.uint32(1)
+    bits = bits.reshape(SECURITY_BITS, pad)
+    bits = bits * mask.astype(jnp.uint32)[None, :]
+    part = SECURITY_BITS // split
+    return tuple(bits[i * part:(i + 1) * part] for i in range(split))
+
+
 def _rlc_scalars(n: int, pad: int, split: int = 1):
     # numpy PCG seeded with 128 bits of OS entropy: the randomizers only
     # need to be unpredictable to the adversary, and the Python-int path
@@ -159,7 +189,7 @@ def _gen_sub(curve, gen, pt, ok):
     return curve._select(ok, pt, genb)
 
 
-def _rlc_run_g2sig(sig_x, sign, u0, u1, bits, pk_aff, neg_g1_aff):
+def _rlc_run_g2sig(sig_x, sign, u0, u1, keys, n, pk_aff, neg_g1_aff):
     """Scheme family with sigs on G2, keys on G1 (chained/unchained).
 
     Front end: ONE Fp2 sqrt_ratio scan fuses decompression + both SSWU
@@ -176,7 +206,8 @@ def _rlc_run_g2sig(sig_x, sign, u0, u1, bits, pk_aff, neg_g1_aff):
     # lane order [S, psiS, H, psiH]: A sums the first half, B the second
     base = jax.tree.map(cat, sig_jac, DC.g2_psi(sig_jac),
                         hm, DC.g2_psi(hm))
-    b0, b1, b2, b3 = bits
+    lane_mask = jax.numpy.arange(sub_ok.shape[0]) < n
+    b0, b1, b2, b3 = _device_rlc_bits(keys, lane_mask, split=4)
     bl = jax.numpy.concatenate([b0, b1, b0, b1], axis=1)
     bh = jax.numpy.concatenate([b2, b3, b2, b3], axis=1)
     mult = DC.g2_glv_msm_terms(base, bl, bh)
@@ -191,16 +222,17 @@ def _rlc_run_g2sig(sig_x, sign, u0, u1, bits, pk_aff, neg_g1_aff):
     qx = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), ax, bx)
     qy = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), ay, by)
     ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
-    return sub_ok, ok
+    return sub_ok, _fused_verdict(sub_ok, ok, n)
 
 
-def _rlc_run_g1sig(sig_x, sign, u0, u1, bits, pk_aff, neg_g2_aff):
+def _rlc_run_g1sig(sig_x, sign, u0, u1, keys, n, pk_aff, neg_g2_aff):
     """Short-sig scheme: sigs on G1, keys on G2."""
     sig_jac, parse_ok, hm = DH.g1_decompress_and_hash(sig_x, sign, u0, u1)
     sig_jac = _gen_sub(DC.G1_DEV, _GEN_JAC_G1, sig_jac, parse_ok)
     sub_ok = DC.g1_in_subgroup(sig_jac) & parse_ok
     both = jax.tree.map(lambda a, b: jax.numpy.concatenate([a, b], 0), sig_jac, hm)
-    b0, b1 = bits
+    lane_mask = jax.numpy.arange(sub_ok.shape[0]) < n
+    b0, b1 = _device_rlc_bits(keys, lane_mask, split=2)
     bits2 = (jax.numpy.concatenate([b0, b0], axis=1),
              jax.numpy.concatenate([b1, b1], axis=1))
     mult = DC.g1_glv_msm_terms(both, *bits2)
@@ -215,7 +247,17 @@ def _rlc_run_g1sig(sig_x, sign, u0, u1, bits, pk_aff, neg_g2_aff):
     qx = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), neg_g2_aff[0], pk_aff[0])
     qy = jax.tree.map(lambda a, b: jax.numpy.stack([a, b]), neg_g2_aff[1], pk_aff[1])
     ok = DP.paired_product_is_one(px, py, (qx, qy), 2)
-    return sub_ok, ok
+    return sub_ok, _fused_verdict(sub_ok, ok, n)
+
+
+def _fused_verdict(sub_ok, ok, n):
+    """Single device-side scalar: RLC ok AND every real lane's subgroup/
+    parse check ok.  Folding the lane reduction into the pipeline leaves
+    ONE tiny scalar readback per chunk instead of an (n,)-mask transfer +
+    host reduction (each blocking readback is a full interconnect round
+    trip on axon)."""
+    lanes = jax.numpy.arange(sub_ok.shape[0])
+    return ok & jax.numpy.all(sub_ok | (lanes >= n))
 
 
 def _exact_run_g2sig(sig_x, sign, u0, u1, pk_aff, neg_g1_aff):
@@ -398,16 +440,18 @@ class BatchBeaconVerifier:
     # pairing program compiles far slower and tiny shards leave devices idle
     SHARD_MIN_PAD = 512
 
-    def _shard_round_axis(self, enc, bits):
+    def _shard_round_axis(self, enc):
         """Shard the round/batch axis over every visible device (the DP/SP
         axis of this domain, SURVEY.md §5.7).  XLA inserts the collectives
         for the cross-shard point-sum reduction; single-device runs are
-        unchanged (no-op sharding)."""
+        unchanged (no-op sharding).  The randomizer bits are generated
+        inside the pipeline (on device) and inherit their sharding from
+        propagation."""
         devs = jax.devices()
         pad = self._leaf_len(enc)
         if len(devs) < 2 or pad < self.SHARD_MIN_PAD \
                 or pad % len(devs) != 0:
-            return enc, bits
+            return enc
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
         mesh = Mesh(np.array(devs), ("round",))
         sh = NamedSharding(mesh, P("round"))
@@ -415,26 +459,28 @@ class BatchBeaconVerifier:
         def put(t):
             return jax.device_put(t, sh) if t.shape[0] == pad else t
 
-        enc = jax.tree.map(put, enc)
-        bits = jax.tree.map(
-            lambda t: jax.device_put(t, NamedSharding(mesh, P(None, "round"))),
-            bits)
-        return enc, bits
+        return jax.tree.map(put, enc)
 
     @staticmethod
     def _leaf_len(enc):
         return jax.tree.leaves(enc)[0].shape[0]
 
-    def _rlc_ok(self, enc, n) -> bool:
-        """One RLC check over an encoded range; True iff all n rounds verify."""
-        bits = _rlc_scalars(n, self._leaf_len(enc),
-                            split=4 if self.g2sig else 2)
-        enc, bits = self._shard_round_axis(enc, bits)
+    def _rlc_dispatch(self, enc, n):
+        """Dispatch one RLC check (no sync): returns the device-side fused
+        verdict scalar.  The randomizer bits are sampled on device from a
+        fresh 128-bit key; n rides as a 0-d operand so every chunk shares
+        one compiled program."""
+        import jax.numpy as jnp
+        enc = self._shard_round_axis(enc)
         sig_x, sign, u0, u1 = enc
         pipe = _rlc_pipeline_g2sig() if self.g2sig else _rlc_pipeline_g1sig()
-        sub_ok, ok = pipe(sig_x, sign, u0, u1, bits,
-                          self.pk_aff, self.fixed_aff)
-        return bool(ok) and np.asarray(sub_ok)[:n].all()
+        _, all_ok = pipe(sig_x, sign, u0, u1, jnp.asarray(_rlc_keys()),
+                         jnp.uint32(n), self.pk_aff, self.fixed_aff)
+        return all_ok
+
+    def _rlc_ok(self, enc, n) -> bool:
+        """One RLC check over an encoded range; True iff all n rounds verify."""
+        return bool(self._rlc_dispatch(enc, n))
 
     def _exact(self, enc, n) -> np.ndarray:
         """Per-round exact pairing checks over an encoded range."""
@@ -510,17 +556,41 @@ class BatchBeaconVerifier:
             if buf:
                 yield buf
 
+        def dispatch(packed):
+            rounds, enc, bad = packed
+            if bad.any():
+                return rounds, enc, bad, None     # rare: straight to fallback
+            return rounds, enc, bad, self._rlc_dispatch(enc, len(rounds))
+
+        def resolve(item):
+            rounds, enc, bad, verdict = item
+            if verdict is not None and bool(verdict):
+                return rounds, np.ones(len(rounds), dtype=bool)
+            # slow path: bisection + exact checks locate the bad rounds
+            return rounds, self._verify_range(enc, 0, len(rounds), bad,
+                                              top=True)
+
+        # Two overlapped stages: the pack thread prepares chunk i+1 while
+        # the device runs chunk i, and the fused-verdict readback of chunk
+        # i-1 happens only after chunk i's program is already enqueued —
+        # the blocking interconnect round trip per chunk hides behind the
+        # next chunk's device time (r5: the sync in the dispatch path cost
+        # ~1 RPC latency + readback per chunk of pure serial stall).
+        from collections import deque
+        inflight = deque()
         with ThreadPoolExecutor(max_workers=1) as ex:
             pending = None
             for chunk in chunks():
                 nxt = ex.submit(pack, chunk)
                 if pending is not None:
-                    rounds, enc, bad = pending.result()
-                    yield rounds, self._verify_range(enc, 0, len(rounds), bad, top=True)
+                    inflight.append(dispatch(pending.result()))
+                    if len(inflight) > 1:
+                        yield resolve(inflight.popleft())
                 pending = nxt
             if pending is not None:
-                rounds, enc, bad = pending.result()
-                yield rounds, self._verify_range(enc, 0, len(rounds), bad, top=True)
+                inflight.append(dispatch(pending.result()))
+            while inflight:
+                yield resolve(inflight.popleft())
 
     def verify_chain(self, beacons):
         """Verify a chained sequence of (round, sig, prev_sig) host-side

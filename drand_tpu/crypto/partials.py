@@ -18,7 +18,6 @@ Slot layout: callers pass ragged per-round partial lists (wire format:
 be16(index) || sig); rows are padded to the widest row and masked.
 """
 
-import secrets
 from functools import lru_cache
 
 import jax
@@ -26,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import tbls as HT
-from .batch import SECURITY_BITS, _NEG_G1, _NEG_G2
+from .batch import _NEG_G1, _NEG_G2, _device_rlc_bits, _rlc_keys
 from .host.params import G1_GEN, G2_GEN
 from .schemes import Scheme, GroupG2
 from ..ops import curve as DC
@@ -67,12 +66,28 @@ def _prepend_point(single, stacked):
                         single, stacked)
 
 
-def _rlc_partials_run_g2sig(sig_jac, u0, u1, bits, onehot, pk_sel, neg_g1_aff):
+def _partials_bits(keys, valid):
+    """(SB, 2rk) randomizer planes on device: one coefficient per slot
+    (zero where invalid), duplicated for the tiled-hm half (the same c_rj
+    multiplies S_rj and H_r — the RLC identity needs equal coefficients)."""
+    b, = _device_rlc_bits(keys, valid, split=1)
+    return jnp.concatenate([b, b], axis=1)
+
+
+def _partials_verdict(sub_ok, ok, valid):
+    """Fused device scalar: RLC ok AND every valid slot's subgroup check."""
+    return ok & jnp.all(sub_ok | ~valid.astype(bool))
+
+
+def _rlc_partials_run_g2sig(sig_jac, u0, u1, keys, valid, onehot, pk_sel,
+                            neg_g1_aff):
     """sigs on G2, pks on G1.  sig_jac: (rk,) G2 jac; u0/u1: (r,) fp2;
-    bits: (SB, 2rk); onehot: (p, rk); pk_sel: ((p,24),(p,24)) G1 affine."""
+    keys: (2, 2) threefry keys; valid: (rk,) slot mask; onehot: (p, rk);
+    pk_sel: ((p,24),(p,24)) G1 affine."""
     rk = onehot.shape[1]
     r = u0[0].shape[0]
     k = rk // r
+    bits = _partials_bits(keys, valid)
     sub_ok = DC.g2_in_subgroup(sig_jac)
     hm = _tile_rounds(DH.hash_to_g2_jac(u0, u1), k)
     both = jax.tree.map(lambda a, b: jnp.concatenate([a, b], 0), sig_jac, hm)
@@ -85,14 +100,16 @@ def _rlc_partials_run_g2sig(sig_jac, u0, u1, bits, onehot, pk_sel, neg_g1_aff):
     py = jnp.concatenate([neg_g1_aff[1][None], pk_sel[1]], axis=0)
     ok = DP.paired_product_is_one(px, py, (qx_all, qy_all),
                                   onehot.shape[0] + 1)
-    return sub_ok, ok
+    return sub_ok, _partials_verdict(sub_ok, ok, valid)
 
 
-def _rlc_partials_run_g1sig(sig_jac, u0, u1, bits, onehot, pk_sel, neg_g2_aff):
+def _rlc_partials_run_g1sig(sig_jac, u0, u1, keys, valid, onehot, pk_sel,
+                            neg_g2_aff):
     """sigs on G1, pks on G2 (short-sig scheme)."""
     rk = onehot.shape[1]
     r = u0.shape[0]
     k = rk // r
+    bits = _partials_bits(keys, valid)
     sub_ok = DC.g1_in_subgroup(sig_jac)
     hm = _tile_rounds(DH.hash_to_g1_jac(u0, u1), k)
     both = jax.tree.map(lambda a, b: jnp.concatenate([a, b], 0), sig_jac, hm)
@@ -107,7 +124,7 @@ def _rlc_partials_run_g1sig(sig_jac, u0, u1, bits, onehot, pk_sel, neg_g2_aff):
                       neg_g2_aff[1], pk_sel[1])
     ok = DP.paired_product_is_one(px_all, py_all, (qx, qy),
                                   onehot.shape[0] + 1)
-    return sub_ok, ok
+    return sub_ok, _partials_verdict(sub_ok, ok, valid)
 
 
 def _exact_partials_run_g2sig(sig_jac, u0, u1, k, pk_slot, neg_g1_aff):
@@ -236,16 +253,17 @@ class BatchPartialVerifier:
 
         flat_valid = valid.reshape(-1)
         flat_idx = idxs.reshape(-1)
-        cs = [secrets.randbits(SECURITY_BITS) if v else 0 for v in flat_valid]
         signers = sorted(set(flat_idx[flat_valid]))
         onehot = np.zeros((len(signers), rk), dtype=np.uint32)
         for i, s in enumerate(signers):
             onehot[i] = (flat_idx == s) & flat_valid
-        bits = DC.scalars_to_bits(cs + cs, nbits=SECURITY_BITS)
-        sub_ok, ok = _rlc_pipeline(self.g2sig)(
-            sig_jac, u0, u1, bits, jnp.asarray(onehot),
+        # per-slot randomizers are sampled on device from a fresh 128-bit
+        # key (batch._device_rlc_bits); invalid slots get zero coefficients
+        _, all_ok = _rlc_pipeline(self.g2sig)(
+            sig_jac, u0, u1, jnp.asarray(_rlc_keys()),
+            jnp.asarray(flat_valid.astype(np.uint32)), jnp.asarray(onehot),
             self._pk_sel(signers), self.fixed_aff)
-        if bool(ok) and np.asarray(sub_ok)[flat_valid].all():
+        if bool(all_ok):
             return valid
 
         # exact fallback: per-slot pairings with per-slot public shares
