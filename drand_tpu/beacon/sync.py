@@ -21,12 +21,13 @@ Components:
 """
 
 import queue
-import random
 import threading
 from typing import Callable, Iterable, Iterator, List, Optional, Sequence
 
 from ..chain.beacon import Beacon
 from ..chain.errors import ErrNoBeaconSaved, ErrNoBeaconStored
+from ..net.resilience import (DEFAULT_SYNC_BUDGET, BreakerOpen, Deadline,
+                              ResiliencePolicy, peer_key)
 from .stores import ErrBeaconAlreadyStored
 
 DEFAULT_CHUNK = 512
@@ -47,8 +48,8 @@ class SyncManager:
     def __init__(self, chain, scheme, public_key_bytes: bytes, period: int,
                  clock, fetch: Callable[[object, int], Iterable[Beacon]],
                  peers: Sequence[object] = (), chunk: int = DEFAULT_CHUNK,
-                 verifier=None):
-        from ..crypto.batch import BatchBeaconVerifier
+                 verifier=None, resilience: Optional[ResiliencePolicy] = None,
+                 sync_budget: Optional[float] = None):
         self.chain = chain                  # ChainStore facade (decorators)
         self.scheme = scheme
         self.period = period
@@ -56,8 +57,15 @@ class SyncManager:
         self.fetch = fetch
         self.peers = list(peers)
         self.chunk = chunk
-        self.verifier = verifier or BatchBeaconVerifier(scheme,
-                                                        public_key_bytes)
+        if verifier is None:                # lazy: keep jax out of host-only
+            from ..crypto.batch import BatchBeaconVerifier   # callers' path
+            verifier = BatchBeaconVerifier(scheme, public_key_bytes)
+        self.verifier = verifier
+        # shared policy: the daemon passes the one its ProtocolClient uses,
+        # so partial-send failures steer sync peer selection and vice versa
+        self.resilience = resilience or ResiliencePolicy(clock=clock,
+                                                         scope="sync")
+        self.sync_budget = sync_budget or DEFAULT_SYNC_BUDGET
         self._requests: queue.Queue = queue.Queue(maxsize=SYNC_QUEUE)
         self._stop = threading.Event()
         self._last_progress = None
@@ -120,22 +128,99 @@ class SyncManager:
             return None   # fresh store (follow-mode bootstrap)
 
     def sync(self, target_round: int, peers: Sequence[object]) -> None:
-        """Stream from shuffled peers until the chain reaches target_round."""
+        """Stream from peers until the chain reaches target_round, under ONE
+        overall budget (`sync_budget`) instead of per-call timeouts.
+
+        Peer order is breaker-aware (closed-breaker peers first, quarantined
+        ones last, shuffled within each health bucket for load spreading —
+        the Handel-style de-prioritization of unresponsive peers).
+        Quarantined peers are skipped while any healthier candidate exists,
+        but when EVERY peer is quarantined they are dialed anyway (last
+        resort — a healed partition must not idle out a full cooldown); a
+        pass that makes no progress backs off with jitter, and
+        `ErrFailedAll` is raised only once the budget is spent."""
         peers = list(peers)
-        random.shuffle(peers)
-        for peer in peers:
+        if not peers:
+            raise ErrFailedAll("no peers to sync from")
+        deadline = Deadline.after(self.clock, self.sync_budget)
+        strikes = 0
+        while True:
+            progressed = False
+            # ONE preference snapshot per pass drives both the ranking and
+            # the quarantine skip — querying the registry twice would let a
+            # cooldown that elapses mid-pass make the two disagree
+            prefs = {peer_key(p): self.resilience.breakers.preference(
+                peer_key(p)) for p in peers}
+            all_quarantined = all(v == 2 for v in prefs.values())
+            order = list(peers)
+            self.resilience.rng.shuffle(order)
+            order.sort(key=lambda p: prefs[peer_key(p)])
+            for peer in order:
+                if self._stop.is_set():
+                    return
+                if deadline.expired:
+                    raise ErrFailedAll(
+                        f"no peer could sync us to round {target_round} "
+                        f"within the {self.sync_budget}s budget")
+                key = peer_key(peer)
+                br = self.resilience.breaker(key)
+                if prefs[key] == 2:
+                    if not all_quarantined:
+                        continue    # quarantined: cooldown not yet elapsed
+                    # last resort: every peer is quarantined — admit a
+                    # probe NOW (OPEN → HALF_OPEN before the cooldown
+                    # elapses), or the production fetch path would raise
+                    # BreakerOpen at the client and the dial-anyway promise
+                    # above would be dead code
+                    br.force_probe()
+                before = self._head_round()
+                try:
+                    reached, aborted = self._try_peer(peer, target_round,
+                                                      deadline)
+                except BreakerOpen:
+                    continue        # client-side rejection, not a failure
+                except Exception:
+                    br.record_failure()
+                    continue
+                if self._head_round() > before:
+                    progressed = True
+                    br.record_success()
+                elif not reached and not aborted:
+                    # transport was fine but the content didn't advance us
+                    # (empty, stale, or Byzantine stream); an `aborted` try
+                    # (stop() or budget expiry mid-stream) is OUR exit, not
+                    # the peer's fault — no strike
+                    br.record_failure()
+                if reached:
+                    return
             if self._stop.is_set():
                 return
-            try:
-                if self._try_peer(peer, target_round):
-                    return
-            except Exception:
-                continue
-        raise ErrFailedAll(f"no peer could sync us to round {target_round}")
+            if deadline.expired:
+                raise ErrFailedAll(
+                    f"no peer could sync us to round {target_round} "
+                    f"within the {self.sync_budget}s budget")
+            strikes = 0 if progressed else strikes + 1
+            # back off before the next pass (full jitter, never past the
+            # deadline); a fruitless pass also waits for the earliest
+            # breaker probe so a fully-quarantined peer set isn't hot-looped
+            delay = max(self.resilience.backoff.delay(strikes,
+                                                      self.resilience.rng),
+                        0.05)
+            wake = min(self.clock.now() + delay, deadline.expires)
+            if not progressed:
+                probe_at = self.resilience.breakers.next_probe_at(
+                    [peer_key(p) for p in peers])
+                wake = min(max(wake, probe_at), deadline.expires)
+            self.clock.wait_until(wake, self._stop)
 
-    def _try_peer(self, peer, target_round: int) -> bool:
+    def _try_peer(self, peer, target_round: int,
+                  deadline: Optional[Deadline] = None) -> tuple:
+        """One streaming attempt against `peer`.  Returns (reached,
+        aborted): `aborted` means WE bailed (stop() or budget expiry), so
+        the caller must not blame the peer for the lack of progress."""
         head = self._head_beacon()
         buf: List[Beacon] = []
+        aborted = False
         # Idle watchdog: a peer that stops producing for > 2·period is
         # abandoned so sync() can fail over (sync_manager.go:52-53,154-162);
         # without it a black-holed TCP stream stalls the manager forever.
@@ -145,7 +230,10 @@ class SyncManager:
         try:
             for b in stream:
                 if self._stop.is_set():
-                    return False
+                    return False, True
+                if deadline is not None and deadline.expired:
+                    aborted = True
+                    break       # budget spent mid-stream: flush what we have
                 buf.append(b)
                 # flush on a full chunk OR once the target is covered: the
                 # serving side live-follows forever (sync_manager.go:468),
@@ -155,12 +243,13 @@ class SyncManager:
                     head = self._verify_and_store(head, buf)
                     buf = []
                     if head is None:
-                        return False
+                        return False, False
                     if head.round >= target_round:
-                        return True
+                        return True, False
             if buf:
                 head = self._verify_and_store(head, buf)
-            return head is not None and head.round >= target_round
+            reached = head is not None and head.round >= target_round
+            return reached, aborted
         finally:
             # every exit path must tear the stream down, or the pump thread
             # keeps draining the peer's live-follow stream forever
@@ -267,39 +356,73 @@ class SyncManager:
         the RAW store (the append decorator would reject non-head writes).
 
         Returns the rounds that could not be repaired."""
-        peers = list(peers or self.peers)
-        random.shuffle(peers)
+        peers = self.resilience.rank(list(peers or self.peers))
         remaining = sorted(set(faulty))
         for peer in peers:
             if not remaining:
                 break
-            fetched = [(r, self._fetch_one(peer, r)) for r in remaining]
+            br = self.resilience.breaker(peer_key(peer))
+            dialed = False
+            fetched = []
+            for r in remaining:
+                try:
+                    b = self._fetch_one(peer, r)
+                    dialed = True
+                except BreakerOpen:
+                    # client-side rejection: nothing was dialed, and every
+                    # further round would be rejected too — next peer
+                    break
+                except Exception:
+                    dialed = True
+                    b = None
+                fetched.append((r, b))
             got = [(r, b) for r, b in fetched if b is not None]
+            repaired = set()
             if got:
                 # one device pass for everything this peer produced
                 ok = self.verifier.verify_batch(
                     [b.round for _, b in got],
                     [b.signature for _, b in got],
                     [b.previous_sig for _, b in got])
-                repaired = set()
                 for (r, b), good in zip(got, ok):
                     if good:
                         raw_store.delete(r)
                         raw_store.put(b)
                         repaired.add(r)
                 remaining = [r for r in remaining if r not in repaired]
+            # repair-path breaker accounting: a peer that produced nothing
+            # usable (unreachable, or only forged rounds) trips towards
+            # open — but only an ACTUAL dial outcome counts; a BreakerOpen
+            # fast-fail is not new evidence against the peer
+            if repaired:
+                br.record_success()
+            elif dialed:
+                br.record_failure()
         return remaining
 
     def _fetch_one(self, peer, round_: int) -> Optional[Beacon]:
+        """Single-round fetch.  Lets `BreakerOpen` propagate (client-side
+        rejection — no dial happened) and tears the stream down on every
+        exit: the production fetch is a SyncChain stream that live-follows
+        forever after the replay, so returning mid-iteration without
+        cancel() would leak one server-side stream per repaired round."""
+        stream = self.fetch(peer, round_)
         try:
-            for b in self.fetch(peer, round_):
+            for b in stream:
                 if b.round == round_:
                     return b
                 if b.round > round_:
                     return None
-        except Exception:
             return None
-        return None
+        finally:
+            for name in ("cancel", "close"):
+                fn = getattr(stream, name, None)
+                if callable(fn):
+                    try:
+                        fn()
+                    except Exception:
+                        pass
+                    break
 
 
 class SyncChainServer:

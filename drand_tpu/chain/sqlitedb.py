@@ -86,9 +86,29 @@ class SqliteStore(Store):
 
     def save_to(self, fileobj) -> None:
         """Native snapshot: the serialized sqlite image (BackupDatabase RPC,
-        chain/store.go:24 SaveTo analogue)."""
+        chain/store.go:24 SaveTo analogue).  Connection.serialize() needs
+        Python 3.11; older runtimes snapshot through the online backup API
+        into a temp file — same bytes, one extra disk round trip."""
         with self._lock:
-            fileobj.write(self._conn.serialize())
+            if hasattr(self._conn, "serialize"):
+                fileobj.write(self._conn.serialize())
+                return
+            import os
+            import sqlite3
+            import tempfile
+            fd, tmp = tempfile.mkstemp(suffix=".db")
+            os.close(fd)
+            try:
+                dst = sqlite3.connect(tmp)
+                try:
+                    self._conn.backup(dst)
+                    dst.commit()
+                finally:
+                    dst.close()
+                with open(tmp, "rb") as f:
+                    fileobj.write(f.read())
+            finally:
+                os.unlink(tmp)
 
 
 class _SqliteCursor(Cursor):

@@ -8,18 +8,28 @@ the round schedule.
 """
 
 import queue
+import random
 import threading
 import time
 from typing import Iterator, List, Optional
 
 from ..chain.info import Info
 from ..chain.timing import time_of_round
+from ..net.resilience import BackoffPolicy
 from .interface import Client, Result
 
 
 class WatchAggregator(Client):
-    def __init__(self, inner: Client, auto_watch: bool = False):
+    def __init__(self, inner: Client, auto_watch: bool = False,
+                 backoff: Optional[BackoffPolicy] = None,
+                 rng: Optional[random.Random] = None):
         self.inner = inner
+        # reconnect schedule for a dying upstream: exponential backoff with
+        # full jitter (was a fixed 1s — a flapping upstream got hammered at
+        # 1 Hz by every aggregator in the fleet simultaneously)
+        self.backoff = backoff or BackoffPolicy(base=0.5, cap=15.0)
+        self.rng = rng or random.Random()
+        self._consecutive_failures = 0
         self._subs: List[queue.Queue] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -38,6 +48,7 @@ class WatchAggregator(Client):
         while not self._stop.is_set():
             try:
                 for result in self.inner.watch(self._stop):
+                    self._consecutive_failures = 0   # stream is live again
                     with self._lock:
                         subs = list(self._subs)
                     for q in subs:
@@ -49,7 +60,12 @@ class WatchAggregator(Client):
                         return
             except Exception:
                 pass
-            self._stop.wait(1.0)   # upstream died: retry (aggregator.go)
+            # upstream died: retry with jittered backoff (aggregator.go
+            # restarts the watch; the schedule grows while it keeps dying)
+            delay = max(self.backoff.delay(self._consecutive_failures,
+                                           self.rng), 0.2)
+            self._consecutive_failures += 1
+            self._stop.wait(delay)
 
     def get(self, round_: int = 0) -> Result:
         return self.inner.get(round_)

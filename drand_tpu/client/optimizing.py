@@ -3,6 +3,11 @@
 Tracks per-source latency, re-probing every `speed_test_interval`; `get`
 races the top-2 fastest sources with a stagger and returns the first
 success; `watch` follows the fastest source and fails over on error.
+
+Ranking is breaker-aware (net/resilience.py): a source that keeps failing
+trips its circuit breaker and sinks to the back of the ranking until its
+cooldown elapses, regardless of how fast it was when it last answered —
+latency measures the happy path, the breaker remembers the sad one.
 """
 
 import threading
@@ -12,6 +17,7 @@ from typing import Iterator, List, Optional
 
 from ..chain.info import Info
 from ..log import Logger
+from ..net.resilience import ResiliencePolicy
 from .interface import Client, Result
 
 SPEED_TEST_INTERVAL = 300.0     # optimizing.go: 5 min
@@ -20,8 +26,9 @@ DEFAULT_TIMEOUT = 5.0
 
 
 class _Source:
-    def __init__(self, client: Client):
+    def __init__(self, client: Client, key: str):
         self.client = client
+        self.key = key              # breaker key for this transport
         self.latency = float("inf")
 
     def probe(self) -> None:
@@ -36,11 +43,14 @@ class _Source:
 class OptimizingClient(Client):
     def __init__(self, sources: List[Client],
                  speed_test_interval: float = SPEED_TEST_INTERVAL,
-                 log: Optional[Logger] = None):
+                 log: Optional[Logger] = None,
+                 resilience: Optional[ResiliencePolicy] = None):
         if not sources:
             raise ValueError("optimizing client needs at least one source")
-        self.sources = [_Source(c) for c in sources]
+        self.sources = [_Source(c, f"source-{i}")
+                        for i, c in enumerate(sources)]
         self.log = (log or Logger()).named("optimizing")
+        self.resilience = resilience or ResiliencePolicy(scope="client")
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self._interval = speed_test_interval
@@ -62,8 +72,16 @@ class OptimizingClient(Client):
             self._stop.wait(self._interval)
 
     def _ranked(self) -> List[_Source]:
+        """Closed-breaker sources first (then by latency); quarantined ones
+        last, but never dropped — they are the fallback of last resort."""
+        pref = self.resilience.breakers.preference
         with self._lock:
-            return sorted(self.sources, key=lambda s: s.latency)
+            return sorted(self.sources,
+                          key=lambda s: (pref(s.key), s.latency))
+
+    def _record(self, src: _Source, ok: bool) -> None:
+        br = self.resilience.breaker(src.key)
+        br.record_success() if ok else br.record_failure()
 
     # -- Client --------------------------------------------------------------
 
@@ -80,18 +98,26 @@ class OptimizingClient(Client):
                     done, _ = wait(futures, timeout=RACE_STAGGER,
                                    return_when=FIRST_COMPLETED)
                     for f in done:
+                        # pop: a failure resolved here must not be counted
+                        # against the breaker again by the final loop below
+                        f_src = futures.pop(f)
                         try:
-                            return f.result()
+                            result = f.result()
+                            self._record(f_src, ok=True)
+                            return result
                         except Exception as e:
+                            self._record(f_src, ok=False)
                             errors.append(e)
                 futures[pool.submit(src.client.get, round_)] = src
             for f, src in futures.items():
                 try:
                     result = f.result(timeout=DEFAULT_TIMEOUT)
                     src.latency = min(src.latency, DEFAULT_TIMEOUT)
+                    self._record(src, ok=True)
                     return result
                 except Exception as e:
                     src.latency = float("inf")
+                    self._record(src, ok=False)
                     errors.append(e)
         raise errors[-1] if errors else RuntimeError("no source succeeded")
 
@@ -104,15 +130,20 @@ class OptimizingClient(Client):
         while not stop.is_set():
             progressed = False
             for src in self._ranked():
+                src_progressed = False
                 try:
                     for result in src.client.watch(stop):
                         if result.round > last_round:
                             last_round = result.round
+                            if not src_progressed:
+                                src_progressed = True
+                                self._record(src, ok=True)
                             progressed = True
                             yield result
                         if stop.is_set():
                             return
                 except Exception as e:
+                    self._record(src, ok=False)
                     self.log.warn("watch source failed; failing over",
                                   err=str(e))
                     continue

@@ -26,8 +26,10 @@ from ..key.store import FileStore
 from ..log import Logger
 from ..metrics import (ThresholdMonitor, beacon_discrepancy_latency,
                        group_size, group_threshold, last_beacon_round)
+from ..chain.timing import time_of_round
 from ..net import Peer, ProtocolClient
 from ..net import convert
+from ..net.resilience import BreakerOpen, Deadline, DeadlineExceeded
 from ..protos import drand_pb2 as pb
 from .broadcast import EchoBroadcast
 from .config import CALL_MAX_TIMEOUT, Config
@@ -48,6 +50,11 @@ class BeaconProcess:
         self.client = client
         self.log = log.named(self.beacon_id)
         self.clock = cfg.clock
+        # one policy for everything this process does on the wire: the
+        # client's (daemon-wide) when it has one, so partial-send failures
+        # and sync failovers share per-peer breaker state
+        self.resilience = getattr(client, "resilience", None) \
+            or cfg.make_resilience(scope=self.beacon_id)
         self.group: Optional[Group] = None
         self.share: Optional[Share] = None
         self.handler: Optional[Handler] = None
@@ -110,23 +117,58 @@ class BeaconProcess:
 
     def _broadcast_partial(self, packet: PartialBeaconPacket) -> None:
         """Fan the partial out to every peer, one thread each
-        (node.go:445-472); failures feed the threshold monitor."""
+        (node.go:445-472); failures feed the threshold monitor.
+
+        All sends share ONE deadline — the end of the round being built
+        (a partial delivered after that is useless), so retries inside the
+        client's resilience policy are budget-clamped instead of stacking
+        per-call 60s timeouts.  When enough sends have terminally failed
+        that the threshold cannot be met this round, gathering degrades to
+        catchup-sync: peers that did aggregate will feed us the beacon."""
         proto = pb.PartialBeaconPacket(
             round=packet.round,
             previous_signature=packet.previous_signature or b"",
             partial_sig=packet.partial_sig,
             metadata=convert.metadata(self.beacon_id))
+        peers = self._peers()
+        round_end = time_of_round(self.group.period, self.group.genesis_time,
+                                  packet.round + 1)
+        # catchup rebroadcasts sign rounds whose end time is already past
+        # (node.go:368-403): those sends get one catchup-period of budget,
+        # not a degenerate already-expired deadline
+        grace = float(max(self.group.catchup_period or self.group.period, 5))
+        deadline = Deadline.at(self.clock,
+                               max(round_end, self.clock.now() + grace))
+        # we need threshold-1 partials from others on top of our own; once
+        # more than len(peers) - (threshold-1) sends failed, this round's
+        # gathering mathematically cannot reach the threshold
+        degrade_at = len(peers) - (self.group.threshold - 1) + 1
+        state = {"failed": 0}
+        lock = threading.Lock()
 
         def send(peer: Peer):
             try:
-                self.client.partial_beacon(peer, proto)
+                self.client.partial_beacon(peer, proto, deadline=deadline)
             except Exception as e:
-                if self.monitor is not None:
+                # a BreakerOpen fast-fail still counts toward the degrade
+                # decision (the peer is unreachable on recent evidence) but
+                # is not a NEW dial failure for the threshold monitor
+                if self.monitor is not None \
+                        and not isinstance(e, BreakerOpen):
                     self.monitor.report_failure(peer.address)
                 self.log.debug("partial send failed", dest=peer.address,
                                err=str(e))
+                with lock:
+                    state["failed"] += 1
+                    crossed = state["failed"] == degrade_at
+                if crossed and degrade_at > 0:
+                    self.log.warn("partial gathering cannot reach threshold; "
+                                  "degrading to catchup sync",
+                                  round=packet.round,
+                                  failed=state["failed"])
+                    self._on_sync_needed(packet.round)
 
-        for peer in self._peers():
+        for peer in peers:
             threading.Thread(target=send, args=(peer,), daemon=True).start()
 
     def start_beacon(self, catchup: bool) -> None:
@@ -170,7 +212,9 @@ class BeaconProcess:
                     peer, fr, self.beacon_id),
                 peers=self._peers(),
                 chunk=self.cfg.sync_chunk,
-                verifier=sync_verifier)
+                verifier=sync_verifier,
+                resilience=self.resilience,
+                sync_budget=self.cfg.sync_budget or None)
             self.syncm.start()
             self.handler.chain.cbstore.add_callback(
                 "metrics", self._metrics_callback)
@@ -184,7 +228,6 @@ class BeaconProcess:
                       genesis=self.group.genesis_time)
 
     def _metrics_callback(self, b: Beacon) -> None:
-        from ..chain.timing import time_of_round
         last_beacon_round.labels(self.beacon_id).set(b.round)
         expected = time_of_round(self.group.period, self.group.genesis_time,
                                  b.round)
@@ -301,30 +344,37 @@ class BeaconProcess:
                            backoff: float = 0.5) -> None:
         """The leader may not have run InitDKG yet when we signal; keep
         retrying within the setup budget (the reference CLI loops the same
-        way while the coordinator comes up)."""
-        import time as _time
-        deadline = _time.monotonic() + budget
+        way while the coordinator comes up).  Waits go through the shared
+        policy's injected clock, and the client layer's own retry chain is
+        clamped by the same Deadline — no breaker here, an absent
+        coordinator is the EXPECTED starting state."""
+        deadline = Deadline.after(self.clock, budget)
         while True:
             try:
                 self.client.signal_dkg_participant(leader, packet,
-                                                   timeout=CALL_MAX_TIMEOUT)
+                                                   timeout=CALL_MAX_TIMEOUT,
+                                                   deadline=deadline)
                 return
+            except DeadlineExceeded:
+                raise
             except Exception:
-                if _time.monotonic() + backoff >= deadline:
+                if deadline.remaining() <= backoff:
                     raise
-                _time.sleep(backoff)
+                self.resilience.sleep(backoff)
 
     def _fetch_leader_identity(self, leader: Peer, budget: float = 30.0):
-        import time as _time
-        deadline = _time.monotonic() + budget
+        deadline = Deadline.after(self.clock, budget)
         while True:
             try:
-                resp = self.client.get_identity(leader, self.beacon_id)
+                resp = self.client.get_identity(leader, self.beacon_id,
+                                                deadline=deadline)
                 break
+            except DeadlineExceeded:
+                raise
             except Exception:
-                if _time.monotonic() + 0.5 >= deadline:
+                if deadline.remaining() <= 0.5:
                     raise
-                _time.sleep(0.5)
+                self.resilience.sleep(0.5)
         from ..crypto.schemes import get_scheme_by_id_with_default
         scheme = get_scheme_by_id_with_default(resp.schemeName)
         ident = convert.proto_to_identity(resp, scheme)

@@ -48,6 +48,33 @@ class Config:
     dkg_callback: Optional[Callable] = None
     use_device_verifier: bool = True     # TPU-batched aggregation verify
     sync_chunk: int = 512
+    # resilience layer (net/resilience.py; every default is additionally
+    # env-overridable there: DRAND_RETRY_*, DRAND_BREAKER_*, DRAND_SYNC_BUDGET)
+    retry_max_attempts: int = 0          # 0 = module default
+    retry_backoff_base: float = 0.0      # 0 = module default
+    breaker_failures: int = 0            # consecutive failures before OPEN
+    breaker_cooldown: float = 0.0        # seconds before a half-open probe
+    sync_budget: float = 0.0             # overall budget of one sync pass
+
+    def make_resilience(self, scope: str = "node"):
+        """One shared policy per daemon: partial fan-out, sync peer
+        selection, and DKG retries all feed the same per-peer breakers."""
+        from ..net.resilience import (BackoffPolicy, BreakerRegistry,
+                                      ResiliencePolicy)
+        kw = {}
+        if self.retry_backoff_base:
+            kw["backoff"] = BackoffPolicy(base=self.retry_backoff_base)
+        breg = {}
+        if self.breaker_failures:
+            breg["failures"] = self.breaker_failures
+        if self.breaker_cooldown:
+            breg["cooldown"] = self.breaker_cooldown
+        return ResiliencePolicy(
+            clock=self.clock,
+            breakers=BreakerRegistry(clock=self.clock, scope=scope, **breg),
+            **({"max_attempts": self.retry_max_attempts}
+               if self.retry_max_attempts else {}),
+            scope=scope, **kw)
 
     def db_folder(self, beacon_id: str) -> str:
         from ..common import DEFAULT_BEACON_ID

@@ -37,12 +37,14 @@ class DrandDaemon:
         self._lock = threading.Lock()
         self._exit = threading.Event()
 
+        self.resilience = cfg.make_resilience(scope="node")
         self.gateway = PrivateGateway(
             cfg.private_listen,
             protocol_impl=ProtocolService(self),
             public_impl=PublicService(self),
             tls_cert=None if cfg.insecure else cfg.tls_cert,
-            tls_key=None if cfg.insecure else cfg.tls_key)
+            tls_key=None if cfg.insecure else cfg.tls_key,
+            resilience=self.resilience)
         self.control = ControlListener(ControlService(self),
                                        port=cfg.control_port)
         self.metrics: Optional[MetricsServer] = None

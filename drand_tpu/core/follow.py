@@ -82,7 +82,11 @@ def follow_chain(daemon, bp, nodes: List[str], is_tls: bool, up_to: int,
         chain=facade, scheme=scheme, public_key_bytes=info.public_key,
         period=info.period, clock=bp.clock,
         fetch=lambda peer, fr: client.sync_chain(peer, fr, bp.beacon_id),
-        peers=peers, chunk=bp.cfg.sync_chunk, verifier=verifier)
+        peers=peers, chunk=bp.cfg.sync_chunk, verifier=verifier,
+        # share the dialing client's policy: ranking and the client-side
+        # BreakerOpen rejections must consult the SAME breaker registry
+        resilience=getattr(client, "resilience", None),
+        sync_budget=bp.cfg.sync_budget or None)
 
     target = up_to or current_round(int(bp.clock.now()), info.period,
                                     info.genesis_time)

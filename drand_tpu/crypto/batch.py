@@ -123,8 +123,17 @@ def _pad_len(n: int) -> int:
 
 def _rlc_keys() -> "np.ndarray":
     """(2, 2) uint32: two independent 64-bit threefry keys (128 bits of key
-    material total) for the on-device randomizer stream."""
-    return np.frombuffer(secrets.token_bytes(16), np.uint32).reshape(2, 2)
+    material total) for the on-device randomizer stream.
+
+    The two streams are XORed on device, so EQUAL halves would cancel to an
+    all-zero randomizer (every RLC coefficient 0 — the pairing check passes
+    vacuously and per-batch soundness collapses to the 2^-64 collision
+    probability).  Resample on collision: the degenerate event becomes
+    impossible instead of astronomically unlikely."""
+    raw = secrets.token_bytes(16)
+    while raw[:8] == raw[8:]:
+        raw = secrets.token_bytes(16)
+    return np.frombuffer(raw, np.uint32).reshape(2, 2)
 
 
 def _device_rlc_bits(keys, mask, split: int):
@@ -133,10 +142,14 @@ def _device_rlc_bits(keys, mask, split: int):
     planes cost ~4 MB of interconnect per 8192-round chunk — more bytes
     than the signatures themselves).  A single threefry2x32 key is only 64
     bits, so the stream is the XOR of two independently-keyed streams:
-    predicting the randomizers requires both keys (2^-128), matching the
-    host path's 128-bit PCG seeding.  Lanes where `mask` is 0 get zero
-    coefficients (inert pad / invalid slots), preserving per-coefficient
-    soundness exactly as the host `_rlc_scalars` did."""
+    predicting the randomizers requires both keys (2^-128 with distinct
+    halves, which _rlc_keys enforces by resampling), matching the host
+    path's 128-bit PCG seeding.  Lanes where `mask` is 0 get zero
+    coefficients (inert pad / invalid slots), mirroring the host
+    `_rlc_scalars` zeroing of pad rows — "mirroring", not "identical": the
+    host sampler (still used by tools/profile_stages.py and
+    tools/chip_profile.py) draws from numpy PCG with a different bit
+    layout and has no key-collision degenerate event of its own."""
     import jax.random as jr
     jnp = jax.numpy
     pad = mask.shape[0]
@@ -211,9 +224,11 @@ def _rlc_run_g2sig(sig_x, sign, u0, u1, keys, n, pk_aff, neg_g1_aff):
     bl = jax.numpy.concatenate([b0, b1, b0, b1], axis=1)
     bh = jax.numpy.concatenate([b2, b3, b2, b3], axis=1)
     mult = DC.g2_glv_msm_terms(base, bl, bh)
-    n = 2 * b0.shape[1]
-    A = DC.G2_DEV.sum_points(jax.tree.map(lambda t: t[:n], mult))
-    B = DC.G2_DEV.sum_points(jax.tree.map(lambda t: t[n:], mult))
+    # `half` is the MSM lane-split width — do NOT shadow the traced round
+    # count `n`, which _fused_verdict needs for real pad-lane masking
+    half = 2 * b0.shape[1]
+    A = DC.G2_DEV.sum_points(jax.tree.map(lambda t: t[:half], mult))
+    B = DC.G2_DEV.sum_points(jax.tree.map(lambda t: t[half:], mult))
     ax, ay, _ = DC.G2_DEV.to_affine(A)
     bx, by, _ = DC.G2_DEV.to_affine(B)
     # stack the 2 pairs of the check into one Miller call
@@ -236,9 +251,9 @@ def _rlc_run_g1sig(sig_x, sign, u0, u1, keys, n, pk_aff, neg_g2_aff):
     bits2 = (jax.numpy.concatenate([b0, b0], axis=1),
              jax.numpy.concatenate([b1, b1], axis=1))
     mult = DC.g1_glv_msm_terms(both, *bits2)
-    n = b0.shape[1]
-    A = DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[:n], mult))
-    B = DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[n:], mult))
+    half = b0.shape[1]      # MSM lane-split width; keep the traced `n` alive
+    A = DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[:half], mult))
+    B = DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[half:], mult))
     ax, ay, _ = DC.G1_DEV.to_affine(A)
     bx, by, _ = DC.G1_DEV.to_affine(B)
     # e(A, -g2) · e(B, pk) == 1

@@ -13,7 +13,10 @@ non-default).
 
 import hashlib
 import struct
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:   # Python < 3.11: tomli is API-identical
+    import tomli as tomllib
 from dataclasses import dataclass, field
 from typing import List, Optional
 

@@ -11,7 +11,10 @@ Private key material is written with owner-only permissions via fs helpers.
 """
 
 import os
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:   # Python < 3.11: tomli is API-identical
+    import tomli as tomllib
 from typing import Optional
 
 from .. import fs

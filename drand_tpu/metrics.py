@@ -67,6 +67,25 @@ http_latency = Histogram(
 client_http_heartbeat = Counter(
     "client_http_heartbeat", "HTTP client watch liveness", ["url"],
     registry=CLIENT)
+# Resilience layer (net/resilience.py): per-peer circuit breakers and the
+# retry/deadline executor.  `resilience_breaker_state` is 0 closed / 1 open /
+# 2 half-open; transitions carry the target state as a label so a scrape
+# shows a peer getting quarantined and later probed back in.
+breaker_state = Gauge(
+    "resilience_breaker_state",
+    "Per-peer circuit breaker state (0 closed, 1 open, 2 half-open)",
+    ["scope", "address"], registry=GROUP)
+breaker_transitions = Counter(
+    "resilience_breaker_transitions_total",
+    "Circuit breaker state transitions", ["scope", "address", "state"],
+    registry=GROUP)
+retries_total = Counter(
+    "resilience_retries_total", "Retry attempts after a failed call",
+    ["scope", "op"], registry=GROUP)
+deadline_exceeded_total = Counter(
+    "resilience_deadline_exceeded_total",
+    "Operations abandoned because their overall budget was spent",
+    ["scope", "op"], registry=GROUP)
 # TPU-specific: the device batch-verification pipeline.
 batch_verify_rounds = Counter(
     "tpu_batch_verify_rounds_total", "Beacon rounds verified on device",

@@ -7,10 +7,14 @@ service specs in services.py; the generic service framework in rpc.py.
 from .client import CertManager, Peer, ProtocolClient
 from .listener import (ControlClient, ControlListener, Listener,
                        PrivateGateway)
+from .resilience import (BackoffPolicy, BreakerOpen, BreakerRegistry,
+                         CircuitBreaker, Deadline, DeadlineExceeded,
+                         ResiliencePolicy)
 from .services import CONTROL, PROTOCOL, PUBLIC
 
 __all__ = [
     "CertManager", "Peer", "ProtocolClient", "ControlClient",
     "ControlListener", "Listener", "PrivateGateway", "CONTROL", "PROTOCOL",
-    "PUBLIC",
+    "PUBLIC", "BackoffPolicy", "BreakerOpen", "BreakerRegistry",
+    "CircuitBreaker", "Deadline", "DeadlineExceeded", "ResiliencePolicy",
 ]

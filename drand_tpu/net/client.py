@@ -4,6 +4,14 @@ Reference: net/client_grpc.go:31-369 (conn pool :276, SyncChain stream pump
 :211-248, 1-minute default timeout :39 overridable via DRAND_DIAL_TIMEOUT).
 TLS here means channel credentials from the trusted-cert pool
 (net/certs.go:45); plaintext otherwise.
+
+When a `ResiliencePolicy` is attached (net/resilience.py), every unary call
+runs through its retry executor — deadline-clamped per-attempt timeouts,
+backoff with jitter, per-peer breaker accounting — and the SyncChain stream
+feeds the same breakers (a dial failure releases the probe; a half-open
+probe is closed by the first delivered beacon; content verdicts stay with
+the SyncManager) so one subsystem's failures steer every other subsystem's
+peer selection.
 """
 
 import os
@@ -16,6 +24,8 @@ import grpc
 from ..chain.beacon import Beacon
 from ..protos import drand_pb2 as pb
 from . import convert, services
+from .resilience import (HALF_OPEN, BreakerOpen, Deadline, ResiliencePolicy,
+                         peer_key)
 
 DEFAULT_TIMEOUT = float(os.environ.get("DRAND_DIAL_TIMEOUT", "60"))
 
@@ -44,16 +54,33 @@ class CertManager:
 
 class _BeaconStream:
     """Iterator over a SyncChain gRPC call that keeps `cancel()` reachable
-    (a bare generator would hide the call object in its frame)."""
+    (a bare generator would hide the call object in its frame).  The
+    optional breaker hook closes a HALF_OPEN probe on the first delivered
+    beacon — a transport-level reachability verdict.  In CLOSED state
+    nothing is recorded here: content verdicts belong to the SyncManager,
+    and resetting the failure streak on every delivered chunk would let a
+    content-Byzantine peer (working transport, forged signatures) oscillate
+    between 0 and 1 consecutive failures and never trip its breaker.
+    Failures are likewise NOT recorded here: the SyncManager records one
+    per fruitless peer-try — accounting in both layers would double-count
+    every transport error and halve the configured failure threshold."""
 
-    def __init__(self, call):
+    def __init__(self, call, breaker=None):
         self._call = call
+        self._breaker = breaker
+        self._delivered = False
 
     def __iter__(self):
         return self
 
     def __next__(self) -> Beacon:
-        return convert.proto_to_beacon(next(self._call))
+        item = next(self._call)
+        if not self._delivered:
+            self._delivered = True
+            if self._breaker is not None \
+                    and self._breaker.state == HALF_OPEN:
+                self._breaker.record_success()
+        return convert.proto_to_beacon(item)
 
     def cancel(self) -> None:
         try:
@@ -66,9 +93,11 @@ class ProtocolClient:
     """Dial-side of the Protocol + Public services, one channel per peer."""
 
     def __init__(self, certs: Optional[CertManager] = None,
-                 timeout: float = DEFAULT_TIMEOUT):
+                 timeout: float = DEFAULT_TIMEOUT,
+                 resilience: Optional[ResiliencePolicy] = None):
         self.certs = certs or CertManager()
         self.timeout = timeout
+        self.resilience = resilience
         self._conns: Dict[tuple, grpc.Channel] = {}
         self._lock = threading.Lock()
 
@@ -99,39 +128,87 @@ class ProtocolClient:
     def _public(self, peer: Peer):
         return services.PUBLIC.stub(self.channel(peer))
 
+    # -- resilient unary dispatch -------------------------------------------
+
+    def _unary(self, peer: Peer, op: str, fn, timeout: Optional[float] = None,
+               deadline: Optional[Deadline] = None, breaker: bool = True):
+        """Run `fn(per_attempt_timeout)` under the attached policy (retry +
+        breaker + deadline); without a policy, a bare single attempt with
+        the deadline still clamping the static timeout.  `breaker=False`
+        keeps retries/deadlines but skips breaker accounting — used by the
+        DKG setup plane, where the coordinator is EXPECTED to be down until
+        the operator runs InitDKG and quarantining it would deadlock the
+        join loop."""
+        t = timeout or self.timeout
+        if self.resilience is None:
+            return fn(deadline.clamp(t) if deadline is not None else t)
+        return self.resilience.call(fn,
+                                    key=peer.address if breaker else None,
+                                    op=op, timeout=t, deadline=deadline)
+
     # -- Protocol service ----------------------------------------------------
 
-    def get_identity(self, peer: Peer, beacon_id: str = "") -> pb.IdentityResponse:
+    def get_identity(self, peer: Peer, beacon_id: str = "",
+                     deadline: Optional[Deadline] = None
+                     ) -> pb.IdentityResponse:
         req = pb.IdentityRequest(metadata=convert.metadata(beacon_id))
-        return self._protocol(peer).get_identity(req, timeout=self.timeout)
+        return self._unary(
+            peer, "get_identity",
+            lambda t: self._protocol(peer).get_identity(req, timeout=t),
+            deadline=deadline, breaker=False)
 
     def signal_dkg_participant(self, peer: Peer, packet: pb.SignalDKGPacket,
-                               timeout: Optional[float] = None) -> None:
-        self._protocol(peer).signal_dkg_participant(
-            packet, timeout=timeout or self.timeout)
+                               timeout: Optional[float] = None,
+                               deadline: Optional[Deadline] = None) -> None:
+        self._unary(
+            peer, "signal_dkg_participant",
+            lambda t: self._protocol(peer).signal_dkg_participant(
+                packet, timeout=t),
+            timeout=timeout, deadline=deadline, breaker=False)
 
     def push_dkg_info(self, peer: Peer, packet: pb.DKGInfoPacket,
-                      timeout: Optional[float] = None) -> None:
-        self._protocol(peer).push_dkg_info(packet,
-                                           timeout=timeout or self.timeout)
+                      timeout: Optional[float] = None,
+                      deadline: Optional[Deadline] = None) -> None:
+        self._unary(
+            peer, "push_dkg_info",
+            lambda t: self._protocol(peer).push_dkg_info(packet, timeout=t),
+            timeout=timeout, deadline=deadline)
 
     def broadcast_dkg(self, peer: Peer, packet: pb.DKGPacket) -> None:
-        self._protocol(peer).broadcast_dkg(packet, timeout=self.timeout)
+        self._unary(
+            peer, "broadcast_dkg",
+            lambda t: self._protocol(peer).broadcast_dkg(packet, timeout=t))
 
     def partial_beacon(self, peer: Peer, packet: pb.PartialBeaconPacket,
-                       timeout: Optional[float] = None) -> None:
-        self._protocol(peer).partial_beacon(packet,
-                                            timeout=timeout or self.timeout)
+                       timeout: Optional[float] = None,
+                       deadline: Optional[Deadline] = None) -> None:
+        self._unary(
+            peer, "partial_beacon",
+            lambda t: self._protocol(peer).partial_beacon(packet, timeout=t),
+            timeout=timeout, deadline=deadline)
 
     def sync_chain(self, peer: Peer, from_round: int,
                    beacon_id: str = "") -> "_BeaconStream":
         """Server-stream of BeaconPackets starting at from_round
         (client_grpc.go:211-248).  The returned iterator forwards
         `cancel()` to the underlying gRPC call so sync watchdogs can tear
-        down a black-holed stream."""
+        down a black-holed stream.  With a policy attached, an open breaker
+        rejects the dial outright and the stream's first-item/error events
+        feed the breaker."""
+        breaker = None
+        if self.resilience is not None:
+            breaker = self.resilience.breaker(peer_key(peer))
+            if not breaker.allow():
+                raise BreakerOpen(f"sync_chain {peer.address} open")
         req = pb.SyncRequest(from_round=from_round,
                              metadata=convert.metadata(beacon_id))
-        return _BeaconStream(self._protocol(peer).sync_chain(req))
+        try:
+            call = self._protocol(peer).sync_chain(req)
+        except Exception:
+            if breaker is not None:
+                breaker.record_failure()   # dial failed: release the probe
+            raise
+        return _BeaconStream(call, breaker=breaker)
 
     def status(self, peer: Peer, beacon_id: str = "",
                check_conn: Sequence[Peer] = ()) -> pb.StatusResponse:
@@ -139,13 +216,17 @@ class ProtocolClient:
         for p in check_conn:
             req.check_conn.append(pb.StatusAddress(address=p.address,
                                                    tls=p.tls))
-        return self._protocol(peer).status(req, timeout=self.timeout)
+        return self._unary(
+            peer, "status",
+            lambda t: self._protocol(peer).status(req, timeout=t))
 
     def metrics(self, peer: Peer, beacon_id: str = "") -> bytes:
         """Fetch a peer's GroupMetrics snapshot (federation; the reference
         proxies HTTP over the gRPC conn instead, client_grpc.go:352-361)."""
         req = pb.MetricsRequest(metadata=convert.metadata(beacon_id))
-        return self._protocol(peer).metrics(req, timeout=self.timeout).metrics
+        return self._unary(
+            peer, "metrics",
+            lambda t: self._protocol(peer).metrics(req, timeout=t)).metrics
 
     # -- Public service ------------------------------------------------------
 
@@ -153,7 +234,9 @@ class ProtocolClient:
                     beacon_id: str = "") -> pb.PublicRandResponse:
         req = pb.PublicRandRequest(round=round_,
                                    metadata=convert.metadata(beacon_id))
-        return self._public(peer).public_rand(req, timeout=self.timeout)
+        return self._unary(
+            peer, "public_rand",
+            lambda t: self._public(peer).public_rand(req, timeout=t))
 
     def public_rand_stream(self, peer: Peer, round_: int = 0,
                            beacon_id: str = "") -> Iterator[pb.PublicRandResponse]:
@@ -163,8 +246,12 @@ class ProtocolClient:
 
     def chain_info(self, peer: Peer, beacon_id: str = "") -> pb.ChainInfoPacket:
         req = pb.ChainInfoRequest(metadata=convert.metadata(beacon_id))
-        return self._public(peer).chain_info(req, timeout=self.timeout)
+        return self._unary(
+            peer, "chain_info",
+            lambda t: self._public(peer).chain_info(req, timeout=t))
 
     def home(self, peer: Peer, beacon_id: str = "") -> pb.HomeResponse:
         req = pb.HomeRequest(metadata=convert.metadata(beacon_id))
-        return self._public(peer).home(req, timeout=self.timeout)
+        return self._unary(
+            peer, "home",
+            lambda t: self._public(peer).home(req, timeout=t))

@@ -54,12 +54,13 @@ class PrivateGateway:
 
     def __init__(self, address: str, protocol_impl, public_impl,
                  certs: Optional[CertManager] = None,
-                 tls_cert: Optional[str] = None, tls_key: Optional[str] = None):
+                 tls_cert: Optional[str] = None, tls_key: Optional[str] = None,
+                 resilience=None):
         self.listener = Listener(
             address,
             [(services.PROTOCOL, protocol_impl), (services.PUBLIC, public_impl)],
             tls_cert=tls_cert, tls_key=tls_key)
-        self.client = ProtocolClient(certs=certs)
+        self.client = ProtocolClient(certs=certs, resilience=resilience)
         host = address.rsplit(":", 1)[0]
         self.listen_addr = f"{host}:{self.listener.port}"
 

@@ -1,0 +1,356 @@
+"""Deterministic fault-injection harness for the sync/partials hot path.
+
+Everything is driven by (a) a seed and (b) an auto-advancing fake clock, so
+a chaos run is byte-for-byte reproducible: fault decisions are STATELESS
+functions of (seed, peer, stream#, item#) — they do not consume a shared
+RNG stream, so thread interleaving in the sync pump cannot perturb them —
+and every retry/backoff/cooldown wait jumps the clock instead of sleeping.
+
+Building blocks:
+  * `AutoClock`    — FakeClock whose waiters advance time themselves.
+  * `FaultPlan`    — per-peer probabilities for drop / delay /
+                     corrupt-signature / truncate-stream, plus a
+                     crash-restart window in fake time.
+  * `ChaosStream`  — wraps any beacon iterator with the plan's faults.
+  * `ChaosStore`   — wraps any chain Store, corrupting / dropping reads.
+  * `build_chain`  — real-crypto 1-of-1 chain (the MockChain pattern).
+  * `ChaosScenario`— N-node sync network, some peers Byzantine; honest
+                     nodes sync through breaker-aware SyncManagers and must
+                     converge to one identical verified chain.
+"""
+
+import hashlib
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from drand_tpu.beacon.clock import FakeClock
+from drand_tpu.beacon.sync import SyncManager
+from drand_tpu.chain.beacon import Beacon
+from drand_tpu.chain.errors import ErrNoBeaconSaved
+from drand_tpu.chain.memdb import MemDBStore
+from drand_tpu.core.follow import FollowFacade
+from drand_tpu.crypto.hostverify import HostBatchVerifier
+from drand_tpu.crypto.schemes import scheme_from_name
+from drand_tpu.net.resilience import (BackoffPolicy, BreakerRegistry,
+                                      ResiliencePolicy)
+
+
+def stable_seed(*parts) -> int:
+    """Process-independent 32-bit seed (builtin hash() of a str is salted
+    per process — useless for cross-run reproducibility)."""
+    blob = "/".join(str(p) for p in parts).encode()
+    return int.from_bytes(hashlib.sha256(blob).digest()[:4], "big")
+
+
+class AutoClock(FakeClock):
+    """FakeClock whose waiters advance time themselves: `wait_until` jumps
+    straight to the deadline.  Backoff schedules, breaker cooldowns, and
+    deadline budgets all elapse instantly AND deterministically — fake time
+    only moves when someone asks to wait for it."""
+
+    def wait_until(self, deadline: float, stop: threading.Event) -> bool:
+        if stop.is_set():
+            return False
+        with self._cond:
+            if deadline > self._now:
+                self._now = deadline
+                self._cond.notify_all()
+        return True
+
+    def jump(self, dt: float) -> None:
+        """advance() that tolerates concurrent callers (fault injectors
+        advance from stream pump threads)."""
+        with self._cond:
+            self._now += dt
+            self._cond.notify_all()
+
+
+@dataclass
+class FaultPlan:
+    """Per-peer fault schedule.  Probabilities are evaluated by a stateless
+    seeded hash per (stream, item), so two runs with the same seed inject
+    the same fault at the same point no matter how threads interleave."""
+
+    seed: int = 0
+    drop: float = 0.0            # P(raise ConnectionError) per item
+    delay: float = 0.0           # P(advance the fake clock) per item
+    delay_s: float = 7.0         # how far one injected delay jumps
+    corrupt: float = 0.0         # P(flip signature bytes) per item
+    truncate: float = 0.0        # P(end the stream early) per item
+    crash_at: Optional[float] = None      # fake-time window in which the
+    restart_at: Optional[float] = None    # peer refuses all connections
+
+    def dice(self, stream: int, item: int) -> random.Random:
+        return random.Random(stable_seed(self.seed, stream, item))
+
+    def down(self, now: float) -> bool:
+        return (self.crash_at is not None and now >= self.crash_at
+                and (self.restart_at is None or now < self.restart_at))
+
+
+def corrupt_signature(b: Beacon) -> Beacon:
+    """Flip bits in the signature: still parses as 96/48 bytes but fails
+    verification (a Byzantine peer serving forged beacons)."""
+    sig = bytearray(b.signature)
+    sig[len(sig) // 2] ^= 0xFF
+    return Beacon(round=b.round, signature=bytes(sig),
+                  previous_sig=b.previous_sig)
+
+
+class ChaosStream:
+    """Wrap a beacon iterator with a FaultPlan.  `events` collects
+    (peer, stream#, item#, fault) tuples for post-run inspection."""
+
+    def __init__(self, source, plan: FaultPlan, clock, peer: str,
+                 stream_no: int, events: Optional[List[tuple]] = None):
+        self.source = iter(source)
+        self.plan = plan
+        self.clock = clock
+        self.peer = peer
+        self.stream_no = stream_no
+        self.events = events if events is not None else []
+        self._i = 0
+
+    def _log(self, fault: str) -> None:
+        self.events.append((self.peer, self.stream_no, self._i, fault))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Beacon:
+        if self.plan.down(self.clock.now()):
+            self._log("crash")
+            raise ConnectionError(f"{self.peer} is down (crash window)")
+        item = next(self.source)
+        dice = self.plan.dice(self.stream_no, self._i)
+        self._i += 1
+        if dice.random() < self.plan.drop:
+            self._log("drop")
+            raise ConnectionError(f"{self.peer} dropped the connection")
+        if dice.random() < self.plan.delay:
+            self._log("delay")
+            # a slow peer burns the caller's deadline budget
+            jump = getattr(self.clock, "jump", None)
+            if jump is not None:
+                jump(self.plan.delay_s)
+        if dice.random() < self.plan.truncate:
+            self._log("truncate")
+            raise StopIteration
+        if dice.random() < self.plan.corrupt:
+            self._log("corrupt")
+            return corrupt_signature(item)
+        return item
+
+
+class ChaosStore:
+    """Store decorator injecting read faults: `drop` raises
+    ErrNoBeaconSaved (lost row), `corrupt` returns a forged beacon.  A
+    round re-written THROUGH this wrapper (the repair path's delete+put)
+    is considered healed — the bad sector got replaced — and reads
+    faithfully from then on, so `check → repair → re-check` really
+    exercises the RAW-store write path."""
+
+    def __init__(self, raw, plan: FaultPlan):
+        self.raw = raw
+        self.plan = plan
+        self._healed = set()
+
+    def get(self, round_: int) -> Beacon:
+        b = self.raw.get(round_)
+        if round_ in self._healed:
+            return b
+        dice = self.plan.dice(0, round_)
+        if dice.random() < self.plan.drop:
+            raise ErrNoBeaconSaved(f"round {round_} lost")
+        if dice.random() < self.plan.corrupt:
+            return corrupt_signature(b)
+        return b
+
+    def put(self, b: Beacon) -> None:
+        self._healed.add(b.round)
+        self.raw.put(b)
+
+    def delete(self, round_: int) -> None:
+        self._healed.add(round_)
+        self.raw.delete(round_)
+
+    def __getattr__(self, name):
+        return getattr(self.raw, name)
+
+
+# ---------------------------------------------------------------------------
+# chain + scenario
+# ---------------------------------------------------------------------------
+
+
+class TrueChain:
+    """Real-crypto 1-of-1 chain (the MockChain pattern from test_client,
+    duplicated here so tools/chaos_smoke.py can import the harness without
+    dragging the test modules in)."""
+
+    def __init__(self, scheme_id="pedersen-bls-chained", n=24,
+                 seed: bytes = b"chaos-chain"):
+        self.scheme = scheme_from_name(scheme_id)
+        sec, pub = self.scheme.keypair(seed=seed)
+        self.public = self.scheme.public_bytes(pub)
+        self.genesis_seed = b"\x07" * 32
+        self.n = n
+        self.beacons: Dict[int, Beacon] = {}
+        prev = self.genesis_seed if self.scheme.chained else None
+        for r in range(1, n + 1):
+            msg = self.scheme.digest_beacon(
+                r, prev if self.scheme.chained else None)
+            sig = self.scheme.sign(sec, msg)
+            self.beacons[r] = Beacon(
+                round=r, signature=sig,
+                previous_sig=prev if self.scheme.chained else None)
+            prev = sig
+
+
+@dataclass
+class ScenarioResult:
+    converged: bool
+    chain_digest: str                       # sha256 over all stored sigs
+    events: List[tuple] = field(default_factory=list)
+    breaker_snapshots: Dict[str, Dict[str, str]] = field(default_factory=dict)
+
+
+class ChaosScenario:
+    """N-node sync network with Byzantine members.
+
+    Node 0 is the honest seed holding the full true chain; the remaining
+    honest nodes start empty and sync from ALL other nodes (Byzantine ones
+    included) through breaker-aware SyncManagers.  Byzantine peers serve
+    the true chain mangled by their FaultPlan.  Honest nodes that have
+    already synced serve from their own stores, so later nodes genuinely
+    depend on earlier convergence."""
+
+    def __init__(self, seed: int, n_nodes: int = 5, n_byzantine: int = 2,
+                 rounds: int = 24, period: int = 30,
+                 byzantine_plan: Optional[dict] = None,
+                 breaker_failures: int = 2, breaker_cooldown: float = 5.0,
+                 sync_budget: float = 10_000.0,
+                 chain: Optional[TrueChain] = None):
+        assert n_byzantine < n_nodes - 1, "need at least 2 honest nodes"
+        self.seed = seed
+        self.clock = AutoClock(start=1_000.0)
+        # the real-crypto chain is the expensive part; determinism tests
+        # reuse one instance across scenario replays (it is read-only here)
+        self.chain = chain if chain is not None and chain.n >= rounds \
+            else TrueChain(n=rounds)
+        self.rounds = rounds
+        self.period = period
+        self.events: List[tuple] = []
+        self.addresses = [f"node{i}" for i in range(n_nodes)]
+        # Byzantine assignment is part of the seed-derived determinism:
+        # the LAST n_byzantine addresses, faults seeded per peer
+        self.byzantine = set(self.addresses[-n_byzantine:])
+        plan_kw = dict(drop=0.25, delay=0.2, corrupt=0.35, truncate=0.15)
+        plan_kw.update(byzantine_plan or {})
+        self.plans = {a: FaultPlan(seed=stable_seed(seed, a), **plan_kw)
+                      for a in self.byzantine}
+        self._stream_no: Dict[str, int] = {}
+        self.breaker_failures = breaker_failures
+        self.breaker_cooldown = breaker_cooldown
+        self.sync_budget = sync_budget
+        # honest nodes: node 0 pre-seeded, the rest empty
+        self.stores: Dict[str, MemDBStore] = {}
+        self.facades: Dict[str, FollowFacade] = {}
+        for a in self.addresses:
+            if a in self.byzantine:
+                continue
+            store = MemDBStore(buffer_size=rounds + 8)
+            facade = FollowFacade(store, self.chain.scheme.chained,
+                                  self.chain.genesis_seed)
+            if a == self.addresses[0]:
+                for r in range(1, rounds + 1):
+                    facade.put(self.chain.beacons[r])
+            self.stores[a] = store
+            self.facades[a] = facade
+
+    # -- serving side --------------------------------------------------------
+
+    def _serve(self, peer: str, from_round: int):
+        """What `peer` would stream for a SyncChain request."""
+        if peer in self.byzantine:
+            # Byzantine peers claim the whole chain, then mangle it
+            for r in range(from_round, self.rounds + 1):
+                yield self.chain.beacons[r]
+            return
+        facade = self.facades.get(peer)
+        if facade is None:
+            return
+        store = self.stores[peer]
+        for r in range(from_round, self.rounds + 1):
+            try:
+                yield store.get(r)
+            except Exception:
+                return      # an honest node serves only what it has
+
+    def fetch(self, peer, from_round: int):
+        peer = str(peer)
+        src = self._serve(peer, from_round)
+        plan = self.plans.get(peer)
+        if plan is None:
+            return src
+        no = self._stream_no.get(peer, 0)
+        self._stream_no[peer] = no + 1
+        return ChaosStream(src, plan, self.clock, peer, no, self.events)
+
+    # -- the run -------------------------------------------------------------
+
+    def _manager(self, addr: str) -> SyncManager:
+        policy = ResiliencePolicy(
+            clock=self.clock,
+            backoff=BackoffPolicy(base=0.2, cap=2.0),
+            breakers=BreakerRegistry(clock=self.clock,
+                                     failures=self.breaker_failures,
+                                     cooldown=self.breaker_cooldown,
+                                     scope=f"chaos-{addr}"),
+            scope=f"chaos-{addr}",
+            seed=stable_seed(self.seed, addr))
+        peers = [a for a in self.addresses if a != addr]
+        return SyncManager(
+            chain=self.facades[addr], scheme=self.chain.scheme,
+            public_key_bytes=self.chain.public, period=self.period,
+            clock=self.clock, fetch=self.fetch, peers=peers, chunk=8,
+            verifier=HostBatchVerifier(self.chain.scheme, self.chain.public),
+            resilience=policy, sync_budget=self.sync_budget)
+
+    def run(self) -> ScenarioResult:
+        """Sync every empty honest node to the target round; returns the
+        convergence verdict plus the per-node breaker snapshots."""
+        snapshots: Dict[str, Dict[str, str]] = {}
+        digests = []
+        converged = True
+        for addr in self.addresses:
+            if addr in self.byzantine or addr == self.addresses[0]:
+                continue
+            syncm = self._manager(addr)
+            try:
+                syncm.sync(self.rounds, syncm.peers)
+            except Exception:
+                converged = False
+            snapshots[addr] = syncm.resilience.breakers.snapshot()
+            # converged = full chain present AND it re-verifies
+            faulty = syncm.check_past_beacons(self.rounds)
+            if faulty:
+                converged = False
+        for addr in sorted(self.facades):
+            h = hashlib.sha256()
+            store = self.stores[addr]
+            for r in range(1, self.rounds + 1):
+                try:
+                    h.update(store.get(r).signature)
+                except Exception:
+                    h.update(b"missing")
+                    converged = False
+            digests.append(h.hexdigest())
+        if len(set(digests)) != 1:
+            converged = False
+        return ScenarioResult(converged=converged,
+                              chain_digest=digests[0],
+                              events=list(self.events),
+                              breaker_snapshots=snapshots)

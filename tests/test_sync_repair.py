@@ -106,3 +106,79 @@ def test_sync_from_live_follow_stream(chain):
     syncm = _manager(chain, facade, live_fetch)
     syncm.sync(N, ["peer0"])         # must return, not buffer forever
     assert facade.last().round == N
+
+
+# -- chaos-harness cases (tests/chaos.py) ------------------------------------
+
+
+def _chaos_manager(chain, facade, fetch, clock, failures=1, cooldown=10_000.0,
+                   budget=50.0):
+    from drand_tpu.net.resilience import BreakerRegistry, ResiliencePolicy
+    policy = ResiliencePolicy(
+        clock=clock, seed=13, scope="sync-chaos",
+        breakers=BreakerRegistry(clock=clock, failures=failures,
+                                 cooldown=cooldown, scope="sync-chaos"))
+    return SyncManager(
+        chain=facade, scheme=chain.scheme,
+        public_key_bytes=chain.public, period=30, clock=clock,
+        fetch=fetch, peers=["peer0"], chunk=4,
+        verifier=HostBatchVerifier(chain.scheme, chain.public),
+        resilience=policy, sync_budget=budget), policy
+
+
+def test_corrupted_stream_fails_over_and_opens_breaker(chain):
+    """A Byzantine peer corrupting a beacon mid-stream: the chunk is
+    rejected, the peer's breaker opens, and the next sync fails over to the
+    honest peer without re-trying the quarantined one."""
+    from drand_tpu.net.resilience import OPEN
+    from chaos import AutoClock, ChaosStream, FaultPlan
+
+    clock = AutoClock(1_000.0)
+    store, facade = _facade_with(chain, [])
+    plan = FaultPlan(seed=3, corrupt=1.0)      # every served beacon forged
+    streams = {"n": 0}
+
+    def fetch(peer, from_round):
+        src = (chain.beacons[r] for r in range(from_round, N + 1))
+        if peer == "byzantine":
+            streams["n"] += 1
+            return ChaosStream(src, plan, clock, "byzantine",
+                               streams["n"], [])
+        return src
+
+    syncm, policy = _chaos_manager(chain, facade, fetch, clock)
+    with pytest.raises(Exception):             # budget spent on the bad peer
+        syncm.sync(N, ["byzantine"])
+    assert policy.breaker("byzantine").state == OPEN
+    assert facade.last().round == 0            # nothing forged was stored
+    syncm.sync(N, ["byzantine", "honest"])     # fails over instantly
+    assert facade.last().round == N
+    assert store.get(N).signature == chain.beacons[N].signature
+
+
+def test_chaos_store_faults_detected_and_repaired_through_raw(chain):
+    """Seeded read faults (lost + forged rounds) under the decorator chain:
+    check_past_beacons flags them, correct_past_beacons re-fetches and
+    overwrites THROUGH the raw store, and the re-check passes because the
+    repair really replaced the bad rows."""
+    from chaos import ChaosStore, FaultPlan
+
+    raw = MemDBStore(buffer_size=100)
+    chaos = ChaosStore(raw, FaultPlan(seed=21, drop=0.2, corrupt=0.2))
+    facade = FollowFacade(chaos, chain.scheme.chained,
+                          chain.info.genesis_seed)
+    for r in range(1, N + 1):
+        raw.put(chain.beacons[r])              # raw writes: faults unnoticed
+
+    def fetch(peer, from_round):
+        for r in range(from_round, N + 1):
+            yield chain.beacons[r]
+
+    syncm = _manager(chain, facade, fetch)
+    faulty = syncm.check_past_beacons(N)
+    assert faulty                              # the seeded plan fired
+    remaining = syncm.correct_past_beacons(chaos, faulty)
+    assert remaining == []
+    assert syncm.check_past_beacons(N) == []   # healed rows re-verify
+    for r in range(1, N + 1):
+        assert raw.get(r).signature == chain.beacons[r].signature
