@@ -58,6 +58,7 @@ class ChainStore:
         (host serial by default; DevicePartialVerifier for the TPU path)."""
         self.vault = vault
         self.group = group
+        self.backend = backend      # raw store: integrity scans + repair
         self.partial_verifier = partial_verifier or HostPartialVerifier(
             vault.scheme, vault.get_pub())
         disc = DiscrepancyStore(backend, clock, group.period,
@@ -91,6 +92,20 @@ class ChainStore:
         self.cache.flush_rounds(beacon.round)
         with self._new_beacon:
             self._new_beacon.notify_all()
+
+    def integrity_scan(self, verifier=None, mode: str = "full",
+                       upto: Optional[int] = None, progress=None,
+                       beacon_id: str = "default", chunk: int = 512):
+        """Scan the RAW backend (below the decorators — corruption hides
+        underneath them) against this chain's scheme + genesis seed.
+        Returns a chain.integrity.ScanReport; pair with
+        `SyncManager.heal` to quarantine + re-fetch what it finds."""
+        from ..chain.integrity import IntegrityScanner
+        return IntegrityScanner(
+            self.backend, self.vault.scheme, verifier=verifier,
+            genesis_seed=self.group.get_genesis_seed(), chunk=chunk,
+            beacon_id=beacon_id).scan(mode=mode, upto=upto,
+                                      progress=progress)
 
     def wait_for_round(self, round_: int, timeout: float,
                        scheduled_time: bool = False) -> Optional[Beacon]:

@@ -384,11 +384,25 @@ class SyncManager:
                     [b.round for _, b in got],
                     [b.signature for _, b in got],
                     [b.previous_sig for _, b in got])
-                for (r, b), good in zip(got, ok):
-                    if good:
-                        raw_store.delete(r)
-                        raw_store.put(b)
-                        repaired.add(r)
+                goods = [(r, b) for (r, b), good in zip(got, ok) if good]
+                for r, _ in goods:
+                    raw_store.delete(r)
+                try:
+                    # one transaction for the whole batch on engines that
+                    # support it (chain/store.py put_many contract)
+                    raw_store.put_many([b for _, b in goods])
+                    repaired = {r for r, _ in goods}
+                except Exception:
+                    # the rows are already deleted — salvage row by row so
+                    # a batch-level failure (e.g. SQLITE_BUSY past the
+                    # timeout) loses at most the rows that individually
+                    # fail, not every verified replacement in hand
+                    for r, b in goods:
+                        try:
+                            raw_store.put(b)
+                            repaired.add(r)
+                        except Exception:
+                            pass
                 remaining = [r for r in remaining if r not in repaired]
             # repair-path breaker accounting: a peer that produced nothing
             # usable (unreachable, or only forged rounds) trips towards
@@ -398,6 +412,36 @@ class SyncManager:
                 br.record_success()
             elif dialed:
                 br.record_failure()
+        return remaining
+
+    def heal(self, raw_store, report_or_rounds, peers=None,
+             beacon_id: str = "default") -> List[int]:
+        """Quarantine + re-fetch rounds flagged by an integrity scan
+        (chain/integrity.py): corrupt rows are deleted first so this node
+        stops serving them, then the union of corrupt + missing rounds is
+        re-fetched from breaker-ranked peers (correct_past_beacons — the
+        existing repair machinery with its peer accounting), verified in
+        device batches, and written back through the RAW store.
+
+        Accepts a ScanReport or a plain round list.  Returns the rounds
+        that could not be repaired (every peer failed or served forgeries);
+        those stay quarantined rather than corrupt."""
+        from ..chain.integrity import IntegrityScanner, ScanReport
+        from ..metrics import integrity_repaired
+        if isinstance(report_or_rounds, ScanReport):
+            bad_rows = report_or_rounds.quarantinable_rounds
+            faulty = report_or_rounds.faulty_rounds
+        else:
+            faulty = sorted(set(report_or_rounds))
+            bad_rows = faulty
+        if not faulty:
+            return []
+        IntegrityScanner(raw_store, self.scheme,
+                         beacon_id=beacon_id).quarantine(bad_rows)
+        remaining = self.correct_past_beacons(raw_store, faulty, peers)
+        healed = len(faulty) - len(remaining)
+        if healed > 0:
+            integrity_repaired.labels(beacon_id).inc(healed)
         return remaining
 
     def _fetch_one(self, peer, round_: int) -> Optional[Beacon]:

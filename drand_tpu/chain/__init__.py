@@ -8,8 +8,10 @@ in-tree analogue of the reference's boltdb single-bucket store).
 """
 
 from .beacon import Beacon, genesis_beacon
-from .errors import ErrNoBeaconStored, ErrNoBeaconSaved
+from .errors import ErrMissingPrevious, ErrNoBeaconStored, ErrNoBeaconSaved
 from .info import Info
+from .integrity import (Finding, IntegrityScanner, ScanReport,
+                        MODE_FULL, MODE_LINKAGE)
 from .timing import (TIME_OF_ROUND_ERROR, current_round, next_round,
                      time_of_round)
 from .store import Cursor, Store, round_to_bytes, bytes_to_round
@@ -18,7 +20,8 @@ from .sqlitedb import SqliteStore
 
 __all__ = [
     "Beacon", "genesis_beacon", "Info",
-    "ErrNoBeaconStored", "ErrNoBeaconSaved",
+    "ErrNoBeaconStored", "ErrNoBeaconSaved", "ErrMissingPrevious",
+    "Finding", "IntegrityScanner", "ScanReport", "MODE_FULL", "MODE_LINKAGE",
     "TIME_OF_ROUND_ERROR", "time_of_round", "current_round", "next_round",
     "Store", "Cursor", "round_to_bytes", "bytes_to_round",
     "MemDBStore", "SqliteStore",

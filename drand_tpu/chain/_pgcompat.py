@@ -62,6 +62,12 @@ class _Cursor:
                 self._cur.execute(sql, tuple(args))
         return self
 
+    def executemany(self, sql, seq_of_args):
+        sql = _translate(sql)
+        with self._conn._lock:
+            self._cur.executemany(sql, [tuple(a) for a in seq_of_args])
+        return self
+
     def fetchone(self):
         return _pgrow(self._cur.fetchone())
 

@@ -15,6 +15,7 @@ from .store import Cursor, Store
 
 
 class MemDBStore(Store):
+    DURABILITY = "volatile"
     MIN_BUFFER = 10
 
     def __init__(self, buffer_size: int = 2000):

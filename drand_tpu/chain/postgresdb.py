@@ -9,10 +9,11 @@ reconstructed from round-1 on read for chained schemes (the migration-1.04
 behavior, pgdb.go / chain/beacon.go:90-97).
 """
 
+import threading
 from typing import Optional
 
 from .beacon import Beacon
-from .errors import ErrNoBeaconSaved, ErrNoBeaconStored
+from .errors import ErrMissingPrevious, ErrNoBeaconSaved, ErrNoBeaconStored
 from .store import Cursor, Store
 
 _SCHEMA = """
@@ -30,6 +31,8 @@ CREATE TABLE IF NOT EXISTS beacon_ids (
 
 
 class PostgresStore(Store):
+    DURABILITY = "server"
+
     def __init__(self, dsn: str, beacon_id: str = "default",
                  require_previous: bool = False, driver=None):
         """`driver` is any module exposing psycopg2's `connect` (tests
@@ -47,6 +50,11 @@ class PostgresStore(Store):
         # reads must not pin an open transaction (VACUUM blockage /
         # idle_in_transaction timeouts on long-lived daemons)
         self.conn.autocommit = True
+        # serializes writers: put_many drops the shared connection out of
+        # autocommit for its batch transaction, and an unguarded put()
+        # from another thread (beacon engine vs. repair thread) would be
+        # swallowed into — and rolled back with — that batch
+        self._write_lock = threading.RLock()
         self.require_previous = require_previous
         with self.conn, self.conn.cursor() as cur:
             cur.execute(_SCHEMA)
@@ -64,11 +72,33 @@ class PostgresStore(Store):
             return cur.fetchone()[0]
 
     def put(self, beacon: Beacon) -> None:
-        with self.conn, self.conn.cursor() as cur:
+        with self._write_lock, self.conn, self.conn.cursor() as cur:
             cur.execute(
                 "INSERT INTO beacons (beacon_id, round, signature) "
                 "VALUES (%s, %s, %s) ON CONFLICT DO NOTHING",
                 (self.bid, beacon.round, beacon.signature))
+
+    def put_many(self, beacons) -> None:
+        """Batched insert in one transaction — same all-or-nothing
+        TRANSACTIONAL contract as sqlite.  Conflict semantics differ
+        within the store contract: ON CONFLICT DO NOTHING keeps the
+        existing row (sqlite's REPLACE overwrites) — callers replacing
+        content must delete first, as chain/store.py requires.
+        The connection normally runs autocommit
+        (see __init__); it is dropped into transactional mode for the
+        batch so `with self.conn` really commits/rolls back atomically
+        on a live server, not one row at a time."""
+        with self._write_lock:
+            auto = self.conn.autocommit
+            self.conn.autocommit = False
+            try:
+                with self.conn, self.conn.cursor() as cur:
+                    cur.executemany(
+                        "INSERT INTO beacons (beacon_id, round, signature) "
+                        "VALUES (%s, %s, %s) ON CONFLICT DO NOTHING",
+                        [(self.bid, b.round, b.signature) for b in beacons])
+            finally:
+                self.conn.autocommit = auto
 
     def _fill_previous(self, round_: int, signature: bytes) -> Beacon:
         prev = None
@@ -78,7 +108,13 @@ class PostgresStore(Store):
                     "SELECT signature FROM beacons "
                     "WHERE beacon_id=%s AND round=%s", (self.bid, round_ - 1))
                 row = cur.fetchone()
-                prev = bytes(row[0]) if row else None
+            if row is None:
+                # same round-1 carve-out as sqlite: the genesis seed is
+                # not a stored row; deeper holes must raise
+                if round_ > 1:
+                    raise ErrMissingPrevious(round_)
+            else:
+                prev = bytes(row[0])
         return Beacon(round=round_, signature=signature, previous_sig=prev)
 
     def last(self) -> Beacon:
@@ -102,7 +138,7 @@ class PostgresStore(Store):
         return self._fill_previous(round_, bytes(row[0]))
 
     def delete(self, round_: int) -> None:
-        with self.conn, self.conn.cursor() as cur:
+        with self._write_lock, self.conn, self.conn.cursor() as cur:
             cur.execute("DELETE FROM beacons WHERE beacon_id=%s AND round=%s",
                         (self.bid, round_))
 

@@ -13,23 +13,42 @@ import threading
 from typing import Optional
 
 from .beacon import Beacon
-from .errors import ErrNoBeaconSaved, ErrNoBeaconStored
+from .errors import ErrMissingPrevious, ErrNoBeaconSaved, ErrNoBeaconStored
 from .store import Cursor, Store
+
+# how long a writer waits on a competing writer's lock before SQLITE_BUSY
+# surfaces as an exception (a second process — the doctor CLI — may hold
+# the db while the daemon runs)
+BUSY_TIMEOUT_MS = 5_000
 
 
 class SqliteStore(Store):
+    DURABILITY = "crash-safe"
+
     def __init__(self, path: str, require_previous: bool = False):
         """`require_previous`: reconstruct previous_sig on reads (set for
-        chained schemes; chain/beacon.go:90-97 context flag)."""
-        self._conn = sqlite3.connect(path, check_same_thread=False)
+        chained schemes; chain/beacon.go:90-97 context flag).  When the
+        prior round is absent, reads raise ErrMissingPrevious — see the
+        chain/store.py contract.
+
+        Durability discipline: WAL journal (readers never block the
+        writer, a crash mid-commit rolls back to the last complete
+        transaction) + `synchronous=NORMAL` (fsync on WAL checkpoints,
+        not on every commit — a process crash loses nothing, an OS crash
+        may lose a tail of recent commits but never tears one)."""
+        self._conn = sqlite3.connect(path, check_same_thread=False,
+                                     timeout=BUSY_TIMEOUT_MS / 1000.0)
         self._lock = threading.RLock()
         self.require_previous = require_previous
         with self._lock:
+            # pragmas first: the table create below should already ride WAL
+            self._conn.execute(f"PRAGMA busy_timeout={BUSY_TIMEOUT_MS}")
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA synchronous=NORMAL")
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS beacons ("
                 " round INTEGER PRIMARY KEY,"
                 " signature BLOB NOT NULL)")
-            self._conn.execute("PRAGMA journal_mode=WAL")
             self._conn.commit()
 
     def __len__(self) -> int:
@@ -44,13 +63,36 @@ class SqliteStore(Store):
                 (beacon.round, beacon.signature))
             self._conn.commit()
 
+    def put_many(self, beacons) -> None:
+        """Batched insert in ONE transaction: either the whole batch
+        commits or none of it does (sync stores a verified chunk at a
+        time — a crash must not leave half a chunk)."""
+        with self._lock:
+            try:
+                self._conn.executemany(
+                    "INSERT OR REPLACE INTO beacons (round, signature)"
+                    " VALUES (?, ?)",
+                    [(b.round, b.signature) for b in beacons])
+            except BaseException:
+                self._conn.rollback()
+                raise
+            self._conn.commit()
+
     def _fill_previous(self, round_: int, signature: bytes) -> Beacon:
         prev = None
         if self.require_previous and round_ > 0:
             row = self._conn.execute(
                 "SELECT signature FROM beacons WHERE round = ?",
                 (round_ - 1,)).fetchone()
-            if row is not None:
+            if row is None:
+                # Round 1 anchors on the genesis SEED, which lives outside
+                # the store — an absent round-0 row is normal, and the
+                # caller supplies the seed.  Any other absent prior row is
+                # a hole: raise instead of fabricating a beacon that can
+                # never re-verify (chain/store.py contract).
+                if round_ > 1:
+                    raise ErrMissingPrevious(round_)
+            else:
                 prev = bytes(row[0])
         return Beacon(round=round_, signature=bytes(signature), previous_sig=prev)
 
@@ -91,6 +133,9 @@ class SqliteStore(Store):
         into a temp file — same bytes, one extra disk round trip."""
         with self._lock:
             if hasattr(self._conn, "serialize"):
+                # fold the WAL into the main image first, or commits since
+                # the last checkpoint would be missing from the snapshot
+                self._conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
                 fileobj.write(self._conn.serialize())
                 return
             import os

@@ -3,6 +3,37 @@
 Stores hold the beacon chain ordered by round.  All methods are synchronous;
 engines guard their own state (the beacon engine calls them from multiple
 threads).
+
+Durability / consistency contract (every backend declares where it sits
+via the `DURABILITY` class attribute; tests/test_chain.py pins the matrix):
+
+  * ``volatile``   — contents die with the process (memdb).  `put` is
+    atomic w.r.t. concurrent readers but nothing survives a crash.
+  * ``crash-safe`` — a `put`/`put_many`/`delete` that returned has been
+    committed through a journal and survives a PROCESS crash (sqlitedb
+    under WAL).  With `synchronous=NORMAL` an OS/power failure may lose a
+    tail of recently-committed transactions but can never tear one: the
+    store reopens to some clean prefix of commit order, which the
+    integrity scan + peer repair path re-fills.
+  * ``server``     — durability is delegated to an external database's
+    own guarantees (postgresdb).
+
+Shared semantics all backends must honour (the cross-backend contract
+suite enforces them):
+
+  * `put` of an already-stored round is a no-op or an equal-content
+    overwrite — never an error.  Callers that need replace-with-different
+    -content (the repair path) must `delete` first.
+  * `get`/`last` raise the Err* types below; they never return torn or
+    half-written rows.
+  * `put_many` writes the batch in ONE transaction where the engine has
+    transactions: after a crash either none or a prefix-in-commit-order
+    of the batch is visible, never an interleaving.
+  * Trimmed-format engines (sqlite, postgres) reconstruct `previous_sig`
+    from round-1 when `require_previous=True`; if that prior row is
+    absent they raise `ErrMissingPrevious` instead of fabricating a
+    beacon that cannot re-verify.  Round 1 is exempt — its anchor is the
+    genesis seed (chain metadata), not a stored row.
 """
 
 import struct
@@ -44,13 +75,24 @@ class Cursor(ABC):
 
 
 class Store(ABC):
-    """Beacon chain storage (chain/store.go:16-24)."""
+    """Beacon chain storage (chain/store.go:16-24).
+
+    See the module docstring for the durability/consistency contract that
+    `DURABILITY` and `put_many` are part of."""
+
+    DURABILITY = "volatile"
 
     @abstractmethod
     def __len__(self) -> int: ...
 
     @abstractmethod
     def put(self, beacon: Beacon) -> None: ...
+
+    def put_many(self, beacons) -> None:
+        """Store a batch of beacons; engines with transactions override
+        this with a single-transaction write (see the module contract)."""
+        for b in beacons:
+            self.put(b)
 
     @abstractmethod
     def last(self) -> Beacon:

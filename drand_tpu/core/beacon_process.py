@@ -220,12 +220,70 @@ class BeaconProcess:
                 "metrics", self._metrics_callback)
             group_size.labels(self.beacon_id).set(len(self.group))
             group_threshold.labels(self.beacon_id).set(self.group.threshold)
+        if self.cfg.startup_integrity not in ("off", "linkage", "full"):
+            # fail fast: a typo'd value must not silently degrade the scan
+            raise ValueError(
+                "startup_integrity must be off|linkage|full, got "
+                f"{self.cfg.startup_integrity!r}")
+        if self.cfg.startup_integrity != "off":
+            self._startup_integrity_pass()
         if catchup:
             self.handler.catchup()
         else:
             self.handler.start()
         self.log.info("beacon started", catchup=catchup,
                       genesis=self.group.genesis_time)
+
+    def _startup_integrity_pass(self) -> None:
+        """Scan the store we just reopened before serving from it
+        (cfg.startup_integrity: linkage | full).  The scan is synchronous
+        — it is the point of the knob — but the repair runs on a daemon
+        thread so unreachable peers can't stall startup past the sync
+        budget; until repair lands the corrupt rounds are quarantined
+        (deleted), which is strictly safer than serving them."""
+        mode = self.cfg.startup_integrity
+        verifier = self.syncm.verifier if mode == "full" else None
+        try:
+            report = self.handler.chain.integrity_scan(
+                verifier=verifier, mode=mode, beacon_id=self.beacon_id)
+        except Exception as e:
+            self.log.error("startup integrity scan failed", err=str(e))
+            return
+        if report.clean:
+            self.log.info("startup integrity scan clean",
+                          mode=mode, scanned=report.scanned)
+            return
+        self.log.warn("startup integrity scan found corruption; "
+                      "quarantining and re-fetching from peers",
+                      mode=mode, findings=len(report.findings),
+                      rounds=",".join(str(r) for r in report.faulty_rounds))
+        # quarantine SYNCHRONOUSLY — the docstring's guarantee is that a
+        # known-corrupt round is never served, so the deletes cannot wait
+        # for the repair thread (a peer could sync the bad row in that
+        # window).  heal() re-quarantines idempotently: already-deleted
+        # rows are skipped without double-counting the metric.
+        from ..chain.integrity import IntegrityScanner
+        IntegrityScanner(self.handler.chain.backend, self.syncm.scheme,
+                         beacon_id=self.beacon_id).quarantine(report)
+
+        def repair():
+            try:
+                remaining = self.syncm.heal(
+                    self.handler.chain.backend, report,
+                    peers=self._peers(), beacon_id=self.beacon_id)
+            except Exception as e:
+                self.log.error("startup integrity repair failed", err=str(e))
+                return
+            if remaining:
+                self.log.error("integrity repair incomplete; rounds remain "
+                               "quarantined",
+                               rounds=",".join(str(r) for r in remaining))
+            else:
+                self.log.info("integrity repair complete",
+                              repaired=len(report.faulty_rounds))
+
+        threading.Thread(target=repair, daemon=True,
+                         name=f"integrity-repair-{self.beacon_id}").start()
 
     def _metrics_callback(self, b: Beacon) -> None:
         last_beacon_round.labels(self.beacon_id).set(b.round)

@@ -48,6 +48,13 @@ class Config:
     dkg_callback: Optional[Callable] = None
     use_device_verifier: bool = True     # TPU-batched aggregation verify
     sync_chunk: int = 512
+    # startup chain-integrity pass (chain/integrity.py): "off" trusts the
+    # disk, "linkage" is the structural host-only scan (gaps, torn rows,
+    # prev_sig linkage), "full" adds batched signature verification —
+    # cheap on device, which is what makes it a startup option at all.
+    # Corrupt rounds found are quarantined and re-fetched from peers in
+    # the background (SyncManager.heal, under the sync budget).
+    startup_integrity: str = "off"       # off | linkage | full
     # resilience layer (net/resilience.py; every default is additionally
     # env-overridable there: DRAND_RETRY_*, DRAND_BREAKER_*, DRAND_SYNC_BUDGET)
     retry_max_attempts: int = 0          # 0 = module default

@@ -8,6 +8,7 @@ server, and the on-disk multibeacon layout.
 """
 
 import os
+import tempfile
 import threading
 from typing import Dict, Optional
 
@@ -457,9 +458,17 @@ class ControlService:
         result = {}
 
         def run():
+            # The integrity scanner, not SyncManager.check_past_beacons:
+            # the daemon's raw store does not materialize previous_sig
+            # (require_previous=False), so check_past_beacons would flag
+            # EVERY round of a chained scheme; the scanner carries the
+            # linkage anchor itself and its report lets `heal` quarantine
+            # only rows that are provably bad on disk.
             try:
-                result["faulty"] = bp.syncm.check_past_beacons(
-                    upto, progress=lambda c, t: events.put((c, t)))
+                result["report"] = bp.handler.chain.integrity_scan(
+                    verifier=bp.syncm.verifier, mode="full", upto=upto,
+                    beacon_id=bp.beacon_id,
+                    progress=lambda c, t: events.put((c, t)))
             except Exception as e:
                 result["error"] = e
             finally:
@@ -475,19 +484,43 @@ class ControlService:
         if "error" in result:
             context.abort(grpc.StatusCode.ABORTED,
                           f"check failed: {result['error']}")
-        faulty = result.get("faulty", [])
-        if req.nodes and faulty:
+        report = result["report"]
+        remaining = report.faulty_rounds
+        if req.nodes and remaining:
             peers = [Peer(n, req.is_tls) for n in req.nodes]
-            bp.syncm.correct_past_beacons(bp.store, faulty, peers)
-        yield pb.SyncProgress(current=upto - len(faulty), target=upto)
+            # heal = quarantine the bad rows + re-fetch from breaker-ranked
+            # peers + integrity metrics (chain/integrity.py wiring)
+            remaining = bp.syncm.heal(bp.store, report, peers,
+                                      beacon_id=bp.beacon_id)
+        # the final frame reports the POST-repair state: a full repair
+        # shows current == target, an un-repaired (or repair-less) check
+        # shows the shortfall
+        yield pb.SyncProgress(current=upto - len(remaining), target=upto)
 
     def backup_database(self, req, context):
         bp = self._bp(context, req.metadata)
         if bp.store is None:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                           "beacon not running")
-        with open(req.output_file, "wb") as f:
-            bp.store.save_to(f)
+        # atomic snapshot: stream into a sibling temp file, fsync, rename —
+        # a crash mid-backup must never leave a torn file where an operator
+        # expects a restorable image.  mkstemp (not a fixed name) so two
+        # concurrent backup RPCs to the same target can't write over each
+        # other's temp file; last rename wins with both images intact.
+        out = os.path.abspath(req.output_file)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(out),
+                                   prefix=os.path.basename(out) + ".tmp.")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                bp.store.save_to(f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, out)
+        finally:
+            try:
+                os.unlink(tmp)
+            except FileNotFoundError:
+                pass
         return pb.BackupDBResponse(metadata=convert.metadata(bp.beacon_id))
 
     def remote_status(self, req, context):

@@ -38,6 +38,11 @@ class FollowFacade:
     def store(self):
         return self.cbstore
 
+    @property
+    def backend(self):
+        """Raw store below the decorators (integrity scans + repair)."""
+        return self._backend
+
     def last(self):
         return self.cbstore.last()
 

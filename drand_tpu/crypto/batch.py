@@ -374,6 +374,8 @@ class BatchBeaconVerifier:
     The drand-side analogue would be the `BatchVerifyBeacon` extension of
     crypto.Scheme described in BASELINE.json's north star."""
 
+    kind = "device"  # metrics label for integrity scans (chain/integrity.py)
+
     def __init__(self, scheme: Scheme, public_key_bytes: bytes,
                  pad_to: int | None = None):
         self.scheme = scheme

@@ -11,6 +11,8 @@ from .schemes import Scheme
 
 
 class HostBatchVerifier:
+    kind = "host"    # metrics label for integrity scans (chain/integrity.py)
+
     def __init__(self, scheme: Scheme, public_key_bytes: bytes):
         self.scheme = scheme
         self.pub_point = scheme.key_group.from_bytes(public_key_bytes)

@@ -86,6 +86,27 @@ deadline_exceeded_total = Counter(
     "resilience_deadline_exceeded_total",
     "Operations abandoned because their overall budget was spent",
     ["scope", "op"], registry=GROUP)
+# Chain-integrity subsystem (chain/integrity.py + tools/chain_doctor.py):
+# the scan/quarantine/repair counters live next to the breaker metrics so
+# one scrape answers both "is the network healthy" and "is the disk
+# healthy".  `verifier` is host|device — the acceptance check that a scan
+# really ran through the batched device path reads this label.
+integrity_beacons_scanned = Counter(
+    "chain_integrity_beacons_scanned_total",
+    "Beacon rounds examined by integrity scans",
+    ["beacon_id", "verifier"], registry=GROUP)
+integrity_corrupt_found = Counter(
+    "chain_integrity_corrupt_found_total",
+    "Corrupt/missing rounds flagged by integrity scans",
+    ["beacon_id", "kind"], registry=GROUP)
+integrity_quarantined = Counter(
+    "chain_integrity_quarantined_total",
+    "Corrupt rounds deleted (quarantined) pending re-fetch",
+    ["beacon_id"], registry=GROUP)
+integrity_repaired = Counter(
+    "chain_integrity_repaired_total",
+    "Quarantined/missing rounds re-fetched, re-verified and restored",
+    ["beacon_id"], registry=GROUP)
 # TPU-specific: the device batch-verification pipeline.
 batch_verify_rounds = Counter(
     "tpu_batch_verify_rounds_total", "Beacon rounds verified on device",

@@ -17,6 +17,10 @@ Building blocks:
   * `ChaosScenario`— N-node sync network, some peers Byzantine; honest
                      nodes sync through breaker-aware SyncManagers and must
                      converge to one identical verified chain.
+  * `StorageFaultPlan` / `inject_storage_faults` — seeded AT-REST faults
+                     (torn write, bit flip, deleted row) written INTO a
+                     store, for the chain-integrity scan/repair path
+                     (chain/integrity.py, tools/chain_doctor.py).
 """
 
 import hashlib
@@ -172,12 +176,74 @@ class ChaosStore:
         self._healed.add(b.round)
         self.raw.put(b)
 
+    def put_many(self, beacons) -> None:
+        # must route through OUR put so repaired rounds count as healed
+        for b in beacons:
+            self.put(b)
+
     def delete(self, round_: int) -> None:
         self._healed.add(round_)
         self.raw.delete(round_)
 
     def __getattr__(self, name):
         return getattr(self.raw, name)
+
+
+# ---------------------------------------------------------------------------
+# storage faults at rest (the chain-doctor target): unlike ChaosStore's
+# read-path faults, these mutate the stored rows themselves — what a crash
+# mid-write, a bad sector, or an operator's stray DELETE leaves behind.
+# ---------------------------------------------------------------------------
+
+TORN_WRITE = "torn_write"      # row exists but the blob is truncated
+BIT_FLIP = "bit_flip"          # right length, one bit of the signature off
+DELETED_ROW = "deleted_row"    # row gone entirely
+
+
+@dataclass
+class StorageFaultPlan:
+    """Seeded at-rest fault assignment.  `assign` is a pure function of
+    (seed, max_round), so a scenario replay corrupts the same rounds the
+    same way regardless of interleaving."""
+
+    seed: int = 0
+    torn_writes: int = 1
+    bit_flips: int = 1
+    deleted_rows: int = 1
+
+    def assign(self, max_round: int) -> Dict[int, str]:
+        total = self.torn_writes + self.bit_flips + self.deleted_rows
+        if total > max_round:
+            raise ValueError(f"{total} faults > {max_round} rounds")
+        rng = random.Random(stable_seed(self.seed, "storage-faults"))
+        rounds = rng.sample(range(1, max_round + 1), total)
+        kinds = ([TORN_WRITE] * self.torn_writes
+                 + [BIT_FLIP] * self.bit_flips
+                 + [DELETED_ROW] * self.deleted_rows)
+        return dict(zip(rounds, kinds))
+
+
+def inject_storage_faults(store, plan: StorageFaultPlan,
+                          max_round: int) -> Dict[int, str]:
+    """Write the plan's faults into `store` (any chain.Store; the
+    delete-then-put dance is needed because memdb ignores duplicate-round
+    puts).  Returns {round: fault_kind} for post-run assertions."""
+    faults = plan.assign(max_round)
+    for r, kind in sorted(faults.items()):
+        if kind == DELETED_ROW:
+            store.delete(r)
+            continue
+        b = store.get(r)
+        if kind == BIT_FLIP:
+            sig = bytearray(b.signature)
+            sig[len(sig) // 3] ^= 0x01
+            sig = bytes(sig)
+        else:                                   # TORN_WRITE
+            sig = b.signature[:len(b.signature) // 2]
+        store.delete(r)
+        store.put(Beacon(round=r, signature=sig,
+                         previous_sig=b.previous_sig))
+    return faults
 
 
 # ---------------------------------------------------------------------------
@@ -354,3 +420,114 @@ class ChaosScenario:
                               chain_digest=digests[0],
                               events=list(self.events),
                               breaker_snapshots=snapshots)
+
+
+# ---------------------------------------------------------------------------
+# storage chaos: corrupt one node's store at rest, prove the integrity
+# scan detects it, the heal path repairs from peers, and the post-repair
+# full-crypto rescan comes back clean — zero real I/O (fake clock,
+# in-memory peers)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class StorageScenarioResult:
+    injected: Dict[int, str]            # round -> fault kind
+    detected_rounds: List[int]          # faulty rounds the scan flagged
+    all_detected: bool                  # every injected round was flagged
+    unrepaired: List[int]
+    rescan_clean: bool
+    converged: bool                     # all nodes byte-identical again
+    chain_digest: str
+
+    @property
+    def ok(self) -> bool:
+        return (self.all_detected and not self.unrepaired
+                and self.rescan_clean and self.converged)
+
+
+class StorageChaosScenario:
+    """N honest nodes all holding the full true chain; node 0's store gets
+    seeded at-rest faults.  run() = scan → heal(from peers) → rescan."""
+
+    def __init__(self, seed: int, n_nodes: int = 3, rounds: int = 24,
+                 torn_writes: int = 1, bit_flips: int = 1,
+                 deleted_rows: int = 1, chain: Optional[TrueChain] = None):
+        assert n_nodes >= 2, "need at least one healthy peer"
+        self.seed = seed
+        self.rounds = rounds
+        self.clock = AutoClock(start=1_000.0)
+        self.chain = chain if chain is not None and chain.n >= rounds \
+            else TrueChain(n=rounds)
+        self.addresses = [f"node{i}" for i in range(n_nodes)]
+        self.victim = self.addresses[0]
+        self.plan = StorageFaultPlan(seed=stable_seed(seed, "at-rest"),
+                                     torn_writes=torn_writes,
+                                     bit_flips=bit_flips,
+                                     deleted_rows=deleted_rows)
+        self.stores: Dict[str, MemDBStore] = {}
+        for a in self.addresses:
+            store = MemDBStore(buffer_size=rounds + 8)
+            for r in range(1, rounds + 1):
+                store.put(self.chain.beacons[r])
+            self.stores[a] = store
+
+    def fetch(self, peer, from_round: int):
+        store = self.stores[str(peer)]
+        for r in range(from_round, self.rounds + 1):
+            try:
+                yield store.get(r)
+            except Exception:
+                return
+
+    def run(self) -> StorageScenarioResult:
+        from drand_tpu.chain.integrity import IntegrityScanner
+
+        victim_store = self.stores[self.victim]
+        injected = inject_storage_faults(victim_store, self.plan, self.rounds)
+        scanner = IntegrityScanner(
+            victim_store, self.chain.scheme,
+            verifier=HostBatchVerifier(self.chain.scheme, self.chain.public),
+            genesis_seed=self.chain.genesis_seed, chunk=8,
+            beacon_id="chaos-storage")
+        # explicit upto: a deleted HEAD row would otherwise shrink the
+        # store's own idea of how long the chain is
+        report = scanner.scan(mode="full", upto=self.rounds)
+        detected = report.faulty_rounds
+        all_detected = set(injected).issubset(detected)
+
+        facade = FollowFacade(victim_store, self.chain.scheme.chained,
+                              self.chain.genesis_seed)
+        peers = [a for a in self.addresses if a != self.victim]
+        policy = ResiliencePolicy(
+            clock=self.clock, backoff=BackoffPolicy(base=0.2, cap=2.0),
+            breakers=BreakerRegistry(clock=self.clock,
+                                     scope="chaos-storage"),
+            scope="chaos-storage", seed=stable_seed(self.seed, "heal"))
+        syncm = SyncManager(
+            chain=facade, scheme=self.chain.scheme,
+            public_key_bytes=self.chain.public, period=30,
+            clock=self.clock, fetch=self.fetch, peers=peers, chunk=8,
+            verifier=HostBatchVerifier(self.chain.scheme, self.chain.public),
+            resilience=policy)
+        unrepaired = syncm.heal(victim_store, report, peers,
+                                beacon_id="chaos-storage")
+        rescan = scanner.scan(mode="full", upto=self.rounds)
+
+        digests = []
+        converged = True
+        for a in self.addresses:
+            h = hashlib.sha256()
+            for r in range(1, self.rounds + 1):
+                try:
+                    h.update(self.stores[a].get(r).signature)
+                except Exception:
+                    h.update(b"missing")
+                    converged = False
+            digests.append(h.hexdigest())
+        converged = converged and len(set(digests)) == 1
+        return StorageScenarioResult(
+            injected=injected, detected_rounds=detected,
+            all_detected=all_detected, unrepaired=unrepaired,
+            rescan_clean=rescan.clean, converged=converged,
+            chain_digest=digests[0])

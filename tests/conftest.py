@@ -149,7 +149,12 @@ if os.environ.get("PALLAS_AXON_POOL_IPS"):
 # persistent cache on CPU — see above).  Run them LAST so a time-bounded
 # run still exercises the whole framework first.
 _HEAVY = ("test_batch", "test_multichip", "test_ops_curve_pairing",
-          "test_partials", "test_ops_pallas")
+          "test_partials", "test_ops_pallas",
+          # the one integrity test that runs the DEVICE verifier: ordered
+          # into the heavy bucket (after test_batch, which compiles the
+          # same pad-8 RLC pipeline) so a cold XLA cache can't stall the
+          # fast group
+          "test_chain_doctor_scan_clean_uses_device_verifier")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -5,11 +5,11 @@ import io
 
 import pytest
 
-from drand_tpu.chain import (Beacon, ErrNoBeaconSaved, ErrNoBeaconStored,
-                             Info, MemDBStore, SqliteStore,
-                             TIME_OF_ROUND_ERROR, bytes_to_round,
-                             current_round, genesis_beacon, next_round,
-                             round_to_bytes, time_of_round)
+from drand_tpu.chain import (Beacon, ErrMissingPrevious, ErrNoBeaconSaved,
+                             ErrNoBeaconStored, Info, MemDBStore,
+                             SqliteStore, TIME_OF_ROUND_ERROR,
+                             bytes_to_round, current_round, genesis_beacon,
+                             next_round, round_to_bytes, time_of_round)
 
 
 # ---------------------------------------------------------------------------
@@ -197,9 +197,13 @@ def test_sqlite_previous_reconstruction(tmp_path):
     got = s.get(3)
     assert got.previous_sig == chain[2].signature  # rebuilt from round-2
     assert s.get(0).previous_sig is None
-    # hole: previous unavailable -> None, not an error
+    # hole: the store must NOT fabricate a beacon with an empty
+    # previous_sig that cannot re-verify (chain/store.py contract) —
+    # the gap surfaces as ErrMissingPrevious for the integrity scan
     s.delete(2)
-    assert s.get(3).previous_sig is None
+    with pytest.raises(ErrMissingPrevious):
+        s.get(3)
+    assert s.get(1).previous_sig == chain[0].signature  # below the hole: fine
     s.close()
 
 
@@ -245,9 +249,100 @@ def test_postgres_previous_reconstruction(tmp_path):
         s.put(b)
     assert s.get(3).previous_sig == chain[2].signature
     assert s.get(0).previous_sig is None
+    # same strict-hole contract as sqlite: no fabricated previous_sig
     s.delete(2)
-    assert s.get(3).previous_sig is None
+    with pytest.raises(ErrMissingPrevious):
+        s.get(3)
     s.close()
+
+
+# ---------------------------------------------------------------------------
+# Cross-backend durability/consistency contract (chain/store.py docstring):
+# the same scenarios over memdb / sqlite / pg-dialect so backends can't drift
+# ---------------------------------------------------------------------------
+
+
+def test_store_durability_contract(store):
+    assert store.DURABILITY in ("volatile", "crash-safe", "server")
+
+
+def test_store_put_many_contract(store):
+    chain = _mk_chain(12)
+    store.put_many(chain[:8])
+    assert len(store) == 8
+    assert store.last().round == 7
+    # overlapping batch: duplicate rounds are harmless, the rest lands
+    store.put_many(chain[6:])
+    assert len(store) == 12
+    assert [b.round for b in store.cursor()] == list(range(12))
+    assert store.get(9).signature == chain[9].signature
+
+
+def test_store_empty_put_many(store):
+    store.put_many([])
+    assert len(store) == 0
+
+
+def test_store_gap_contract(store):
+    """A chain with a hole: reads of the hole raise, reads below it work,
+    and trimmed-format stores refuse to fabricate previous_sig above it."""
+    chain = _mk_chain(9)
+    store.put_many([b for b in chain if b.round not in (4, 5)])
+    assert len(store) == 7
+    with pytest.raises(ErrNoBeaconSaved):
+        store.get(4)
+    assert store.get(3).signature == chain[3].signature
+    if getattr(store, "require_previous", False):
+        # strict-previous contract: the row above the hole cannot be
+        # reconstructed — ErrMissingPrevious, not a half-beacon
+        with pytest.raises(ErrMissingPrevious):
+            store.get(6)
+        assert store.get(7).previous_sig == chain[6].signature
+    else:
+        assert [b.round for b in store.cursor()] == [0, 1, 2, 3, 6, 7, 8]
+        assert store.last().round == 8
+
+
+def test_sqlite_durability_pragmas(tmp_path):
+    """WAL + synchronous=NORMAL + busy_timeout on every connect (the
+    crash-safe half of the store contract)."""
+    s = SqliteStore(str(tmp_path / "w.db"))
+    (mode,) = s._conn.execute("PRAGMA journal_mode").fetchone()
+    assert mode == "wal"
+    (sync,) = s._conn.execute("PRAGMA synchronous").fetchone()
+    assert sync == 1                       # NORMAL
+    (busy,) = s._conn.execute("PRAGMA busy_timeout").fetchone()
+    assert busy == 5000
+    s.close()
+
+
+def test_sqlite_put_many_single_transaction(tmp_path):
+    """A batch with a poison row commits NOTHING — all-or-nothing, no
+    half-chunk on disk after a failure mid-batch."""
+    s = SqliteStore(str(tmp_path / "tx.db"))
+    chain = _mk_chain(8)
+    s.put_many(chain[:5])
+    poison = [chain[5], Beacon(round=99, signature=None), chain[6]]
+    with pytest.raises(Exception):
+        s.put_many(poison)
+    assert len(s) == 5                     # neither chain[5] nor chain[6]
+    with pytest.raises(ErrNoBeaconSaved):
+        s.get(5)
+    s.close()
+
+
+def test_sqlite_survives_unclosed_connection(tmp_path):
+    """Crash surrogate: rows written through one connection are visible to
+    a second connection opened while the first is still alive (WAL commits
+    are on disk at put() return — the crash-safe contract)."""
+    path = str(tmp_path / "crash.db")
+    writer = SqliteStore(path)
+    writer.put_many(_mk_chain(6))
+    reader = SqliteStore(path)             # no close() of writer: "crashed"
+    assert len(reader) == 6
+    assert reader.last().round == 5
+    reader.close()
+    writer.close()
 
 
 def test_postgres_beacon_id_isolation(tmp_path):

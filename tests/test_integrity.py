@@ -1,0 +1,387 @@
+"""Chain-integrity subsystem (chain/integrity.py + SyncManager.heal +
+tools/chain_doctor.py): seeded at-rest storage faults are detected,
+quarantined, repaired from peers, and the post-repair full-crypto rescan
+is clean — all with a fake clock and in-memory peers (zero network I/O).
+"""
+
+import os
+import sys
+
+import pytest
+
+from drand_tpu.chain.beacon import Beacon, genesis_beacon
+from drand_tpu.chain.integrity import (INVALID_SIG, MALFORMED, MISSING,
+                                       UNLINKED, IntegrityScanner)
+from drand_tpu.chain.memdb import MemDBStore
+from drand_tpu.chain.sqlitedb import SqliteStore
+from drand_tpu.crypto.hostverify import HostBatchVerifier
+
+from chaos import (BIT_FLIP, DELETED_ROW, TORN_WRITE, StorageChaosScenario,
+                   StorageFaultPlan, TrueChain, inject_storage_faults,
+                   stable_seed)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+N = 24
+
+pytestmark = pytest.mark.storage
+
+
+@pytest.fixture(scope="module")
+def chain():
+    return TrueChain(n=N)
+
+
+def _seeded_store(chain, store=None, upto=N, genesis=False):
+    store = store if store is not None else MemDBStore(buffer_size=100)
+    if genesis:
+        store.put(genesis_beacon(chain.genesis_seed))
+    for r in range(1, upto + 1):
+        store.put(chain.beacons[r])
+    return store
+
+
+def _scanner(chain, store, verifier=None, chunk=8):
+    return IntegrityScanner(
+        store, chain.scheme,
+        verifier=verifier or HostBatchVerifier(chain.scheme, chain.public),
+        genesis_seed=chain.genesis_seed, chunk=chunk,
+        beacon_id="test-integrity")
+
+
+# ---------------------------------------------------------------------------
+# scanner unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_scan_clean_chain_memdb_and_sqlite(chain, tmp_path):
+    for store in (_seeded_store(chain),
+                  _seeded_store(chain, SqliteStore(str(tmp_path / "c.db")),
+                                genesis=True)):
+        report = _scanner(chain, store).scan(mode="full")
+        assert report.clean
+        assert report.scanned == N
+        assert report.upto == N
+        store.close()
+
+
+def test_scan_empty_store_is_clean(chain):
+    report = _scanner(chain, MemDBStore(buffer_size=100)).scan(mode="full")
+    assert report.clean and report.scanned == 0
+
+
+def test_scan_empty_store_with_upto_flags_all_missing(chain):
+    """A wiped store is NOT clean when the caller names a target: every
+    round up to `upto` is a MISSING finding (full truncation must not
+    scan healthy)."""
+    report = _scanner(chain, MemDBStore(buffer_size=100)).scan(
+        mode="full", upto=7)
+    assert not report.clean
+    assert report.rounds(MISSING) == list(range(1, 8))
+
+
+def test_scan_flags_each_fault_kind(chain):
+    store = _seeded_store(chain)
+    # deterministic handcrafted faults at known rounds
+    store.delete(5)                                     # hole
+    b9 = store.get(9)
+    store.delete(9)
+    store.put(Beacon(round=9, signature=b9.signature[:40],
+                     previous_sig=b9.previous_sig))     # torn write
+    b14 = store.get(14)
+    sig = bytearray(b14.signature)
+    sig[7] ^= 0x10
+    store.delete(14)
+    store.put(Beacon(round=14, signature=bytes(sig),
+                     previous_sig=b14.previous_sig))    # bit flip
+    report = _scanner(chain, store).scan(mode="full")
+    assert 5 in report.rounds(MISSING)
+    assert 9 in report.rounds(MALFORMED)
+    assert 14 in report.rounds(INVALID_SIG)
+    # the round ABOVE a corrupt row failed verification only because its
+    # anchor is corrupt — unprovable (UNLINKED), not provably invalid
+    assert 15 not in report.rounds(INVALID_SIG)
+    assert 15 in report.rounds(UNLINKED)
+    # healthy rounds away from the damage are not flagged
+    for r in (2, 3, 12, 20, N):
+        assert r not in report.faulty_rounds
+    # missing rounds have no row to quarantine; the others do
+    assert 5 not in report.quarantinable_rounds
+    assert {9, 14} <= set(report.quarantinable_rounds)
+
+
+def test_scan_linkage_mode_needs_no_verifier(chain):
+    store = _seeded_store(chain)
+    store.delete(7)
+    scanner = IntegrityScanner(store, chain.scheme,
+                               genesis_seed=chain.genesis_seed)
+    report = scanner.scan(mode="linkage")
+    assert report.rounds(MISSING) == [7]
+    assert report.verifier == "none"
+    with pytest.raises(ValueError):
+        scanner.scan(mode="full")      # full mode requires a verifier
+
+
+def test_scan_unlinked_explicit_previous(chain):
+    """A stored previous_sig that contradicts the previous row's stored
+    signature is flagged UNLINKED even when the signature itself is
+    genuine (full-beacon stores like memdb persist previous_sig and it
+    can rot independently)."""
+    store = _seeded_store(chain)
+    b10 = store.get(10)
+    store.delete(10)
+    store.put(Beacon(round=10, signature=b10.signature,
+                     previous_sig=b"\x13" * 96))
+    report = _scanner(chain, store).scan(mode="full")
+    assert 10 in report.rounds(UNLINKED)
+
+
+def test_scan_upto_extends_past_head(chain):
+    """A truncated chain (deleted tail) is only visible when the caller
+    says how long the chain SHOULD be."""
+    store = _seeded_store(chain, upto=N - 3)
+    report = _scanner(chain, store).scan(mode="full", upto=N)
+    assert report.rounds(MISSING) == [N - 2, N - 1, N]
+
+
+def test_quarantine_deletes_only_bad_rows(chain):
+    store = _seeded_store(chain)
+    store.delete(5)
+    b9 = store.get(9)
+    store.delete(9)
+    store.put(Beacon(round=9, signature=b9.signature[:40],
+                     previous_sig=b9.previous_sig))
+    scanner = _scanner(chain, store)
+    report = scanner.scan(mode="full")
+    deleted = scanner.quarantine(report)
+    assert 9 in deleted and 5 not in deleted
+    with pytest.raises(Exception):
+        store.get(9)
+    assert store.get(2).signature == chain.beacons[2].signature
+
+
+def test_quarantine_plain_list_skips_absent_rounds(chain):
+    """A plain round list (daemon check-chain path) may include rounds
+    that were never on disk; they must not count as quarantined (engines
+    no-op missing deletes)."""
+    from drand_tpu.metrics import integrity_quarantined
+
+    store = _seeded_store(chain)
+    store.delete(6)                     # 6 is already gone
+    scanner = IntegrityScanner(store, chain.scheme,
+                               beacon_id="test-quarantine-plain")
+    before = integrity_quarantined.labels(
+        "test-quarantine-plain")._value.get()
+    deleted = scanner.quarantine([3, 6])
+    assert deleted == [3]
+    assert integrity_quarantined.labels(
+        "test-quarantine-plain")._value.get() == before + 1
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: 3 nodes, seeded at-rest faults (torn write +
+# bit flip + deleted row), zero network I/O
+# ---------------------------------------------------------------------------
+
+
+def test_storage_chaos_detect_quarantine_repair_converge(chain):
+    scenario = StorageChaosScenario(seed=42, n_nodes=3, rounds=N,
+                                    chain=chain)
+    result = scenario.run()
+    assert sorted(result.injected.values()) == sorted(
+        [TORN_WRITE, BIT_FLIP, DELETED_ROW])
+    assert result.all_detected, (result.injected, result.detected_rounds)
+    assert result.unrepaired == []
+    assert result.rescan_clean
+    assert result.converged
+    assert result.ok
+
+
+def test_storage_chaos_deterministic_replay(chain):
+    r1 = StorageChaosScenario(seed=7, rounds=N, chain=chain).run()
+    r2 = StorageChaosScenario(seed=7, rounds=N, chain=chain).run()
+    assert r1.injected == r2.injected
+    assert r1.detected_rounds == r2.detected_rounds
+    assert r1.chain_digest == r2.chain_digest
+    # a different seed corrupts different rounds
+    r3 = StorageChaosScenario(seed=8, rounds=N, chain=chain).run()
+    assert r3.injected != r1.injected
+
+
+def test_fault_plan_is_pure_function_of_seed():
+    p = StorageFaultPlan(seed=stable_seed(3, "x"), torn_writes=2,
+                         bit_flips=2, deleted_rows=1)
+    assert p.assign(50) == p.assign(50)
+    assert len(p.assign(50)) == 5
+
+
+# ---------------------------------------------------------------------------
+# sqlite end-to-end + the chain-doctor CLI (device verifier path)
+# ---------------------------------------------------------------------------
+
+
+def _doctor_db(chain, tmp_path, name="chain.db", faults=None):
+    store = SqliteStore(str(tmp_path / name))
+    _seeded_store(chain, store, genesis=True)
+    if faults:
+        inject_storage_faults(store, faults, N)
+    store.close()
+    return str(tmp_path / name)
+
+
+def test_chain_doctor_scan_clean_uses_device_verifier(chain, tmp_path):
+    """Acceptance: `chain_doctor.py scan` on an intact chain reports 0
+    findings THROUGH the batched device verifier, proven by the
+    chain_integrity_beacons_scanned{verifier="device"} counter."""
+    from drand_tpu.metrics import integrity_beacons_scanned
+    import chain_doctor
+
+    db = _doctor_db(chain, tmp_path)
+    counter = integrity_beacons_scanned.labels("default", "device")
+    before = counter._value.get()
+    # chunk 8 keeps the device pass on the pad-8 pipeline shape the batch
+    # suite already compiles (cold XLA compiles are minutes on 2 CPU cores)
+    sys_argv = ["chain_doctor.py", "scan", "--db", db,
+                "--scheme", chain.scheme.id,
+                "--pubkey", chain.public.hex(),
+                "--genesis-seed", chain.genesis_seed.hex(),
+                "--chunk", "8"]
+    old = sys.argv
+    sys.argv = sys_argv
+    try:
+        rc = chain_doctor.main()
+    finally:
+        sys.argv = old
+    assert rc == 0
+    assert counter._value.get() == before + N
+
+
+def test_chain_doctor_repair_from_db(chain, tmp_path):
+    """repair --from-db: corrupt chain + healthy backup -> clean rescan."""
+    import chain_doctor
+
+    bad = _doctor_db(chain, tmp_path, "bad.db",
+                     faults=StorageFaultPlan(seed=stable_seed(5, "dr")))
+    good = _doctor_db(chain, tmp_path, "good.db")
+    old = sys.argv
+    sys.argv = ["chain_doctor.py", "repair", "--db", bad,
+                "--scheme", chain.scheme.id,
+                "--pubkey", chain.public.hex(),
+                "--genesis-seed", chain.genesis_seed.hex(),
+                "--upto", str(N), "--host", "--from-db", good]
+    try:
+        rc = chain_doctor.main()
+    finally:
+        sys.argv = old
+    assert rc == 0
+    store = SqliteStore(bad)
+    for r in range(1, N + 1):
+        assert store.get(r).signature == chain.beacons[r].signature
+    store.close()
+
+
+def test_chain_doctor_repair_linkage_mode(chain, tmp_path):
+    """repair --mode linkage: the initial scan is structural-only, but the
+    post-repair rescan is still full-crypto (the scanner gains the repair
+    verifier instead of crashing on the hard-coded full mode)."""
+    import chain_doctor
+
+    bad = _doctor_db(chain, tmp_path, "bad.db",
+                     faults=StorageFaultPlan(seed=stable_seed(6, "lk"),
+                                             bit_flips=0))
+    good = _doctor_db(chain, tmp_path, "good.db")
+    old = sys.argv
+    sys.argv = ["chain_doctor.py", "repair", "--db", bad,
+                "--scheme", chain.scheme.id,
+                "--pubkey", chain.public.hex(),
+                "--genesis-seed", chain.genesis_seed.hex(),
+                "--upto", str(N), "--host", "--mode", "linkage",
+                "--from-db", good]
+    try:
+        rc = chain_doctor.main()
+    finally:
+        sys.argv = old
+    assert rc == 0
+
+
+def test_startup_integrity_pass_glue(chain):
+    """core/beacon_process._startup_integrity_pass: scan synchronously,
+    quarantine, repair on a background thread — exercised against a stub
+    process so it needs no DKG, with in-memory peers and a fake clock."""
+    import time
+    from types import SimpleNamespace
+
+    from drand_tpu.beacon.clock import FakeClock
+    from drand_tpu.beacon.sync import SyncManager
+    from drand_tpu.core.beacon_process import BeaconProcess
+    from drand_tpu.core.follow import FollowFacade
+    from drand_tpu.log import Logger
+
+    victim = _seeded_store(chain)
+    inject_storage_faults(
+        victim, StorageFaultPlan(seed=stable_seed(9, "startup")), N)
+    facade = FollowFacade(victim, chain.scheme.chained, chain.genesis_seed)
+
+    def fetch(peer, from_round):
+        for r in range(from_round, N + 1):
+            yield chain.beacons[r]
+
+    syncm = SyncManager(
+        chain=facade, scheme=chain.scheme, public_key_bytes=chain.public,
+        period=30, clock=FakeClock(1), fetch=fetch, peers=["peer0"],
+        chunk=8, verifier=HostBatchVerifier(chain.scheme, chain.public))
+    scanner = _scanner(chain, victim)
+
+    class FakeChain:
+        backend = victim
+
+        def integrity_scan(self, verifier=None, mode="full", upto=None,
+                           progress=None, beacon_id="default", chunk=512):
+            return scanner.scan(mode=mode, upto=N)
+
+    bp = SimpleNamespace(
+        cfg=SimpleNamespace(startup_integrity="full"),
+        syncm=syncm, handler=SimpleNamespace(chain=FakeChain()),
+        log=Logger(), beacon_id="startup-test", _peers=lambda: ["peer0"])
+    BeaconProcess._startup_integrity_pass(bp)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if scanner.scan(mode="full", upto=N).clean:
+            break
+        time.sleep(0.05)
+    assert scanner.scan(mode="full", upto=N).clean
+
+
+def test_heal_with_scan_report_quarantines_and_repairs(chain):
+    """SyncManager.heal(ScanReport): quarantine metrics + repaired
+    metrics + raw-store writeback."""
+    from drand_tpu.beacon.clock import FakeClock
+    from drand_tpu.beacon.sync import SyncManager
+    from drand_tpu.core.follow import FollowFacade
+    from drand_tpu.metrics import integrity_quarantined, integrity_repaired
+
+    victim = _seeded_store(chain)
+    inject_storage_faults(
+        victim, StorageFaultPlan(seed=stable_seed(11, "heal")), N)
+    facade = FollowFacade(victim, chain.scheme.chained, chain.genesis_seed)
+
+    def fetch(peer, from_round):
+        for r in range(from_round, N + 1):
+            yield chain.beacons[r]
+
+    syncm = SyncManager(
+        chain=facade, scheme=chain.scheme, public_key_bytes=chain.public,
+        period=30, clock=FakeClock(1), fetch=fetch, peers=["peer0"],
+        chunk=8, verifier=HostBatchVerifier(chain.scheme, chain.public))
+    scanner = _scanner(chain, victim)
+    report = scanner.scan(mode="full", upto=N)
+    assert not report.clean
+    q_before = integrity_quarantined.labels("test-heal")._value.get()
+    r_before = integrity_repaired.labels("test-heal")._value.get()
+    remaining = syncm.heal(victim, report, beacon_id="test-heal")
+    assert remaining == []
+    assert integrity_quarantined.labels("test-heal")._value.get() > q_before
+    assert integrity_repaired.labels("test-heal")._value.get() \
+        == r_before + len(report.faulty_rounds)
+    assert scanner.scan(mode="full", upto=N).clean

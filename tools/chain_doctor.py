@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Chain doctor: offline scan / repair / report for a stored beacon chain.
+
+    scan    — walk the store, report gaps / torn rows / broken linkage /
+              invalid signatures (full mode runs the batched device
+              verifier; --host falls back to CPU pairings).
+    repair  — scan, quarantine the corrupt rows, re-fetch the union of
+              corrupt + missing rounds from a healthy source (--from-db
+              another chain.db, or --peers running nodes over gRPC),
+              re-verify, write back, and prove health with a post-repair
+              full-crypto rescan.
+    report  — scan and emit the machine-readable JSON report.
+
+Chain identity comes from --info (a chain-info JSON file, hash-checked) or
+from --scheme/--pubkey[/--genesis-seed].  Examples:
+
+    python tools/chain_doctor.py scan --db ~/.drand/multibeacon/default/db/chain.db \
+        --info chain-info.json
+    python tools/chain_doctor.py repair --db chain.db --scheme pedersen-bls-chained \
+        --pubkey 868f00..af31 --genesis-seed 176f..390a --from-db backup.db
+
+Exit codes: 0 = clean (or fully repaired), 1 = findings remain, 2 = usage/
+environment error.
+"""
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _identity(args):
+    """(scheme, public_key_bytes, genesis_seed|None) from the CLI args."""
+    from drand_tpu.chain.info import Info
+    from drand_tpu.crypto.schemes import scheme_from_name
+    if args.info:
+        with open(args.info, "rb") as f:
+            info = Info.from_json(f.read())
+        return scheme_from_name(info.scheme), info.public_key, \
+            info.genesis_seed
+    if not (args.scheme and args.pubkey):
+        # exit 2, not the bare-string SystemExit's 1 — 1 means "findings
+        # remain" in this tool's contract
+        print("need --info, or --scheme and --pubkey", file=sys.stderr)
+        raise SystemExit(2)
+    seed = bytes.fromhex(args.genesis_seed) if args.genesis_seed else None
+    return scheme_from_name(args.scheme), bytes.fromhex(args.pubkey), seed
+
+
+def _verifier(scheme, pubkey, host: bool):
+    if host:
+        from drand_tpu.crypto.hostverify import HostBatchVerifier
+        return HostBatchVerifier(scheme, pubkey)
+    from drand_tpu.crypto.batch import BatchBeaconVerifier
+    return BatchBeaconVerifier(scheme, pubkey)
+
+
+def _scanner(args):
+    from drand_tpu.chain.integrity import IntegrityScanner
+    from drand_tpu.chain.sqlitedb import SqliteStore
+    scheme, pubkey, seed = _identity(args)
+    store = SqliteStore(args.db)
+    verifier = None
+    if args.mode == "full":
+        verifier = _verifier(scheme, pubkey, args.host)
+    scanner = IntegrityScanner(store, scheme, verifier=verifier,
+                               genesis_seed=seed, chunk=args.chunk,
+                               beacon_id=args.beacon_id)
+    return scanner, store, scheme, pubkey, seed
+
+
+def _progress(done, upto):
+    print(f"  scanned up to round {done}/{upto}", file=sys.stderr)
+
+
+def cmd_scan(args) -> int:
+    scanner, store, *_ = _scanner(args)
+    try:
+        report = scanner.scan(mode=args.mode, upto=args.upto,
+                              progress=_progress)
+    finally:
+        store.close()
+    if args.json:
+        print(report.to_json())
+    else:
+        print(f"chain doctor scan: {report.summary()}")
+        for f in report.findings:
+            detail = f" — {f.detail}" if f.detail else ""
+            print(f"  round {f.round}: {f.kind}{detail}")
+    return 0 if report.clean else 1
+
+
+def cmd_report(args) -> int:
+    args.json = True
+    return cmd_scan(args)
+
+
+def _local_fetch(src_path: str):
+    """fetch(peer, from_round) over another sqlite chain file.  The source
+    opens with require_previous so chained repairs carry the previous_sig
+    the verifier needs."""
+    from drand_tpu.chain.sqlitedb import SqliteStore
+    src = SqliteStore(src_path, require_previous=True)
+
+    def fetch(peer, from_round: int):
+        cur = src.cursor()
+        b = cur.seek(max(1, from_round))
+        while b is not None:
+            yield b
+            b = cur.next()
+
+    return fetch, src
+
+
+def _grpc_fetch(args):
+    from drand_tpu.net import Peer
+    from drand_tpu.net.client import ProtocolClient
+    client = ProtocolClient()
+    peers = [Peer(a.strip(), args.tls) for a in args.peers.split(",") if a.strip()]
+
+    def fetch(peer, from_round: int):
+        return client.sync_chain(peer, from_round, args.beacon_id)
+
+    return fetch, peers
+
+
+def cmd_repair(args) -> int:
+    from drand_tpu.beacon.clock import RealClock
+    from drand_tpu.beacon.sync import SyncManager
+    from drand_tpu.core.follow import FollowFacade
+
+    scanner, store, scheme, pubkey, seed = _scanner(args)
+    src = None
+    try:
+        report = scanner.scan(mode=args.mode, upto=args.upto,
+                              progress=_progress)
+        print(f"scan: {report.summary()}")
+        if report.clean:
+            return 0
+        if scheme.chained and seed is None:
+            print("repair of a chained scheme needs --genesis-seed or "
+                  "--info (round 1 anchors on it)", file=sys.stderr)
+            return 2
+        if args.from_db:
+            fetch, src = _local_fetch(args.from_db)
+            peers = ["local"]
+        elif args.peers:
+            fetch, peers = _grpc_fetch(args)
+        else:
+            print("repair needs --from-db or --peers", file=sys.stderr)
+            return 2
+        verifier = _verifier(scheme, pubkey, args.host)
+        # the post-repair rescan below is always full-crypto, even when the
+        # initial scan was linkage-only — make sure the scanner can run it
+        if scanner.verifier is None:
+            scanner.verifier = verifier
+        facade = FollowFacade(store, scheme.chained, seed or b"")
+        syncm = SyncManager(
+            chain=facade, scheme=scheme, public_key_bytes=pubkey,
+            period=30, clock=RealClock(), fetch=fetch, peers=peers,
+            verifier=verifier)
+        remaining = syncm.heal(store, report, peers,
+                               beacon_id=args.beacon_id)
+        if remaining:
+            print(f"UNREPAIRED rounds (still quarantined): {remaining}")
+            return 1
+        # prove health: post-repair full-crypto rescan
+        rescan = scanner.scan(mode="full", upto=args.upto)
+        print(f"post-repair rescan: {rescan.summary()}")
+        return 0 if rescan.clean else 1
+    finally:
+        store.close()
+        if src is not None:
+            src.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name, fn in (("scan", cmd_scan), ("repair", cmd_repair),
+                     ("report", cmd_report)):
+        p = sub.add_parser(name)
+        p.set_defaults(fn=fn)
+        p.add_argument("--db", required=True, help="sqlite chain.db path")
+        p.add_argument("--info", help="chain-info JSON file")
+        p.add_argument("--scheme", help="scheme id (e.g. pedersen-bls-chained)")
+        p.add_argument("--pubkey", help="collective public key, hex")
+        p.add_argument("--genesis-seed", help="genesis seed, hex")
+        p.add_argument("--beacon-id", default="default")
+        p.add_argument("--mode", choices=["full", "linkage"], default="full")
+        p.add_argument("--upto", type=int, default=None)
+        p.add_argument("--chunk", type=int, default=512)
+        p.add_argument("--host", action="store_true",
+                       help="CPU pairings instead of the device batch path")
+        if name == "scan":
+            p.add_argument("--json", action="store_true")
+        if name == "repair":
+            p.add_argument("--from-db", help="healthy chain.db to copy from")
+            p.add_argument("--peers", help="comma-separated node addresses")
+            p.add_argument("--tls", action="store_true")
+    args = ap.parse_args()
+    try:
+        return args.fn(args)
+    except SystemExit:
+        raise
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
