@@ -1,0 +1,27 @@
+"""Checker registry.  Each checker is a class with `name` (the
+suppression token), `description`, and `check(module) -> findings`."""
+
+from .clock import ClockChecker
+from .locks import LockChecker
+from .secrets import SecretChecker
+from .trace import TraceChecker
+from .store import StoreChecker
+
+ALL_CHECKERS = (ClockChecker, LockChecker, SecretChecker, TraceChecker,
+                StoreChecker)
+
+
+def checker_names():
+    return [c.name for c in ALL_CHECKERS]
+
+
+def by_names(names):
+    """Instantiate a subset by suppression token; raises on unknown."""
+    table = {c.name: c for c in ALL_CHECKERS}
+    out = []
+    for n in names:
+        if n not in table:
+            raise KeyError(f"unknown checker {n!r}; "
+                           f"have {', '.join(sorted(table))}")
+        out.append(table[n]())
+    return out

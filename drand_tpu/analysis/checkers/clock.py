@@ -1,0 +1,54 @@
+"""clock-discipline: all time flows through the injected Clock.
+
+The engine's determinism story (tests/chaos.py, FakeClock) only holds if
+nothing reads the wall clock behind the Clock abstraction's back — one
+stray `time.time()` and a chaos scenario that replays byte-identically on
+a fake clock diverges in production.  The reference makes the same
+promise structurally (clockwork in core/util_test.go); here the checker
+enforces it.
+
+Banned: `time.time`, `time.monotonic`, `time.sleep` (and their `_ns`
+variants), resolved through import aliases (`import time as _t`;
+`from time import sleep`).  `time.perf_counter` stays allowed: latency
+*measurement* (metrics observers) is not schedule logic and must not be
+steered by a fake clock.  Allowlist: the Clock implementations
+themselves (beacon/clock.py) and log.py (timestamps on log records are
+wall-clock by definition).
+"""
+
+import ast
+from typing import Iterator
+
+from ..core import Finding
+from ..symbols import ModuleInfo, dotted
+
+BANNED = {
+    "time.time", "time.time_ns",
+    "time.monotonic", "time.monotonic_ns",
+    "time.sleep",
+}
+
+# rel-path suffixes exempt from the discipline
+ALLOWED_FILES = ("beacon/clock.py", "log.py")
+
+
+class ClockChecker:
+    name = "clock"
+    description = ("direct time.time()/monotonic()/sleep() outside the "
+                   "injected-Clock implementations")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if any(module.rel == a or module.rel.endswith("/" + a)
+               for a in ALLOWED_FILES):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = module.resolve(dotted(node.func) or "")
+            if qual in BANNED:
+                yield Finding(
+                    checker=self.name, code="clock-direct-call",
+                    message=(f"direct call to {qual}(); route through the "
+                             "injected Clock (beacon/clock.py) so chaos "
+                             "tests stay deterministic"),
+                    path=module.rel, line=node.lineno, col=node.col_offset)

@@ -1,0 +1,278 @@
+"""lock-discipline: the poor-Python's `-race` for classes that own locks.
+
+Three rules, all derived from the class's own usage (no annotations):
+
+  1. **unguarded write** — an attribute that is assigned (or mutated via
+     list/dict/set methods) inside `with self.<lock>` in one method is
+     lock-guarded state; any OTHER method writing it without the lock is
+     a data race.  `__init__` is exempt (construction happens-before
+     publication).  Helpers whose contract is "caller holds the lock"
+     carry an inline `# tpu-vet: disable=lock` with the reason.
+
+  2. **blocking call under lock** — while holding `with self.<lock>`:
+     `time.sleep`, `<clock>.wait_until`, `Thread.join`, `serve_forever`,
+     `Event.wait` (does NOT release the lock — unlike `Condition.wait`),
+     and blocking `Queue.get/put` (the `_nowait` variants and
+     `block=False` are fine).  A lock held across a blocking call stalls
+     every thread behind it — the exact failure mode the reference
+     avoids by keeping Go's mutexes around pure state transitions.
+
+  3. **lock-order cycle** — a directed graph over (class, lock) nodes:
+     edge A→B when B is acquired while A is held, either by nested
+     `with` or through a same-class method call (closure over the
+     class's own call graph).  Any cycle is a deadlock candidate;
+     re-acquiring a non-reentrant Lock/Condition (a self-edge) is
+     reported the same way.
+"""
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Finding
+from ..symbols import (LOCK_KINDS, NON_REENTRANT, ClassInfo, ModuleInfo,
+                       dotted)
+
+MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
+            "update", "setdefault", "add", "discard", "popleft",
+            "appendleft", "popitem"}
+
+BLOCKING_NAMES = {"wait_until", "serve_forever"}
+
+CONSTRUCTION = ("__init__", "__new__", "__del__", "__enter__", "__exit__")
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    d = dotted(node)
+    if d and d.startswith("self.") and d.count(".") == 1:
+        return d.split(".", 1)[1]
+    return None
+
+
+class LockChecker:
+    name = "lock"
+    description = ("unguarded writes to lock-guarded attributes, blocking "
+                   "calls under a lock, lock-order cycles")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        edges: Dict[Tuple[str, str], List[Tuple[Tuple[str, str], ast.AST]]] = {}
+        for cls in module.classes:
+            locks = cls.lock_attrs()
+            if not locks:
+                continue
+            yield from self._unguarded_writes(module, cls, locks)
+            yield from self._blocking_under_lock(module, cls, locks)
+            self._order_edges(module, cls, locks, edges)
+        yield from self._cycles(module, edges)
+
+    # -- rule 1: unguarded writes -------------------------------------------
+
+    def _writes(self, cls: ClassInfo, fn: ast.AST):
+        """(attr, node) for every mutation of a self attribute in `fn`:
+        assignment, augmented assignment, del, subscript store, or a
+        mutating method call (append/update/...)."""
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        yield attr, node
+                    elif isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                        if attr:
+                            yield attr, node
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        yield attr, node
+                    elif isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                        if attr:
+                            yield attr, node
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in MUTATORS:
+                attr = _self_attr(node.func.value)
+                if attr:
+                    yield attr, node
+
+    def _held_locks(self, module: ModuleInfo, node: ast.AST,
+                    locks: List[str]) -> Set[str]:
+        held = set()
+        for d in module.withs_holding(node):
+            attr = d.split(".", 1)[1] if d.startswith("self.") else None
+            if attr in locks:
+                held.add(attr)
+        return held
+
+    def _unguarded_writes(self, module: ModuleInfo, cls: ClassInfo,
+                          locks: List[str]) -> Iterator[Finding]:
+        guarded: Set[str] = set()
+        for name, fn in cls.methods.items():
+            for attr, node in self._writes(cls, fn):
+                if attr in cls.attr_kinds and \
+                        cls.attr_kinds[attr] in LOCK_KINDS:
+                    continue            # the lock object itself
+                if self._held_locks(module, node, locks):
+                    guarded.add(attr)
+        if not guarded:
+            return
+        for name, fn in cls.methods.items():
+            if name in CONSTRUCTION:
+                continue
+            for attr, node in self._writes(cls, fn):
+                if attr in guarded \
+                        and not self._held_locks(module, node, locks):
+                    yield Finding(
+                        checker=self.name, code="lock-unguarded-write",
+                        message=(f"{cls.name}.{name} mutates self.{attr} "
+                                 "without holding the lock that guards it "
+                                 "elsewhere in the class"),
+                        path=module.rel, line=node.lineno,
+                        col=node.col_offset)
+
+    # -- rule 2: blocking calls under a lock --------------------------------
+
+    def _blocking_reason(self, module: ModuleInfo, cls: ClassInfo,
+                         node: ast.Call) -> Optional[str]:
+        qual = module.resolve(dotted(node.func) or "")
+        if qual == "time.sleep":
+            return "time.sleep"
+        if not isinstance(node.func, ast.Attribute):
+            return None
+        meth = node.func.attr
+        if meth in BLOCKING_NAMES:
+            return f".{meth}()"
+        recv = _self_attr(node.func.value)
+        kind = cls.attr_kinds.get(recv) if recv else None
+        if meth == "join" and kind == "thread":
+            return f"Thread.join on self.{recv}"
+        if meth == "wait" and kind == "event":
+            # Event.wait keeps the lock held; Condition.wait releases it
+            return f"Event.wait on self.{recv}"
+        if meth in ("get", "put") and kind == "queue":
+            for kw in node.keywords:
+                if kw.arg == "block" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and kw.value.value is False:
+                    return None
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and node.args[0].value is False:
+                return None
+            return f"blocking Queue.{meth} on self.{recv}"
+        return None
+
+    def _blocking_under_lock(self, module: ModuleInfo, cls: ClassInfo,
+                             locks: List[str]) -> Iterator[Finding]:
+        for name, fn in cls.methods.items():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                held = self._held_locks(module, node, locks)
+                if not held:
+                    continue
+                # waiting on the very condition you hold is the cv
+                # pattern, not a stall: Condition.wait releases it
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("wait", "wait_for"):
+                    recv = _self_attr(node.func.value)
+                    if recv in held and \
+                            cls.attr_kinds.get(recv) == "condition":
+                        continue
+                reason = self._blocking_reason(module, cls, node)
+                if reason:
+                    yield Finding(
+                        checker=self.name, code="lock-blocking-call",
+                        message=(f"{cls.name}.{name} makes a blocking call "
+                                 f"({reason}) while holding "
+                                 f"self.{sorted(held)[0]}"),
+                        path=module.rel, line=node.lineno,
+                        col=node.col_offset)
+
+    # -- rule 3: lock-order cycles ------------------------------------------
+
+    def _acquires(self, cls: ClassInfo, locks: List[str]
+                  ) -> Dict[str, Set[str]]:
+        """method -> locks it may acquire, closed over same-class calls."""
+        direct: Dict[str, Set[str]] = {}
+        calls: Dict[str, Set[str]] = {}
+        for name, fn in cls.methods.items():
+            acq, callees = set(), set()
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        attr = _self_attr(item.context_expr)
+                        if attr in locks:
+                            acq.add(attr)
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "self" \
+                        and node.func.attr in cls.methods:
+                    callees.add(node.func.attr)
+            direct[name] = acq
+            calls[name] = callees
+        closed = {m: set(s) for m, s in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for m, callees in calls.items():
+                for c in callees:
+                    extra = closed.get(c, set()) - closed[m]
+                    if extra:
+                        closed[m] |= extra
+                        changed = True
+        return closed
+
+    def _order_edges(self, module: ModuleInfo, cls: ClassInfo,
+                     locks: List[str], edges) -> None:
+        closed = self._acquires(cls, locks)
+        for name, fn in cls.methods.items():
+            for node in ast.walk(fn):
+                acquired: Set[str] = set()
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        attr = _self_attr(item.context_expr)
+                        if attr in locks:
+                            acquired.add(attr)
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "self" \
+                        and node.func.attr in cls.methods:
+                    acquired |= closed.get(node.func.attr, set())
+                if not acquired:
+                    continue
+                held = self._held_locks(module, node, locks)
+                for h in held:
+                    for a in acquired:
+                        if a == h and \
+                                cls.attr_kinds.get(a) not in NON_REENTRANT:
+                            continue    # RLock re-entry is fine
+                        src, dst = (cls.name, h), (cls.name, a)
+                        edges.setdefault(src, []).append((dst, node))
+
+    def _cycles(self, module: ModuleInfo, edges) -> Iterator[Finding]:
+        seen_cycles = set()
+        for start in edges:
+            stack = [(start, [start])]
+            while stack:
+                cur, path = stack.pop()
+                for dst, node in edges.get(cur, ()):  # noqa: B007
+                    if dst == start:
+                        cyc = tuple(sorted(set(path)))
+                        if cyc in seen_cycles:
+                            continue
+                        seen_cycles.add(cyc)
+                        pretty = " -> ".join(
+                            f"{c}.{a}" for c, a in path + [start])
+                        yield Finding(
+                            checker=self.name, code="lock-order-cycle",
+                            message=("lock-order cycle (deadlock "
+                                     f"candidate): {pretty}"),
+                            path=module.rel, line=node.lineno,
+                            col=node.col_offset)
+                    elif dst not in path and len(path) < 6:
+                        stack.append((dst, path + [dst]))

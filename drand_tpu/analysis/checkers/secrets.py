@@ -1,0 +1,196 @@
+"""secret-hygiene: key material never reaches logs, exceptions, or repr.
+
+Security reviews of beacon-chain clients (arXiv:2109.11677) put
+key-material hygiene next to concurrency misuse as the dominant finding
+class; in this codebase the dangerous values are the DKG share
+(`key.Share` / `vault.get_share()`), the long-term private key
+(`pair.key`, `longterm`), and setup secrets.  A leak needs no exploit —
+one `log.debug("dkg state", share=self.share)` and the share sits in
+every log aggregator the operator ships to.
+
+Taint-lite, intra-function:
+
+  * sources — names/attributes whose terminal identifier is secret-ish
+    (`secret`, `sk`, `private_key`, `pri_key`, `secret_key`,
+    `longterm`, `share`/`_share`, `.private`), plus calls to
+    `get_share()` / `load_share()` / `sign_partial` inputs excluded.
+  * sanitizers — `hash_secret(...)`, `len()`, `type()`, `bool()`, `id()`
+    produce clean values (a *hash* of the setup secret is the designed
+    wire form).  Identifiers on the safe-list (`secret_proof`) are
+    already sanitized upstream.
+  * sinks — Logger-style calls (`.debug/.info/.warn/.warning/.error/
+    .exception/.critical/.rate_limited_info` on a `log`-ish receiver),
+    `print`, exception constructors inside `raise`, and return values of
+    `__repr__`/`__str__`/`__format__`.
+
+One assignment hop is tracked (`s = self._share` then `log.info(x=s)`);
+deeper interprocedural flow is out of scope — the point is catching the
+direct and one-hop cases that code review keeps missing.
+"""
+
+import ast
+import re
+from typing import Iterator, Optional, Set
+
+from ..core import Finding
+from ..symbols import ModuleInfo, dotted
+
+SECRET_IDS = re.compile(
+    r"^(secret|secrets|sk|pri_key|private|private_key|secret_key|"
+    r"longterm|share|_share|new_share|old_share|dist_share)$")
+
+SAFE_IDS = {"secret_proof", "share_index", "sharemap", "shares_total"}
+
+SANITIZERS = {"hash_secret", "len", "type", "bool", "id", "index_of"}
+
+LOG_METHODS = {"debug", "info", "warn", "warning", "error", "exception",
+               "critical", "rate_limited_info"}
+
+REPR_METHODS = {"__repr__", "__str__", "__format__"}
+
+
+def _terminal(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+class SecretChecker:
+    name = "secret"
+    description = ("secret/share/private-key values flowing into logging, "
+                   "exception messages, or __repr__")
+
+    # -- taint predicates ----------------------------------------------------
+
+    def _is_source(self, module: ModuleInfo, node: ast.AST,
+                   tainted: Set[str]) -> Optional[str]:
+        """Returns a human name for the secret expression, or None."""
+        if isinstance(node, ast.Call):
+            fname = dotted(node.func) or ""
+            if _terminal(fname) in SANITIZERS:
+                return None
+            if _terminal(fname) in ("get_share", "load_share"):
+                return f"{fname}()"
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                hit = self._is_source(module, arg, tainted)
+                if hit:
+                    return hit
+            return None
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    hit = self._is_source(module, v.value, tainted)
+                    if hit:
+                        return hit
+            return None
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            for e in node.elts:
+                hit = self._is_source(module, e, tainted)
+                if hit:
+                    return hit
+            return None
+        if isinstance(node, ast.Dict):
+            for v in node.values:
+                hit = self._is_source(module, v, tainted)
+                if hit:
+                    return hit
+            return None
+        if isinstance(node, ast.BinOp):
+            for side in (node.left, node.right):
+                hit = self._is_source(module, side, tainted)
+                if hit:
+                    return hit
+            return None
+        if isinstance(node, ast.Attribute):
+            d = dotted(node)
+            if d is None:
+                # chained off a call, e.g. vault.get_share().private
+                inner = node.value
+                if isinstance(inner, ast.Call):
+                    return self._is_source(module, inner, tainted)
+                return None
+            term = _terminal(d)
+            if term in SAFE_IDS:
+                return None
+            if SECRET_IDS.match(term):
+                return d
+            return None
+        if isinstance(node, ast.Name):
+            if node.id in SAFE_IDS:
+                return None
+            if node.id in tainted or SECRET_IDS.match(node.id):
+                return node.id
+            return None
+        return None
+
+    def _taint_pass(self, module: ModuleInfo, fn: ast.AST) -> Set[str]:
+        """One-hop flow: local names assigned from a source expression."""
+        tainted: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                if self._is_source(module, node.value, tainted):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            tainted.add(t.id)
+        return tainted
+
+    # -- sinks ---------------------------------------------------------------
+
+    def _log_call(self, module: ModuleInfo, node: ast.Call) -> bool:
+        if not isinstance(node.func, ast.Attribute):
+            return False
+        if node.func.attr not in LOG_METHODS:
+            return False
+        recv = dotted(node.func.value) or ""
+        return _terminal(recv) in ("log", "logger", "LOG", "DEFAULT") \
+            or recv.endswith(".log")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for cls, fn in module.functions():
+            tainted = self._taint_pass(module, fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    is_log = self._log_call(module, node)
+                    is_print = isinstance(node.func, ast.Name) \
+                        and node.func.id == "print"
+                    if not (is_log or is_print):
+                        continue
+                    for arg in list(node.args) \
+                            + [kw.value for kw in node.keywords]:
+                        hit = self._is_source(module, arg, tainted)
+                        if hit:
+                            sink = "log call" if is_log else "print()"
+                            yield Finding(
+                                checker=self.name, code="secret-in-log",
+                                message=(f"secret-bearing value `{hit}` "
+                                         f"reaches a {sink}"),
+                                path=module.rel, line=node.lineno,
+                                col=node.col_offset)
+                elif isinstance(node, ast.Raise) and node.exc is not None:
+                    exc = node.exc
+                    args = []
+                    if isinstance(exc, ast.Call):
+                        args = list(exc.args) \
+                            + [kw.value for kw in exc.keywords]
+                    for arg in args:
+                        hit = self._is_source(module, arg, tainted)
+                        if hit:
+                            yield Finding(
+                                checker=self.name,
+                                code="secret-in-exception",
+                                message=(f"secret-bearing value `{hit}` is "
+                                         "embedded in an exception message "
+                                         "(exceptions get logged and "
+                                         "serialized over RPC)"),
+                                path=module.rel, line=node.lineno,
+                                col=node.col_offset)
+            if getattr(fn, "name", "") in REPR_METHODS:
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Return) and node.value is not None:
+                        hit = self._is_source(module, node.value, tainted)
+                        if hit:
+                            yield Finding(
+                                checker=self.name, code="secret-in-repr",
+                                message=(f"secret-bearing value `{hit}` is "
+                                         f"part of {getattr(fn, 'name', '?')}"
+                                         " output"),
+                                path=module.rel, line=node.lineno,
+                                col=node.col_offset)

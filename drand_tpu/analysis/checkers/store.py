@@ -1,0 +1,197 @@
+"""store-contract: the chain/store.py durability contract, enforced.
+
+Three rules derived from the contract docstring (chain/store.py):
+
+  1. **conn-unlocked** — a sqlite connection opened with
+     `check_same_thread=False` is by declaration shared across threads;
+     every `.execute/.executemany/.commit/.rollback/.backup/.serialize/
+     .close` on it must happen inside `with <owner>.<lock>` for the
+     lock that lives next to the connection.  (sqlite3 serializes at the
+     C level only when compiled threadsafe AND one statement at a time —
+     interleaved `execute`/`commit` from two threads can commit half a
+     batch under another writer's transaction.)
+  2. **put-no-commit** — a `put`/`put_many`/`delete` method that runs
+     mutating SQL must also commit (or run inside `with <conn>`): the
+     contract promises a returned put has been committed through the
+     journal, and an implicitly-open transaction breaks crash-safety AND
+     `save_to` snapshots.
+  3. **missing-durability** — every direct `Store` subclass declares
+     where it sits on the volatile/crash-safe/server spectrum via the
+     `DURABILITY` class attribute (tests/test_chain.py pins the matrix
+     against it).
+"""
+
+import ast
+from typing import Iterator, List, Optional
+
+from ..core import Finding
+from ..symbols import ClassInfo, ModuleInfo, dotted
+
+CONN_METHODS = {"execute", "executemany", "executescript", "commit",
+                "rollback", "backup", "serialize", "close"}
+
+MUTATING_SQL = ("insert", "update", "delete", "replace", "create", "drop")
+
+PUT_PATH = ("put", "put_many", "delete")
+
+
+class StoreChecker:
+    name = "store"
+    description = ("sqlite connections used outside the store lock, "
+                   "put-path without a commit, Store backends missing "
+                   "DURABILITY")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for cls in module.classes:
+            yield from self._durability(module, cls)
+            conn_attrs = [a for a, k in cls.attr_kinds.items()
+                          if k == "sqlite_conn"]
+            if conn_attrs and self._cross_thread(module, cls, conn_attrs):
+                yield from self._conn_locking(module, cls, conn_attrs)
+                yield from self._put_commits(module, cls, conn_attrs)
+        yield from self._foreign_conn_access(module)
+
+    # -- rule 3: DURABILITY --------------------------------------------------
+
+    def _durability(self, module: ModuleInfo,
+                    cls: ClassInfo) -> Iterator[Finding]:
+        if "Store" not in cls.base_names:
+            return
+        resolved = [module.resolve(b) for b in cls.base_names]
+        if not any(r.endswith("store.Store") or r == "Store"
+                   for r in resolved):
+            return
+        for item in cls.node.body:
+            if isinstance(item, ast.Assign):
+                for t in item.targets:
+                    if isinstance(t, ast.Name) and t.id == "DURABILITY":
+                        return
+            elif isinstance(item, ast.AnnAssign) \
+                    and isinstance(item.target, ast.Name) \
+                    and item.target.id == "DURABILITY":
+                return
+            elif isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and item.name == "DURABILITY":
+                return      # delegating @property (decorator chain)
+        yield Finding(
+            checker=self.name, code="store-missing-durability",
+            message=(f"{cls.name} subclasses Store but does not declare "
+                     "DURABILITY (volatile | crash-safe | server; see the "
+                     "chain/store.py contract)"),
+            path=module.rel, line=cls.node.lineno, col=cls.node.col_offset)
+
+    # -- rule 1: connection always under the store lock ----------------------
+
+    def _cross_thread(self, module: ModuleInfo, cls: ClassInfo,
+                      conn_attrs: List[str]) -> bool:
+        """True when the connection is opened check_same_thread=False —
+        the declaration that it WILL be shared across threads."""
+        for fn in cls.methods.values():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and module.resolve(dotted(node.func) or "") \
+                        == "sqlite3.connect":
+                    for kw in node.keywords:
+                        if kw.arg == "check_same_thread" \
+                                and isinstance(kw.value, ast.Constant) \
+                                and kw.value.value is False:
+                            return True
+        return False
+
+    def _conn_calls(self, fn: ast.AST):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in CONN_METHODS:
+                owner = dotted(node.func.value)
+                if owner:
+                    yield owner, node
+
+    def _conn_locking(self, module: ModuleInfo, cls: ClassInfo,
+                      conn_attrs: List[str]) -> Iterator[Finding]:
+        locks = cls.lock_attrs()
+        for name, fn in cls.methods.items():
+            if name == "__init__":
+                continue        # pre-publication: no other thread yet
+            for owner, node in self._conn_calls(fn):
+                if not owner.startswith("self.") \
+                        or owner.split(".")[-1] not in conn_attrs:
+                    continue
+                held = module.withs_holding(node)
+                if any(h.startswith("self.")
+                       and h.split(".", 1)[1] in locks for h in held):
+                    continue
+                yield Finding(
+                    checker=self.name, code="store-conn-unlocked",
+                    message=(f"{cls.name}.{name} touches the cross-thread "
+                             f"sqlite connection ({owner}."
+                             f"{node.func.attr}) outside the store lock"),
+                    path=module.rel, line=node.lineno, col=node.col_offset)
+
+    # -- rule 1b: cursors reaching into another object's connection ----------
+
+    def _foreign_conn_access(self, module: ModuleInfo) -> Iterator[Finding]:
+        """`self._store._conn.execute(...)` from a cursor class must hold
+        `self._store.<lock>` — the lock that lives WITH the connection."""
+        for cls in module.classes:
+            if any(k == "sqlite_conn" for k in cls.attr_kinds.values()):
+                continue        # own-connection classes handled above
+            for name, fn in cls.methods.items():
+                for owner, node in self._conn_calls(fn):
+                    parts = owner.split(".")
+                    if len(parts) < 3 or parts[0] != "self" \
+                            or "conn" not in parts[-1]:
+                        continue
+                    prefix = ".".join(parts[:-1])   # e.g. self._store
+                    held = module.withs_holding(node)
+                    if any(h.startswith(prefix + ".")
+                           and "lock" in h.rsplit(".", 1)[-1].lower()
+                           for h in held):
+                        continue
+                    yield Finding(
+                        checker=self.name, code="store-conn-unlocked",
+                        message=(f"{cls.name}.{name} reaches into "
+                                 f"{prefix}'s sqlite connection without "
+                                 f"holding {prefix}'s lock"),
+                        path=module.rel, line=node.lineno,
+                        col=node.col_offset)
+
+    # -- rule 2: put path commits --------------------------------------------
+
+    def _put_commits(self, module: ModuleInfo, cls: ClassInfo,
+                     conn_attrs: List[str]) -> Iterator[Finding]:
+        for name, fn in cls.methods.items():
+            if name not in PUT_PATH:
+                continue
+            mutates = False
+            commits = False
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        d = dotted(item.context_expr)
+                        if d and d.split(".")[-1] in conn_attrs:
+                            commits = True   # `with conn:` == transaction
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Attribute):
+                    continue
+                owner = dotted(node.func.value) or ""
+                if owner.split(".")[-1] not in conn_attrs:
+                    continue
+                if node.func.attr in ("execute", "executemany",
+                                      "executescript"):
+                    sql = node.args[0] if node.args else None
+                    if isinstance(sql, ast.Constant) \
+                            and isinstance(sql.value, str) \
+                            and sql.value.strip().lower().startswith(
+                                MUTATING_SQL):
+                        mutates = True
+                elif node.func.attr == "commit":
+                    commits = True
+            if mutates and not commits:
+                yield Finding(
+                    checker=self.name, code="store-put-no-commit",
+                    message=(f"{cls.name}.{name} runs mutating SQL but "
+                             "never commits; the chain/store.py contract "
+                             "says a returned put is committed through "
+                             "the journal"),
+                    path=module.rel, line=fn.lineno, col=fn.col_offset)

@@ -1,0 +1,242 @@
+"""tpu-vet framework core: file discovery, suppressions, baseline, report.
+
+Checker-facing contract: a checker is an object with a ``name`` (the
+suppression token), a ``description``, and ``check(module) ->
+Iterable[Finding]`` where ``module`` is a `symbols.ModuleInfo`.  The
+framework owns everything around that — which files are scanned, which
+findings are suppressed or baselined, and how the result is rendered.
+
+Finding identity (the baseline key) is deliberately line-free:
+``path|checker|code|message``.  Messages therefore name symbols, not
+positions, so an unrelated edit above a grandfathered finding does not
+resurrect it.
+"""
+
+import fnmatch
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from .symbols import ModuleInfo
+
+# generated code is not ours to lint
+DEFAULT_EXCLUDES = ("*_pb2.py", "*_pb2_grpc.py")
+
+_SUPP_RE = re.compile(
+    r"#\s*tpu-vet:\s*(disable|disable-file)\s*=\s*([A-Za-z_][A-Za-z0-9_,\- ]*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    checker: str      # suppression token: clock | lock | secret | trace | store
+    code: str         # stable machine code, e.g. "clock-direct-call"
+    message: str      # human sentence; stable across unrelated edits
+    path: str         # posix path relative to the scanned root
+    line: int
+    col: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}|{self.checker}|{self.code}|{self.message}"
+
+    def to_dict(self) -> dict:
+        return {"checker": self.checker, "code": self.code,
+                "message": self.message, "path": self.path,
+                "line": self.line, "col": self.col}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.checker}/{self.code}] {self.message}")
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)   # actionable
+    suppressed: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    files: int = 0
+    errors: List[str] = field(default_factory=list)         # unparseable files
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.errors
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.checker] = out.get(f.checker, 0) + 1
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "clean": self.clean,
+            "files": self.files,
+            "findings": [f.to_dict() for f in
+                         sorted(self.findings,
+                                key=lambda f: (f.path, f.line, f.code))],
+            "counts": self.counts(),
+            "suppressed": len(self.suppressed),
+            "baselined": len(self.baselined),
+            "errors": self.errors,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    def render_text(self) -> str:
+        lines = [f.render() for f in sorted(
+            self.findings, key=lambda f: (f.path, f.line, f.code))]
+        lines.extend(f"error: {e}" for e in self.errors)
+        summary = (f"{len(self.findings)} finding(s) over {self.files} "
+                   f"file(s) ({len(self.suppressed)} suppressed, "
+                   f"{len(self.baselined)} baselined)")
+        if self.counts():
+            summary += "  [" + ", ".join(
+                f"{k}={v}" for k, v in sorted(self.counts().items())) + "]"
+        lines.append(summary)
+        return "\n".join(lines)
+
+
+# -- suppressions ------------------------------------------------------------
+
+
+class Suppressions:
+    """`# tpu-vet: disable=<checker>` on the flagged line or the line
+    above; `disable-file=<checker>` anywhere suppresses the whole file.
+    `all` matches every checker."""
+
+    def __init__(self, lines: Sequence[str]):
+        self.by_line: Dict[int, set] = {}
+        self.file_level: set = set()
+        for i, text in enumerate(lines, start=1):
+            m = _SUPP_RE.search(text)
+            if not m:
+                continue
+            names = {n.strip() for n in m.group(2).split(",") if n.strip()}
+            if m.group(1) == "disable-file":
+                self.file_level |= names
+            else:
+                self.by_line.setdefault(i, set()).update(names)
+
+    def covers(self, finding: Finding) -> bool:
+        if {"all", finding.checker} & self.file_level:
+            return True
+        for line in (finding.line, finding.line - 1):
+            names = self.by_line.get(line, ())
+            if "all" in names or finding.checker in names:
+                return True
+        return False
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "findings" not in data:
+        raise ValueError(f"{path}: not a tpu-vet baseline file")
+    return dict(data["findings"])
+
+
+def write_baseline(path: str, report: Report) -> None:
+    """Grandfather the report's actionable findings (suppressed ones need
+    no baseline; already-baselined ones are carried forward)."""
+    counts: Dict[str, int] = {}
+    for f in list(report.findings) + list(report.baselined):
+        counts[f.key] = counts.get(f.key, 0) + 1
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "tool": "tpu-vet", "findings": counts},
+                  f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# -- discovery + run ---------------------------------------------------------
+
+
+def _package_rel(path: str) -> Optional[str]:
+    """rel relative to the file's topmost enclosing package directory
+    (the highest ancestor holding an `__init__.py`), so
+    `vet.py drand_tpu/beacon/clock.py` and `vet.py drand_tpu/beacon/`
+    yield the same rel (`beacon/clock.py`) as the canonical scan of
+    `drand_tpu/` — checker path scopes, allowlists, and baseline keys
+    match however the target is named.  None for a file outside any
+    package (fixture corpora, tmp files): those keep the caller's
+    argument-relative rel."""
+    top = None
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        top = d
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    if top is None:
+        return None
+    return os.path.relpath(path, top).replace(os.sep, "/")
+
+
+def _iter_files(path: str, excludes: Sequence[str]):
+    """Yield (abspath, rel) under `path`; rel is package-anchored when
+    the file lives in a package (see `_package_rel`), else relative to
+    the argument itself — so checker path scopes ("beacon/clock.py")
+    match no matter where the tree sits on disk or which subtree the
+    command line names."""
+    path = os.path.abspath(path)
+    if os.path.isfile(path):
+        # excludes apply here too: naming a generated _pb2.py directly
+        # must not lint what a directory scan deliberately skips
+        if not any(fnmatch.fnmatch(os.path.basename(path), pat)
+                   for pat in excludes):
+            yield path, _package_rel(path) or os.path.basename(path)
+        return
+    for base, dirs, names in os.walk(path):
+        dirs[:] = sorted(d for d in dirs
+                         if d != "__pycache__" and not d.startswith("."))
+        for name in sorted(names):
+            if not name.endswith(".py"):
+                continue
+            if any(fnmatch.fnmatch(name, pat) for pat in excludes):
+                continue
+            full = os.path.join(base, name)
+            yield full, _package_rel(full) or os.path.relpath(full, path)
+
+
+def run_vet(paths: Sequence[str], checkers: Optional[Iterable] = None,
+            baseline: Optional[Dict[str, int]] = None,
+            excludes: Sequence[str] = DEFAULT_EXCLUDES) -> Report:
+    """Run `checkers` (default: all five) over every .py file under
+    `paths` and split raw findings into actionable / suppressed /
+    baselined."""
+    if checkers is None:
+        from .checkers import ALL_CHECKERS
+        checkers = [c() for c in ALL_CHECKERS]
+    report = Report()
+    budget = dict(baseline or {})
+    for root in paths:
+        for full, rel in _iter_files(root, excludes):
+            report.files += 1
+            try:
+                with open(full, "r", encoding="utf-8") as f:
+                    source = f.read()
+                module = ModuleInfo(full, rel, source)
+            except (SyntaxError, UnicodeDecodeError, OSError) as e:
+                report.errors.append(f"{rel}: {e}")
+                continue
+            supp = Suppressions(module.lines)
+            seen = set()        # nested defs are walked by both their own
+            for checker in checkers:    # pass and the enclosing one
+                for finding in checker.check(module):
+                    if finding in seen:
+                        continue
+                    seen.add(finding)
+                    if supp.covers(finding):
+                        report.suppressed.append(finding)
+                    elif budget.get(finding.key, 0) > 0:
+                        budget[finding.key] -= 1
+                        report.baselined.append(finding)
+                    else:
+                        report.findings.append(finding)
+    return report

@@ -123,9 +123,14 @@ class ChainStore:
         charges at most 2x the requested wait, i.e. the deadline counts
         (mostly-)scheduled time.  A hard wall cap of 20x the timeout still
         bounds genuine deadlocks."""
+        # The monotonic() reads below deliberately bypass the injected
+        # clock: this loop measures raw WALL time to detect OS
+        # descheduling (charged-vs-elapsed) — a FakeClock would defeat
+        # the starvation-awareness that is its whole point.
         import time as _t
         charged = 0.0
         wall_cap = (20 if scheduled_time else 1) * timeout
+        # tpu-vet: disable=clock
         wall_deadline = _t.monotonic() + wall_cap
         while True:
             try:
@@ -139,12 +144,15 @@ class ChainStore:
                         return None  # trimmed/skipped (e.g. memdb ring buffer)
             except ErrNoBeaconStored:
                 pass
+            # tpu-vet: disable=clock
             if charged >= timeout or _t.monotonic() >= wall_deadline:
                 return None
             step = min(timeout - charged, 0.1)
+            # tpu-vet: disable=clock
             t0 = _t.monotonic()
             with self._new_beacon:
                 self._new_beacon.wait(step)
+            # tpu-vet: disable=clock
             charged += min(_t.monotonic() - t0, 2 * step)
 
     # -- aggregation ---------------------------------------------------------

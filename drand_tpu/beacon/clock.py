@@ -11,6 +11,14 @@ class Clock(ABC):
     @abstractmethod
     def now(self) -> float: ...
 
+    def monotonic(self) -> float:
+        """Elapsed-time source for measuring DURATIONS (queue age,
+        timeouts) as opposed to reading the schedule.  Defaults to now()
+        — fake clocks only move forward, so their one timeline serves
+        both — but RealClock overrides it with time.monotonic() so an
+        NTP step or VM suspend/resume can't corrupt a duration."""
+        return self.now()
+
     @abstractmethod
     def wait_until(self, deadline: float, stop: threading.Event) -> bool:
         """Block until now() >= deadline or `stop` is set.  Returns True if
@@ -20,6 +28,9 @@ class Clock(ABC):
 class RealClock(Clock):
     def now(self) -> float:
         return time.time()
+
+    def monotonic(self) -> float:
+        return time.monotonic()
 
     def wait_until(self, deadline: float, stop: threading.Event) -> bool:
         while not stop.is_set():

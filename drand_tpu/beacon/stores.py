@@ -25,6 +25,12 @@ class _Decorator(Store):
     def __init__(self, inner: Store):
         self.inner = inner
 
+    @property
+    def DURABILITY(self):  # noqa: N802 — contract attribute (chain/store.py)
+        """Decorators add semantics, not persistence: durability is
+        whatever the wrapped backend provides."""
+        return self.inner.DURABILITY
+
     def __len__(self):
         return len(self.inner)
 

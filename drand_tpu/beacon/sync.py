@@ -25,7 +25,7 @@ import threading
 from typing import Callable, Iterable, Iterator, List, Optional, Sequence
 
 from ..chain.beacon import Beacon
-from ..chain.errors import ErrNoBeaconSaved, ErrNoBeaconStored
+from ..chain.errors import ErrNoBeaconStored
 from ..net.resilience import (DEFAULT_SYNC_BUDGET, BreakerOpen, Deadline,
                               ResiliencePolicy, peer_key)
 from .stores import ErrBeaconAlreadyStored
@@ -309,46 +309,41 @@ class SyncManager:
     def check_past_beacons(self, upto: int,
                            progress: Optional[Callable[[int, int], None]] = None
                            ) -> List[int]:
-        """Validate rounds 1..upto of our own store in device chunks.
+        """Validate rounds 1..upto of our own store in device chunks;
+        returns the faulty round numbers (missing, failing signature
+        verification, or breaking the chained linkage).
 
-        Returns the faulty round numbers: missing from the store, failing
-        signature verification, or breaking the chained linkage."""
-        faulty: List[int] = []
-        store = self.chain.store
-        buf: List[Beacon] = []
-        prev: Optional[Beacon] = None       # linkage carried across chunks
-        for r in range(1, upto + 1):
-            try:
-                b = store.get(r)
-            except ErrNoBeaconSaved:
-                faulty.append(r)
-                continue
-            buf.append(b)
-            if len(buf) >= self.chunk:
-                faulty.extend(self._check_chunk(buf, prev))
-                prev = buf[-1]
-                if progress:
-                    progress(r, upto)
-                buf = []
-        if buf:
-            faulty.extend(self._check_chunk(buf, prev))
-            if progress:
-                progress(upto, upto)
-        return sorted(set(faulty))
+        Facade over `chain.integrity.IntegrityScanner` (ROADMAP storage
+        follow-up): the pre-scanner implementation verified against the
+        STORE-RETURNED `previous_sig`, which a raw trimmed store (the
+        daemon default, `require_previous=False`) materializes as None —
+        so a chained-scheme check flagged every round.  The scanner
+        carries the linkage anchor itself (the previous row's stored
+        signature, seeded from a stored genesis row or the configured
+        genesis seed), so trimmed and full-beacon stores validate alike.
+        Prefer `ChainStore.integrity_scan` for new callers — it returns
+        the full ScanReport that `heal` consumes."""
+        from ..chain.integrity import MODE_FULL
+        report = self._scanner().scan(mode=MODE_FULL, upto=upto,
+                                      progress=progress)
+        return report.faulty_rounds
 
-    def _check_chunk(self, chunk: List[Beacon],
-                     prev: Optional[Beacon]) -> List[int]:
-        ok = self.verifier.verify_batch(
-            [b.round for b in chunk],
-            [b.signature for b in chunk],
-            [b.previous_sig for b in chunk])
-        bad = [b.round for b, good in zip(chunk, ok) if not good]
-        if self.scheme.chained:
-            pairs = zip(([prev] if prev else []) + chunk, chunk if prev else chunk[1:])
-            for a, b in pairs:
-                if b.round == a.round + 1 and b.previous_sig != a.signature:
-                    bad.append(b.round)
-        return bad
+    def _scanner(self):
+        from ..chain.integrity import IntegrityScanner
+        # scan the RAW backend when the chain exposes one — corruption
+        # hides underneath the decorators (same choice as
+        # ChainStore.integrity_scan) — and recover the genesis anchor
+        # from whichever facade we were handed: FollowFacade carries
+        # genesis_seed directly, ChainStore derives it from the group.
+        store = getattr(self.chain, "backend", None) or self.chain.store
+        seed = getattr(self.chain, "genesis_seed", None)
+        if seed is None:
+            group = getattr(self.chain, "group", None)
+            if group is not None:
+                seed = group.get_genesis_seed()
+        return IntegrityScanner(
+            store, self.scheme, verifier=self.verifier,
+            genesis_seed=seed, chunk=self.chunk)
 
     def correct_past_beacons(self, raw_store, faulty: Sequence[int],
                              peers: Optional[Sequence[object]] = None) -> List[int]:

@@ -97,13 +97,20 @@ class _Connection:
         return _Cursor(self)
 
     def commit(self):
-        self._db.commit()
+        # same lock as _Cursor.execute: a commit racing another thread's
+        # half-finished executemany would otherwise sweep that thread's
+        # rows into this transaction (psycopg2 connections promise
+        # statement-level serialization; the shim must too)
+        with self._lock:
+            self._db.commit()
 
     def rollback(self):
-        self._db.rollback()
+        with self._lock:
+            self._db.rollback()
 
     def close(self):
-        self._db.close()
+        with self._lock:
+            self._db.close()
 
     def __enter__(self):
         return self

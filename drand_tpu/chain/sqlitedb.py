@@ -81,6 +81,9 @@ class SqliteStore(Store):
     def _fill_previous(self, round_: int, signature: bytes) -> Beacon:
         prev = None
         if self.require_previous and round_ > 0:
+            # caller holds self._lock: get/last and the cursor all enter
+            # with it held; this helper is never called bare
+            # tpu-vet: disable=store
             row = self._conn.execute(
                 "SELECT signature FROM beacons WHERE round = ?",
                 (round_ - 1,)).fetchone()

@@ -28,7 +28,14 @@ suite enforces them):
     half-written rows.
   * `put_many` writes the batch in ONE transaction where the engine has
     transactions: after a crash either none or a prefix-in-commit-order
-    of the batch is visible, never an interleaving.
+    of the batch is visible, never an interleaving.  Caveat (memdb): the
+    ring buffer has no transactions, so its `put_many` is per-put atomic
+    only — a CONCURRENT READER can observe a partially-applied batch
+    (crash atomicity is moot: the store is volatile).  Irrelevant for
+    the append path (the ring ingests one head at a time) but a repair
+    writer + an iterating reader on memdb can see a half-healed chain;
+    re-scan after repair, as `heal` does, rather than assuming batch
+    visibility.
   * Trimmed-format engines (sqlite, postgres) reconstruct `previous_sig`
     from round-1 when `require_previous=True`; if that prior row is
     absent they raise `ErrMissingPrevious` instead of fabricating a

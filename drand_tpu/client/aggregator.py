@@ -10,9 +10,9 @@ the round schedule.
 import queue
 import random
 import threading
-import time
 from typing import Iterator, List, Optional
 
+from ..beacon.clock import Clock, RealClock
 from ..chain.info import Info
 from ..chain.timing import time_of_round
 from ..net.resilience import BackoffPolicy
@@ -99,8 +99,9 @@ class PollingWatcher(Client):
     """Wraps a get-only transport; watch polls once per round, aligned to
     the round schedule (client/poll.go:17-62)."""
 
-    def __init__(self, inner: Client):
+    def __init__(self, inner: Client, clock: Optional[Clock] = None):
         self.inner = inner
+        self.clock = clock or RealClock()
 
     def get(self, round_: int = 0) -> Result:
         return self.inner.get(round_)
@@ -121,10 +122,17 @@ class PollingWatcher(Client):
                     yield result
             except Exception:
                 pass
-            # sleep to just after the next round boundary
+            # sleep to just after the next round boundary ON the injected
+            # clock — wait_until, not stop.wait(delay), so a FakeClock
+            # test steps the schedule without real sleeps.  The floor of
+            # now()+0.1 keeps a lagging watcher from busy-polling when
+            # the boundary is already behind us; the one-period cap keeps
+            # a bogus future round from the server (inflated `last`) from
+            # parking the watcher past the next boundary it must re-check.
             nxt = time_of_round(info.period, info.genesis_time, last + 1)
-            delay = max(nxt - time.time(), 0.0) + 0.1
-            if stop.wait(min(delay, info.period)):
+            now = self.clock.now()
+            deadline = min(max(nxt, now) + 0.1, now + info.period)
+            if not self.clock.wait_until(deadline, stop):
                 return
 
     def close(self) -> None:

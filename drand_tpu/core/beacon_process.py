@@ -234,6 +234,22 @@ class BeaconProcess:
         self.log.info("beacon started", catchup=catchup,
                       genesis=self.group.genesis_time)
 
+    def _expected_head_round(self) -> int:
+        """The round the chain SHOULD be at per the clock (ROADMAP
+        head-truncation follow-up): a deleted tail is invisible to a scan
+        that asks the store its own length, so the startup pass derives
+        the expected head from `current_round(now, period, genesis)` and
+        compares it to the stored head — a missing suffix is flagged and
+        handed to catch-up sync instead of passing silently as clean.
+        Before genesis nothing is expected (a fresh network's empty
+        store is genuinely clean)."""
+        from ..chain.timing import current_round
+        now = int(self.clock.now())
+        if self.group is None or now < self.group.genesis_time:
+            return 0
+        return current_round(now, self.group.period,
+                             self.group.genesis_time)
+
     def _startup_integrity_pass(self) -> None:
         """Scan the store we just reopened before serving from it
         (cfg.startup_integrity: linkage | full).  The scan is synchronous
@@ -244,8 +260,29 @@ class BeaconProcess:
         mode = self.cfg.startup_integrity
         verifier = self.syncm.verifier if mode == "full" else None
         try:
+            stored_head = self.handler.chain.last().round
+        except ErrNoBeaconStored:
+            stored_head = 0
+        # Head-truncation probe (ROADMAP follow-up): the store cannot
+        # name rounds it has lost off its tail, so compare its head to
+        # the CLOCK-derived expected round.  The missing suffix — be it
+        # truncation or ordinary downtime, indistinguishable here — is
+        # flagged for catch-up sync (ONE collapsing stream), never fed
+        # to heal's per-round re-fetch: a week offline on a 30 s chain
+        # is ~20k rounds of routine catch-up, not corruption.  The -1
+        # grace mirrors /health: the round being produced right now is
+        # not yet "missing".
+        expected = self._expected_head_round()
+        behind = expected - 1 - stored_head
+        if behind > 0:
+            self.log.warn("chain head behind clock; flagging for "
+                          "catch-up sync", head=stored_head,
+                          expected=expected, behind=behind)
+            self._on_sync_needed(expected)
+        try:
             report = self.handler.chain.integrity_scan(
-                verifier=verifier, mode=mode, beacon_id=self.beacon_id)
+                verifier=verifier, mode=mode, upto=stored_head or None,
+                beacon_id=self.beacon_id)
         except Exception as e:
             self.log.error("startup integrity scan failed", err=str(e))
             return
@@ -253,10 +290,14 @@ class BeaconProcess:
             self.log.info("startup integrity scan clean",
                           mode=mode, scanned=report.scanned)
             return
+        faulty = report.faulty_rounds
+        shown = ",".join(str(r) for r in faulty[:20])
+        if len(faulty) > 20:
+            shown += f",+{len(faulty) - 20} more"
         self.log.warn("startup integrity scan found corruption; "
                       "quarantining and re-fetching from peers",
                       mode=mode, findings=len(report.findings),
-                      rounds=",".join(str(r) for r in report.faulty_rounds))
+                      rounds=shown)
         # quarantine SYNCHRONOUSLY — the docstring's guarantee is that a
         # known-corrupt round is never served, so the deletes cannot wait
         # for the repair thread (a peer could sync the bad row in that

@@ -458,12 +458,11 @@ class ControlService:
         result = {}
 
         def run():
-            # The integrity scanner, not SyncManager.check_past_beacons:
-            # the daemon's raw store does not materialize previous_sig
-            # (require_previous=False), so check_past_beacons would flag
-            # EVERY round of a chained scheme; the scanner carries the
-            # linkage anchor itself and its report lets `heal` quarantine
-            # only rows that are provably bad on disk.
+            # integrity_scan returns the full ScanReport that `heal`
+            # consumes (check_past_beacons is itself a scanner facade now,
+            # but only surfaces the faulty-round list); the scanner
+            # carries the linkage anchor itself, so the daemon's raw
+            # trimmed store (require_previous=False) validates correctly.
             try:
                 result["report"] = bp.handler.chain.integrity_scan(
                     verifier=bp.syncm.verifier, mode="full", upto=upto,

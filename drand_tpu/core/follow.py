@@ -33,6 +33,9 @@ class FollowFacade:
         self._append = AppendStore(sch)
         self.cbstore = CallbackStore(self._append)
         self._backend = backend
+        # the chain identity anchor: SyncManager.check_past_beacons hands
+        # it to the integrity scanner for trimmed stores with no round-0 row
+        self.genesis_seed = genesis_seed
 
     @property
     def store(self):

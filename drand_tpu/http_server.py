@@ -18,6 +18,7 @@ from email.utils import formatdate
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from .beacon.clock import Clock, RealClock
 from .chain.beacon import Beacon
 from .chain.errors import ErrNoBeaconSaved, ErrNoBeaconStored
 from .chain.timing import time_of_round
@@ -103,9 +104,15 @@ class RestServer:
     """The daemon's public REST face.  `daemon` may host many chains; every
     chain is addressable by hash, the default one also without it."""
 
-    def __init__(self, daemon, listen: str = "127.0.0.1:0"):
+    def __init__(self, daemon, listen: str = "127.0.0.1:0",
+                 clock: Optional[Clock] = None):
         self.daemon = daemon
         self.log = daemon.log.named("http")
+        # the daemon's injected clock when it has one (health math must
+        # agree with the engine's idea of "now"), else the wall clock
+        self.clock = clock \
+            or getattr(getattr(daemon, "cfg", None), "clock", None) \
+            or RealClock()
         host, _, port = listen.rpartition(":")
         self._handlers: Dict[str, _BeaconHandler] = {}
         self._hlock = threading.Lock()
@@ -197,7 +204,7 @@ class RestServer:
             except (ErrNoBeaconStored, ErrNoBeaconSaved):
                 head = 0
             from .chain.timing import current_round
-            expected = current_round(int(time.time()), info.period,
+            expected = current_round(int(self.clock.now()), info.period,
                                      info.genesis_time)
             if head >= expected - 1:
                 status = 200

@@ -25,8 +25,17 @@ schedules run instantly and deterministically.
 import os
 import random
 import threading
-import time
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+
+def _default_clock():
+    """Deferred import: the net layer must stay importable without
+    loading the beacon package (beacon.sync already imports this module;
+    an import-time edge back would be one new beacon-side import away
+    from a hard cycle)."""
+    from ..beacon.clock import RealClock
+    return RealClock()
+
 
 # -- knobs (env-overridable; COMPONENTS.md "Resilience") ---------------------
 
@@ -48,22 +57,6 @@ class DeadlineExceeded(Exception):
 
 class BreakerOpen(Exception):
     """The peer's circuit breaker is open (cooldown not yet elapsed)."""
-
-
-class _SystemClock:
-    """Minimal stand-in for beacon.clock.RealClock (kept local so the net
-    layer does not import the beacon package)."""
-
-    def now(self) -> float:
-        return time.time()
-
-    def wait_until(self, deadline: float, stop: threading.Event) -> bool:
-        while not stop.is_set():
-            delta = deadline - self.now()
-            if delta <= 0:
-                return True
-            stop.wait(min(delta, 0.5))
-        return False
 
 
 class Deadline:
@@ -129,7 +122,7 @@ class CircuitBreaker:
                  cooldown: float = DEFAULT_BREAKER_COOLDOWN,
                  scope: str = "default"):
         self.key = key
-        self.clock = clock or _SystemClock()
+        self.clock = clock or _default_clock()
         self.failure_threshold = max(1, failures)
         self.cooldown = cooldown
         self.scope = scope
@@ -247,7 +240,7 @@ class BreakerRegistry:
     def __init__(self, clock=None, failures: int = DEFAULT_BREAKER_FAILURES,
                  cooldown: float = DEFAULT_BREAKER_COOLDOWN,
                  scope: str = "default"):
-        self.clock = clock or _SystemClock()
+        self.clock = clock or _default_clock()
         self.failures = failures
         self.cooldown = cooldown
         self.scope = scope
@@ -311,7 +304,7 @@ class ResiliencePolicy:
                  max_attempts: int = DEFAULT_MAX_ATTEMPTS,
                  scope: str = "default", seed: Optional[int] = None,
                  stop: Optional[threading.Event] = None):
-        self.clock = clock or _SystemClock()
+        self.clock = clock or _default_clock()
         self.backoff = backoff or BackoffPolicy()
         self.breakers = breakers or BreakerRegistry(clock=self.clock,
                                                     scope=scope)

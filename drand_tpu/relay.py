@@ -19,9 +19,9 @@ Reference surface:
 import json
 import os
 import threading
-import time
 from typing import Iterator, Optional
 
+from .beacon.clock import Clock, RealClock
 from .chain.beacon import Beacon
 from .chain.errors import ErrNoBeaconStored
 from .client.interface import Client, Result
@@ -38,9 +38,11 @@ class ValidatingWatch:
     anything that fails full BLS verification — the relay never
     republishes junk."""
 
-    def __init__(self, client: Client, log: Logger):
+    def __init__(self, client: Client, log: Logger,
+                 clock: Optional[Clock] = None):
         self.client = client
         self.log = log
+        self.clock = clock or RealClock()
         self.info = client.info()
         self._seen_max = 0
 
@@ -48,7 +50,8 @@ class ValidatingWatch:
               ) -> Iterator[Result]:
         from .chain.timing import current_round
         for res in self.client.watch(stop):
-            now_round = current_round(int(time.time()), self.info.period,
+            now_round = current_round(int(self.clock.now()),
+                                      self.info.period,
                                       self.info.genesis_time)
             if res.round > now_round + 1:
                 self.log.warn("dropping future round", round=res.round)
@@ -73,13 +76,15 @@ class GrpcRelayNode:
 
     def __init__(self, client: Optional[Client], listen: str = "127.0.0.1:0",
                  log: Optional[Logger] = None, buffer: int = 256,
-                 info=None, extra_services=()):
+                 info=None, extra_services=(),
+                 clock: Optional[Clock] = None):
         from .net import Listener, services
 
         self.log = (log or Logger()).named("relay")
         self.client = client
+        self.clock = clock or RealClock()
         self.info = info if info is not None else client.info()
-        self.valid = (ValidatingWatch(client, self.log)
+        self.valid = (ValidatingWatch(client, self.log, clock=self.clock)
                       if client is not None else None)
         self._cache = {}                 # round -> Result (bounded)
         self._buffer = buffer
@@ -242,12 +247,14 @@ class GossipRelayNode(GrpcRelayNode):
 
     def __init__(self, listen: str = "127.0.0.1:0", peers=(),
                  client: Optional[Client] = None, info=None, fanout: int = 3,
-                 log: Optional[Logger] = None, buffer: int = 256):
+                 log: Optional[Logger] = None, buffer: int = 256,
+                 clock: Optional[Clock] = None):
         from .net import services
 
         self._gossip_impl = _GossipService(self)
         super().__init__(client, listen, log=log, buffer=buffer, info=info,
-                         extra_services=[(services.GOSSIP, self._gossip_impl)])
+                         extra_services=[(services.GOSSIP, self._gossip_impl)],
+                         clock=clock)
         from concurrent.futures import ThreadPoolExecutor
 
         self.peers = list(peers)
@@ -307,7 +314,7 @@ class GossipRelayNode(GrpcRelayNode):
         targets = [p for p in self.peers if p not in exclude]
         if len(targets) > self.fanout:
             targets = random.sample(targets, self.fanout)
-        enq = time.monotonic()
+        enq = self.clock.monotonic()
         for addr in targets:
             # bounded sender pool, not thread-per-send: slow peers (5 s
             # timeout each) must queue, not pile up hundreds of threads
@@ -317,13 +324,16 @@ class GossipRelayNode(GrpcRelayNode):
     # are dropped — the round is stale to the mesh by then, and dropping
     # keeps the queue draining.  Gated on QUEUE AGE, not round recency: a
     # catch-up burst delivers many rounds back-to-back and every one of
-    # them must still be forwarded when the pool is keeping up.
+    # them must still be forwarded when the pool is keeping up.  Age is
+    # a DURATION, so it is measured on the injected clock's monotonic
+    # source: deterministic under a FakeClock in mesh chaos tests, and
+    # immune to wall-clock jumps (NTP step, VM suspend) in production.
     SEND_MAX_QUEUE_AGE = 10.0
 
     def _send(self, addr: str, res: Result, enq: float = 0.0) -> None:
         from .protos import drand_pb2 as pb
 
-        if enq and time.monotonic() - enq > self.SEND_MAX_QUEUE_AGE:
+        if enq and self.clock.monotonic() - enq > self.SEND_MAX_QUEUE_AGE:
             return
         pkt = pb.GossipBeaconPacket(
             chain_hash=self._chain_hash, round=res.round,
@@ -444,13 +454,15 @@ class ObjectStoreRelay:
     plus a `latest` pointer (cmd/relay-s3/main.go:43-199)."""
 
     def __init__(self, client: Client, store: ObjectStore,
-                 log: Optional[Logger] = None):
+                 log: Optional[Logger] = None,
+                 clock: Optional[Clock] = None):
         self.client = client
         self.store = store
         self.log = (log or Logger()).named("s3-relay")
+        self.clock = clock or RealClock()
         self.info = client.info()
         self.prefix = self.info.hash().hex()
-        self.valid = ValidatingWatch(client, self.log)
+        self.valid = ValidatingWatch(client, self.log, clock=self.clock)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

@@ -161,16 +161,28 @@ class ChaosStore:
         self.plan = plan
         self._healed = set()
 
-    def get(self, round_: int) -> Beacon:
-        b = self.raw.get(round_)
-        if round_ in self._healed:
+    def _fault(self, b: Beacon):
+        """The per-round fault verdict, shared by get() and the cursor
+        (the integrity scanner reads through cursors — a bad sector must
+        fault on EVERY read path, not just point lookups).  Returns None
+        for a lost row, a forged beacon for a corrupt one."""
+        if b is None or b.round in self._healed:
             return b
-        dice = self.plan.dice(0, round_)
+        dice = self.plan.dice(0, b.round)
         if dice.random() < self.plan.drop:
-            raise ErrNoBeaconSaved(f"round {round_} lost")
+            return None
         if dice.random() < self.plan.corrupt:
             return corrupt_signature(b)
         return b
+
+    def get(self, round_: int) -> Beacon:
+        b = self._fault(self.raw.get(round_))
+        if b is None:
+            raise ErrNoBeaconSaved(f"round {round_} lost")
+        return b
+
+    def cursor(self):
+        return _ChaosCursor(self)
 
     def put(self, b: Beacon) -> None:
         self._healed.add(b.round)
@@ -187,6 +199,44 @@ class ChaosStore:
 
     def __getattr__(self, name):
         return getattr(self.raw, name)
+
+
+class _ChaosCursor:
+    """Cursor over a ChaosStore: lost rows are skipped (a hole, exactly
+    what a cursor over a store missing that row would produce), corrupt
+    rows come back forged."""
+
+    def __init__(self, store: ChaosStore):
+        self._store = store
+        self._cur = store.raw.cursor()
+
+    def _skip_dropped(self, b, advance):
+        while b is not None:
+            faulted = self._store._fault(b)
+            if faulted is not None:
+                return faulted
+            b = advance()
+        return None
+
+    def first(self):
+        return self._skip_dropped(self._cur.first(), self._cur.next)
+
+    def next(self):
+        return self._skip_dropped(self._cur.next(), self._cur.next)
+
+    def seek(self, round_: int):
+        return self._skip_dropped(self._cur.seek(round_), self._cur.next)
+
+    def last(self):
+        # no backwards walk in the Cursor API: a dropped head reads as a
+        # forged None-free pass-through (head detection stays raw)
+        return self._store._fault(self._cur.last()) or self._cur.last()
+
+    def __iter__(self):
+        b = self.first()
+        while b is not None:
+            yield b
+            b = self.next()
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,35 @@
+"""Seeded clock-discipline violations (tests/test_vet.py fixture)."""
+
+import time
+import time as _t
+from time import monotonic, sleep
+
+CLOCK_PERIOD = 30
+
+
+def direct_time():
+    return time.time()                  # VIOLATION: clock-direct-call
+
+
+def aliased_monotonic():
+    return _t.monotonic()               # VIOLATION: resolved through alias
+
+
+def from_import_sleep():
+    sleep(0.1)                          # VIOLATION: from-import
+    return monotonic()                  # VIOLATION: from-import
+
+
+def allowed_measurement():
+    # perf_counter is latency measurement, not schedule logic: NOT flagged
+    t0 = time.perf_counter()
+    return time.perf_counter() - t0
+
+
+def suppressed_same_line():
+    return time.time()  # tpu-vet: disable=clock
+
+
+def suppressed_line_above():
+    # tpu-vet: disable=clock
+    return time.sleep(0.0)

@@ -1,0 +1,111 @@
+"""Seeded lock-discipline violations (tests/test_vet.py fixture)."""
+
+import queue
+import threading
+
+
+class UnguardedWrite:
+    """self.count is guarded in incr() but mutated bare in reset()."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.items = []
+
+    def incr(self):
+        with self._lock:
+            self.count += 1
+            self.items.append(self.count)
+
+    def reset(self):
+        self.count = 0                  # VIOLATION: lock-unguarded-write
+        self.items.clear()              # VIOLATION: mutator without lock
+
+    def reset_locked(self):
+        with self._lock:
+            self.count = 0              # fine: lock held
+
+    def reset_suppressed(self):
+        # callers of this helper hold self._lock
+        # tpu-vet: disable=lock
+        self.count = 0
+
+
+class BlockingUnderLock:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._ev = threading.Event()
+        self._cond = threading.Condition()
+        self.state = 0
+
+    def drain(self):
+        with self._lock:
+            self.state = 1
+            return self._q.get(timeout=5)   # VIOLATION: blocking Queue.get
+
+    def pause(self):
+        with self._lock:
+            self.state = 2
+            self._ev.wait(1.0)          # VIOLATION: Event.wait keeps the lock
+
+    def fast_path(self):
+        with self._lock:
+            self.state = 3
+            return self._q.get_nowait()     # fine: non-blocking
+
+    def nonblocking(self):
+        with self._lock:
+            self.state = 4
+            return self._q.get(block=False)  # fine: block=False
+
+    def cv_wait(self):
+        with self._cond:
+            self._cond.wait(0.1)        # fine: Condition.wait releases it
+
+
+class OrderAB:
+    """Acquires a then b in one method, b then a in another: cycle."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.x = 0
+
+    def forward(self):
+        with self._a:
+            with self._b:               # VIOLATION edge a->b
+                self.x = 1
+
+    def backward(self):
+        with self._b:
+            with self._a:               # VIOLATION edge b->a: cycle
+                self.x = 2
+
+
+class SelfDeadlock:
+    def __init__(self):
+        self._lock = threading.Lock()   # NON-reentrant
+        self.n = 0
+
+    def outer(self):
+        with self._lock:
+            self.inner()                # VIOLATION: re-acquires self._lock
+
+    def inner(self):
+        with self._lock:
+            self.n += 1
+
+
+class ReentrantOk:
+    def __init__(self):
+        self._lock = threading.RLock()  # reentrant: NOT flagged
+        self.n = 0
+
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        with self._lock:
+            self.n += 1

@@ -1,0 +1,80 @@
+"""Seeded trace-safety violations (tests/test_vet.py fixture).
+
+The `jax` import here is a decoy name — the analyzer only parses, so no
+real JAX is needed (and none is imported by the vet run)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_trace_log = []
+
+
+def make_accumulator():
+    seen = []
+
+    @jax.jit
+    def accumulate(x):
+        seen.append(x)                  # VIOLATION: captured mutation
+        return jnp.sum(x)
+
+    return accumulate
+
+
+@jax.jit
+def branch_on_tracer(x):
+    if x > 0:                           # VIOLATION: python branch on tracer
+        return x
+    return -x
+
+
+@jax.jit
+def concretize(x):
+    n = int(x)                          # VIOLATION: int() on tracer
+    return x.item() + n                 # VIOLATION: .item() on tracer
+
+
+@jax.jit
+def loop_on_tracer(x, ys):
+    total = x
+    for y in ys:                        # VIOLATION: python loop over tracer
+        total = total + y
+    return total
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def static_is_fine(x, n):
+    # n is static_argnums: branching on it is fine
+    if n > 4:
+        return jnp.zeros((n,))
+    acc = x
+    for _ in range(n):                  # range(static) is fine
+        acc = acc * 2
+    return acc
+
+
+@jax.jit
+def shapes_are_static(x):
+    # shape/ndim/dtype/len derive static values: none of this is flagged
+    if x.ndim > 1:
+        return x.reshape(-1)
+    half = x.shape[0] // 2
+    if half > 0:
+        return x[:half]
+    return x
+
+
+def host_side(x):
+    # not jitted: python control flow is the point here
+    if x > 0:
+        return [int(x)]
+    return []
+
+
+@jax.jit
+def suppressed(x):
+    # tpu-vet: disable=trace
+    if x > 0:
+        return x
+    return -x

@@ -1,0 +1,37 @@
+"""Seeded secret-hygiene violations (tests/test_vet.py fixture)."""
+
+
+def hash_secret(value):
+    return b"sanitized"
+
+
+class Vaultish:
+    def __init__(self, share, log):
+        self._share = share
+        self.log = log
+
+    def leak_to_log(self):
+        self.log.info("dkg state", share=self._share)   # VIOLATION
+
+    def leak_one_hop(self):
+        s = self._share
+        self.log.debug("state", dump=s)                 # VIOLATION: taint hop
+
+    def leak_exception(self, secret):
+        raise ValueError(f"bad secret {secret}")        # VIOLATION
+
+    def __repr__(self):
+        return f"Vaultish({self._share})"               # VIOLATION
+
+    def safe_hash(self, secret):
+        proof = hash_secret(secret)                     # sanitizer: fine
+        self.log.info("joining", proof=proof)
+
+    def safe_literal(self):
+        # string literals mentioning secrets are not values: fine
+        self.log.warn("need --secret-file or DRAND_SHARE_SECRET")
+        raise SystemExit("wrong setup secret")
+
+    def suppressed(self):
+        # tpu-vet: disable=secret
+        self.log.debug("debug dump", share=self._share)

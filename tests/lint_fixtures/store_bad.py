@@ -1,0 +1,67 @@
+"""Seeded store-contract violations (tests/test_vet.py fixture)."""
+
+import sqlite3
+import threading
+
+
+class Store:
+    """Stand-in for chain.store.Store (the checker matches the base name
+    and its resolved import; fixtures stay import-free)."""
+
+    DURABILITY = "volatile"
+
+
+class NoDurabilityStore(Store):         # VIOLATION: missing DURABILITY
+    def put(self, beacon):
+        pass
+
+
+class DeclaredStore(Store):             # fine
+    DURABILITY = "crash-safe"
+
+
+class UnlockedConnStore(Store):
+    DURABILITY = "crash-safe"
+
+    def __init__(self, path):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+
+    def get(self, round_):
+        row = self._conn.execute(       # VIOLATION: store-conn-unlocked
+            "SELECT signature FROM beacons WHERE round = ?",
+            (round_,)).fetchone()
+        return row
+
+    def last(self):
+        with self._lock:
+            return self._conn.execute(  # fine: lock held
+                "SELECT round FROM beacons ORDER BY round DESC").fetchone()
+
+    def put(self, beacon):              # VIOLATION: store-put-no-commit
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO beacons VALUES (?, ?)",
+                (beacon.round, beacon.signature))
+
+    def delete(self, round_):
+        with self._lock:                # fine: mutates AND commits
+            self._conn.execute(
+                "DELETE FROM beacons WHERE round = ?", (round_,))
+            self._conn.commit()
+
+
+class ForeignConnCursor:
+    """Cursor reaching into the store's connection without its lock."""
+
+    def __init__(self, store):
+        self._store = store
+
+    def first(self):
+        return self._store._conn.execute(   # VIOLATION: foreign conn, no lock
+            "SELECT round FROM beacons ORDER BY round ASC").fetchone()
+
+    def last(self):
+        with self._store._lock:
+            return self._store._conn.execute(   # fine: owner's lock held
+                "SELECT round FROM beacons ORDER BY round DESC").fetchone()

@@ -336,14 +336,21 @@ def test_startup_integrity_pass_glue(chain):
     class FakeChain:
         backend = victim
 
+        def last(self):
+            return victim.last()
+
         def integrity_scan(self, verifier=None, mode="full", upto=None,
                            progress=None, beacon_id="default", chunk=512):
-            return scanner.scan(mode=mode, upto=N)
+            return scanner.scan(mode=mode, upto=upto or N)
 
     bp = SimpleNamespace(
         cfg=SimpleNamespace(startup_integrity="full"),
         syncm=syncm, handler=SimpleNamespace(chain=FakeChain()),
-        log=Logger(), beacon_id="startup-test", _peers=lambda: ["peer0"])
+        log=Logger(), beacon_id="startup-test", _peers=lambda: ["peer0"],
+        # clock-derived expected head (the head-truncation follow-up):
+        # the real method needs group timing; the stub pins it to N
+        _expected_head_round=lambda: N,
+        _on_sync_needed=lambda target: None)
     BeaconProcess._startup_integrity_pass(bp)
     deadline = time.monotonic() + 20
     while time.monotonic() < deadline:
@@ -351,6 +358,74 @@ def test_startup_integrity_pass_glue(chain):
             break
         time.sleep(0.05)
     assert scanner.scan(mode="full", upto=N).clean
+
+
+def test_startup_scan_catches_head_truncation(chain):
+    """ROADMAP follow-up: a deleted TAIL is invisible to a scan that asks
+    the store its own length.  The startup pass derives the expected head
+    from the clock (current_round), and a head behind it is flagged for
+    CATCH-UP SYNC (one collapsing stream — ordinary downtime produces the
+    same gap and must not be treated as corruption or fed to heal's
+    per-round re-fetch) — instead of passing silently as clean."""
+    from types import SimpleNamespace
+
+    from drand_tpu.beacon.clock import FakeClock
+    from drand_tpu.chain.timing import current_round, time_of_round
+    from drand_tpu.core.beacon_process import BeaconProcess
+    from drand_tpu.log import Logger
+
+    period, genesis = 30, 1_000
+    victim = _seeded_store(chain)
+    for r in range(N - 2, N + 1):
+        victim.delete(r)                 # the truncated tail
+
+    # the store's own head says N-3: a store-head scan reports CLEAN
+    assert _scanner(chain, victim).scan(mode="full").clean
+
+    # the clock says we should be at round N
+    now = time_of_round(period, genesis, N)
+    bp = SimpleNamespace(clock=FakeClock(now),
+                         group=SimpleNamespace(period=period,
+                                               genesis_time=genesis))
+    expected = BeaconProcess._expected_head_round(bp)
+    assert expected == current_round(now, period, genesis) == N
+
+    # the startup pass routes the missing suffix to catch-up sync
+    scanner = _scanner(chain, victim)
+    sync_requests = []
+
+    class FakeChain:
+        def last(self):
+            return victim.last()
+
+        def integrity_scan(self, verifier=None, mode="full", upto=None,
+                           progress=None, beacon_id="default", chunk=512):
+            return scanner.scan(mode=mode, upto=upto)
+
+    bp_pass = SimpleNamespace(
+        cfg=SimpleNamespace(startup_integrity="linkage"),
+        syncm=SimpleNamespace(verifier=None),
+        handler=SimpleNamespace(chain=FakeChain()),
+        log=Logger(), beacon_id="truncation-test",
+        _peers=lambda: [], clock=bp.clock, group=bp.group,
+        _expected_head_round=lambda: expected,
+        _on_sync_needed=sync_requests.append)
+    BeaconProcess._startup_integrity_pass(bp_pass)
+    assert sync_requests == [expected]   # truncated tail -> catch-up sync
+
+    # an up-to-date head (restart mid-round, head == expected - 1 — the
+    # same grace /health applies) does NOT trip the probe
+    for r in range(N - 2, N):
+        victim.put(chain.beacons[r])     # restore through N-1
+    sync_requests.clear()
+    BeaconProcess._startup_integrity_pass(bp_pass)
+    assert sync_requests == []
+
+    # before genesis nothing is expected (fresh network, empty store)
+    bp_fresh = SimpleNamespace(clock=FakeClock(genesis - 1),
+                               group=SimpleNamespace(period=period,
+                                                     genesis_time=genesis))
+    assert BeaconProcess._expected_head_round(bp_fresh) == 0
 
 
 def test_heal_with_scan_report_quarantines_and_repairs(chain):

@@ -1,6 +1,7 @@
 """Chain validation + repair (sync_manager.go:170-268 semantics):
-a deliberately-holed/corrupted chain is detected by check_past_beacons and
-healed by correct_past_beacons through the raw store."""
+a deliberately-holed/corrupted chain is detected by check_past_beacons
+(a facade over chain.integrity.IntegrityScanner since the storage
+follow-up PR) and healed by correct_past_beacons through the raw store."""
 
 import pytest
 
@@ -36,6 +37,33 @@ def _manager(chain, facade, fetch=lambda peer, fr: iter(())):
         public_key_bytes=chain.public, period=30, clock=FakeClock(1),
         fetch=fetch, peers=["peer0"], chunk=4,
         verifier=HostBatchVerifier(chain.scheme, chain.public))
+
+
+def test_check_past_beacons_trimmed_raw_store_is_not_all_faulty(chain, tmp_path):
+    """ROADMAP follow-up regression: on a raw trimmed store (the daemon
+    default, require_previous=False) every stored row returns
+    previous_sig=None; the pre-scanner check_past_beacons verified with
+    that None and flagged EVERY round of a chained scheme.  The scanner
+    facade carries the linkage anchor itself, so a clean chain checks
+    clean — and a corrupted row is still caught."""
+    from drand_tpu.chain.sqlitedb import SqliteStore
+
+    store = SqliteStore(str(tmp_path / "trimmed.db"))   # require_previous=False
+    facade = FollowFacade(store, chain.scheme.chained,
+                          chain.info.genesis_seed)
+    for r in range(1, N + 1):
+        store.put(chain.beacons[r])
+    assert store.get(3).previous_sig is None            # really trimmed
+
+    syncm = _manager(chain, facade)
+    assert syncm.check_past_beacons(N) == []            # no false positives
+
+    # a flipped byte in round 7's signature is still detected
+    sig = bytearray(chain.beacons[7].signature)
+    sig[0] ^= 0xFF
+    store.delete(7)
+    store.put(Beacon(round=7, signature=bytes(sig)))
+    assert 7 in syncm.check_past_beacons(N)
 
 
 def test_check_past_beacons_finds_corruption_and_holes(chain):

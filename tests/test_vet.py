@@ -1,0 +1,317 @@
+"""tpu-vet (drand_tpu/analysis): the tier-1 gate + the fixture corpus.
+
+Two jobs:
+  1. `test_package_is_vet_clean` gates the repo: the whole drand_tpu
+     package must vet clean (zero unsuppressed findings) — the
+     static-analysis analogue of `go vet` in the reference's CI.
+  2. Every checker is proven against tests/lint_fixtures/: each seeded
+     violation is caught, each negative case stays silent, and the
+     suppression + baseline machinery actually suppresses/baselines.
+
+The analyzer parses target files without importing them, so none of
+this touches JAX (the subprocess test pins that).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from drand_tpu.analysis import load_baseline, run_vet, write_baseline
+from drand_tpu.analysis.checkers import (ALL_CHECKERS, by_names,
+                                         checker_names)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "drand_tpu")
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+
+pytestmark = pytest.mark.vet
+
+
+def _codes(report, path=None):
+    return {(f.path, f.code) for f in report.findings
+            if path is None or f.path == path}
+
+
+def _fixture_report(checker_name):
+    return run_vet([FIXTURES], checkers=by_names([checker_name]))
+
+
+# -- the tier-1 gate ----------------------------------------------------------
+
+
+def test_package_is_vet_clean():
+    """The whole package vets clean, fast, with every checker enabled."""
+    t0 = time.perf_counter()
+    report = run_vet([PACKAGE])
+    elapsed = time.perf_counter() - t0
+    assert report.errors == []
+    assert report.findings == [], (
+        "unsuppressed tpu-vet findings:\n"
+        + "\n".join(f.render() for f in report.findings))
+    assert report.files > 80            # the whole package was really walked
+    assert elapsed < 30                 # seconds, generous for a loaded box
+
+
+def test_cli_runs_clean_without_importing_jax():
+    """`tools/vet.py drand_tpu/` exits 0 and never imports JAX — the
+    acceptance criterion, checked in a fresh interpreter."""
+    probe = (
+        "import sys\n"
+        "sys.argv = ['vet', %r]\n"
+        "sys.path.insert(0, %r)\n"
+        "import runpy\n"
+        "try:\n"
+        "    runpy.run_path(%r, run_name='__main__')\n"
+        "except SystemExit as e:\n"
+        "    assert (e.code or 0) == 0, f'vet exit {e.code}'\n"
+        "leaked = [m for m in sys.modules\n"
+        "          if m == 'jax' or m.startswith('jax.')]\n"
+        "assert not leaked, f'vet imported JAX: {leaked}'\n"
+    ) % (PACKAGE, REPO, os.path.join(REPO, "tools", "vet.py"))
+    proc = subprocess.run([sys.executable, "-c", probe],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- per-checker fixture proofs ----------------------------------------------
+
+
+def test_clock_checker_catches_fixture():
+    report = _fixture_report("clock")
+    codes = _codes(report, "clock_bad.py")
+    assert ("clock_bad.py", "clock-direct-call") in codes
+    lines = {f.line for f in report.findings if f.path == "clock_bad.py"}
+    # direct, aliased, and both from-imports are caught
+    assert len(lines) == 4, sorted(lines)
+    # perf_counter and the two suppressed calls are NOT findings
+    texts = "\n".join(f.message for f in report.findings)
+    assert "perf_counter" not in texts
+    assert len([f for f in report.suppressed
+                if f.path == "clock_bad.py"]) == 2
+
+
+def test_lock_checker_catches_fixture():
+    report = _fixture_report("lock")
+    codes = _codes(report)
+    assert ("locks_bad.py", "lock-unguarded-write") in codes
+    assert ("locks_bad.py", "lock-blocking-call") in codes
+    assert ("locks_bad.py", "lock-order-cycle") in codes
+    msgs = [f.message for f in report.findings]
+    # the two seeded unguarded writes in reset(), not the locked one
+    assert sum("UnguardedWrite.reset " in m for m in msgs) == 2
+    # blocking: Queue.get and Event.wait; never get_nowait/block=False/cv
+    assert any("Queue.get" in m for m in msgs)
+    assert any("Event.wait" in m for m in msgs)
+    assert not any("fast_path" in m or "nonblocking" in m or "cv_wait" in m
+                   for m in msgs)
+    # cycle: OrderAB both ways + the SelfDeadlock re-entry; RLock is fine
+    cycles = [m for m in msgs if "cycle" in m]
+    assert any("OrderAB" in m for m in cycles)
+    assert any("SelfDeadlock" in m for m in cycles)
+    assert not any("ReentrantOk" in m for m in cycles)
+    assert len([f for f in report.suppressed
+                if f.path == "locks_bad.py"]) == 1
+
+
+def test_secret_checker_catches_fixture():
+    report = _fixture_report("secret")
+    codes = _codes(report, "secrets_bad.py")
+    assert ("secrets_bad.py", "secret-in-log") in codes
+    assert ("secrets_bad.py", "secret-in-exception") in codes
+    assert ("secrets_bad.py", "secret-in-repr") in codes
+    msgs = [f.message for f in report.findings]
+    # direct kwarg + one-hop taint are both caught
+    assert sum("secret-bearing" in m and "log call" in m
+               for m in msgs) == 2
+    # hash_secret() sanitizes; literals are not values
+    assert not any("proof" in m for m in msgs)
+    assert len([f for f in report.suppressed
+                if f.path == "secrets_bad.py"]) == 1
+
+
+def test_trace_checker_catches_fixture():
+    report = _fixture_report("trace")
+    codes = _codes(report, "ops/trace_bad.py")
+    assert ("ops/trace_bad.py", "trace-python-branch") in codes
+    assert ("ops/trace_bad.py", "trace-python-loop") in codes
+    assert ("ops/trace_bad.py", "trace-concretize") in codes
+    assert ("ops/trace_bad.py", "trace-captured-mutation") in codes
+    msgs = [f.message for f in report.findings]
+    # negatives: static args, shape-derived values, host-side functions
+    assert not any("static_is_fine" in m for m in msgs)
+    assert not any("shapes_are_static" in m for m in msgs)
+    assert not any("host_side" in m for m in msgs)
+    assert len([f for f in report.suppressed
+                if f.path == "ops/trace_bad.py"]) == 1
+
+
+def test_store_checker_catches_fixture():
+    report = _fixture_report("store")
+    codes = _codes(report, "store_bad.py")
+    assert ("store_bad.py", "store-missing-durability") in codes
+    assert ("store_bad.py", "store-conn-unlocked") in codes
+    assert ("store_bad.py", "store-put-no-commit") in codes
+    msgs = [f.message for f in report.findings]
+    assert any("NoDurabilityStore" in m for m in msgs)
+    assert not any("DeclaredStore" in m for m in msgs)
+    # locked accesses and the committing delete are not flagged
+    assert not any(".last " in m for m in msgs)
+    assert sum("ForeignConnCursor" in m for m in msgs) == 1
+
+
+def test_all_fixture_violations_found_by_full_run():
+    """One full-corpus run: every checker contributes findings (no
+    checker silently stopped matching its fixture)."""
+    report = run_vet([FIXTURES])
+    by_checker = report.counts()
+    for name in checker_names():
+        assert by_checker.get(name, 0) > 0, (
+            f"checker {name!r} found nothing in its fixture\n"
+            + report.render_text())
+
+
+# -- framework machinery ------------------------------------------------------
+
+
+def test_suppression_scoping(tmp_path):
+    src = tmp_path / "scoped.py"
+    src.write_text(
+        "import time\n"
+        "def a():\n"
+        "    return time.time()\n"
+        "def b():\n"
+        "    return time.time()  # tpu-vet: disable=lock\n")
+    report = run_vet([str(src)], checkers=by_names(["clock"]))
+    # a wrong checker token does NOT suppress a clock finding
+    assert len(report.findings) == 2
+
+
+def test_file_level_suppression(tmp_path):
+    src = tmp_path / "filewide.py"
+    src.write_text(
+        "# tpu-vet: disable-file=clock\n"
+        "import time\n"
+        "def a():\n"
+        "    return time.time()\n")
+    report = run_vet([str(src)], checkers=by_names(["clock"]))
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+
+
+def test_baseline_roundtrip(tmp_path):
+    """write-baseline grandfathers current findings; a NEW finding of the
+    same kind elsewhere still fails."""
+    report = _fixture_report("clock")
+    assert report.findings
+    path = str(tmp_path / "baseline.json")
+
+    class R:     # report shim with only what write_baseline reads
+        findings = report.findings
+        baselined = []
+
+    write_baseline(path, R)
+    baseline = load_baseline(path)
+    again = run_vet([FIXTURES], checkers=by_names(["clock"]),
+                    baseline=baseline)
+    assert again.findings == []
+    assert len(again.baselined) == len(report.findings)
+    # a fresh violation is NOT covered by the baseline
+    extra = os.path.join(FIXTURES, "clock_extra_tmp.py")
+    with open(extra, "w") as f:
+        f.write("import time\nBAD = time.time()\n")
+    try:
+        third = run_vet([FIXTURES], checkers=by_names(["clock"]),
+                        baseline=baseline)
+        assert len(third.findings) == 1
+        assert third.findings[0].path == "clock_extra_tmp.py"
+    finally:
+        os.unlink(extra)
+
+
+def test_single_file_scan_keeps_package_path_context():
+    """A single-FILE argument resolves rel against its topmost enclosing
+    package, so per-changed-file invocations (pre-commit style) agree
+    with the canonical directory scan: the clock checker's own allowlist
+    still matches `vet.py drand_tpu/beacon/clock.py`, and a scoped
+    checker still fires on a file named directly."""
+    clock_py = os.path.join(PACKAGE, "beacon", "clock.py")
+    report = run_vet([clock_py], checkers=by_names(["clock"]))
+    assert report.findings == []        # allowlisted, not basename-blind
+
+    resil = os.path.join(PACKAGE, "net", "resilience.py")
+    from drand_tpu.analysis.core import _iter_files
+    (_, rel), = _iter_files(resil, ())
+    assert rel == "net/resilience.py"   # matches a drand_tpu/ dir scan
+
+    # a SUBDIRECTORY scan is package-anchored the same way: scanning
+    # drand_tpu/beacon/ must not strip the beacon/ prefix and thereby
+    # flag the Clock implementations themselves
+    beacon_dir = os.path.join(PACKAGE, "beacon")
+    rels = {r for _, r in _iter_files(beacon_dir, ())}
+    assert "beacon/clock.py" in rels
+    report = run_vet([beacon_dir], checkers=by_names(["clock"]))
+    assert [f for f in report.findings if f.path.endswith("clock.py")] == []
+
+
+def test_unparseable_file_is_an_error_not_a_pass(tmp_path):
+    src = tmp_path / "broken.py"
+    src.write_text("def broken(:\n")
+    report = run_vet([str(tmp_path)])
+    assert not report.clean
+    assert report.errors and "broken.py" in report.errors[0]
+
+
+def test_generated_protos_are_excluded():
+    report = run_vet([PACKAGE])
+    assert not any("_pb2" in f.path
+                   for f in report.findings + report.suppressed)
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def _run_cli(*argv):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import vet
+        return vet
+    finally:
+        sys.path.pop(0)
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    vet = _run_cli()
+    # clean target -> 0
+    clean = tmp_path / "clean.py"
+    clean.write_text("X = 1\n")
+    assert vet.main([str(clean)]) == 0
+    # findings -> 1, and the JSON is machine-readable
+    assert vet.main([FIXTURES, "--format", "json"]) == 1
+    out = capsys.readouterr().out
+    payload = json.loads(out[out.index("{"):])
+    assert payload["clean"] is False
+    assert payload["counts"]
+    # usage errors -> 2
+    assert vet.main(["/no/such/path-anywhere"]) == 2
+    assert vet.main([FIXTURES, "--checkers", "nope"]) == 2
+    assert vet.main([FIXTURES, "--baseline", "/no/such/baseline"]) == 2
+
+
+def test_cli_write_baseline_then_clean(tmp_path, capsys):
+    vet = _run_cli()
+    bl = str(tmp_path / "bl.json")
+    assert vet.main([FIXTURES, "--write-baseline", bl]) == 0
+    assert vet.main([FIXTURES, "--baseline", bl]) == 0
+    capsys.readouterr()
+
+
+def test_checker_registry_names_are_suppression_tokens():
+    assert checker_names() == ["clock", "lock", "secret", "trace", "store"]
+    assert len(ALL_CHECKERS) == 5
+    with pytest.raises(KeyError):
+        by_names(["not-a-checker"])

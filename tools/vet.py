@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""tpu-vet CLI: project-native static analysis for drand_tpu.
+
+Usage:
+    python tools/vet.py drand_tpu/                 # text report
+    python tools/vet.py --format json drand_tpu/
+    python tools/vet.py --checkers clock,lock drand_tpu/
+    python tools/vet.py --baseline vet-baseline.json drand_tpu/
+    python tools/vet.py --write-baseline vet-baseline.json drand_tpu/
+
+Exit codes: 0 = clean, 1 = unsuppressed findings (or unparseable files),
+2 = usage / internal error.
+
+Imports no JAX: analysis parses target files, it never executes them —
+a full-package run completes in a couple of seconds on the 2-core
+CPU-only container.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from drand_tpu.analysis import (checker_names, load_baseline,  # noqa: E402
+                                run_vet, write_baseline)
+from drand_tpu.analysis.checkers import by_names  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpu-vet", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/directories to scan "
+                             "(default: drand_tpu/)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--checkers", default=None,
+                        help="comma-separated subset "
+                             f"(default: {','.join(checker_names())})")
+    parser.add_argument("--baseline", default=None,
+                        help="JSON baseline of grandfathered findings")
+    parser.add_argument("--write-baseline", default=None, metavar="PATH",
+                        help="write the current findings as a baseline "
+                             "and exit 0")
+    parser.add_argument("--list", action="store_true",
+                        help="list available checkers and exit")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        from drand_tpu.analysis import ALL_CHECKERS
+        for c in ALL_CHECKERS:
+            print(f"{c.name:8s} {c.description}")
+        return 0
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = args.paths or [os.path.join(repo_root, "drand_tpu")]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"tpu-vet: no such path: {p}", file=sys.stderr)
+            return 2
+
+    checkers = None
+    if args.checkers:
+        try:
+            checkers = by_names(
+                [n.strip() for n in args.checkers.split(",") if n.strip()])
+        except KeyError as e:
+            print(f"tpu-vet: {e.args[0]}", file=sys.stderr)
+            return 2
+
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"tpu-vet: bad baseline: {e}", file=sys.stderr)
+            return 2
+
+    try:
+        report = run_vet(paths, checkers=checkers, baseline=baseline)
+    except Exception as e:  # noqa: BLE001 — a crash is an exit-2 bug, not findings
+        print(f"tpu-vet: internal error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, report)
+        print(f"baseline written: {args.write_baseline} "
+              f"({len(report.findings) + len(report.baselined)} findings)")
+        return 0
+
+    print(report.to_json() if args.format == "json"
+          else report.render_text())
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
