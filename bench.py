@@ -31,6 +31,11 @@ Configs (BASELINE.json north_star):
                        SqliteStore, double buffered (the headline; an
                        exact chunk multiple so every chunk shares ONE
                        compiled program shape)
+  6. coalesced_service the same replay submitted through the resident
+                       verify service in quarter-chunk spans: coalescing
+                       merges 4 submissions per PAD-lane dispatch
+                       (dispatch counter recorded in stats), double
+                       buffering via the service's pipelined executor
 
 Compiled-program economy: every verifier pads to PAD=8192 (pad_to), so
 the whole bench needs exactly four on-chip programs — G1-RLC@8192,
@@ -76,13 +81,13 @@ def _progress(msg):
 
 
 def _configs():
-    raw = os.environ.get("DRAND_TPU_BENCH_CONFIGS", "1,2,3,4,5")
+    raw = os.environ.get("DRAND_TPU_BENCH_CONFIGS", "1,2,3,4,5,6")
     out = set()
     for x in raw.split(","):
         x = x.strip()
-        if x.isdigit() and 1 <= int(x) <= 5:
+        if x.isdigit() and 1 <= int(x) <= 6:
             out.add(int(x))
-    return out or {1, 2, 3, 4, 5}
+    return out or {1, 2, 3, 4, 5, 6}
 
 
 def _jax_setup():
@@ -349,17 +354,79 @@ def bench_streamed_store(stats):
     return n / dt
 
 
+def bench_coalesced_service(stats):
+    """Config 6 (ISSUE 6): the same streamed replay as config 5, but
+    submitted through the resident verify service in quarter-chunk spans
+    from a consumer's point of view — the service coalesces them back
+    into PAD-lane dispatches, double-buffers host packing against device
+    compute, and the dispatch counter proves the reduction (4 submissions
+    coalesce per device dispatch)."""
+    from drand_tpu.crypto import schemes
+    from drand_tpu.crypto.verify_service import VerifyService
+
+    sch, pub, store = _unchained_store(
+        schemes.SHORT_SIG_SCHEME_ID, N_STREAM, b"drand-tpu-bench-stream",
+        "g1stream")                            # config 5's fixture, shared
+    svc = VerifyService(pad=PAD, background_window=0.01)
+    handle = svc.handle(sch, pub)
+    sub = max(1, PAD // 4)
+
+    def replay():
+        futs = []
+        buf_rounds, buf_sigs = [], []
+        cur = store.cursor()
+        b = cur.first()
+        while b is not None:
+            buf_rounds.append(b.round)
+            buf_sigs.append(b.signature)
+            if len(buf_rounds) == sub:
+                futs.append(handle.submit(buf_rounds, buf_sigs))
+                buf_rounds, buf_sigs = [], []
+            b = cur.next()
+        if buf_rounds:
+            futs.append(handle.submit(buf_rounds, buf_sigs))
+        n = 0
+        for f in futs:
+            ok = f.result()
+            assert ok.all()
+            n += len(ok)
+        return n, len(futs)
+
+    try:
+        n, _ = replay()                        # cold (compile/cache-load)
+        _progress("coalesced_service warm")
+        before = svc.stats()
+        t0 = time.perf_counter()
+        n, submissions = replay()
+        dt = time.perf_counter() - t0
+        assert n == N_STREAM
+        st = svc.stats()
+        stats["coalesced_submissions"] = submissions
+        stats["coalesced_dispatches"] = st["dispatches"] - \
+            before["dispatches"]
+        # delta'd over the WARM replay only (cumulative stats would blend
+        # the cold run's interleaving in)
+        slots = st["dispatch_slots"] - before["dispatch_slots"]
+        stats["coalesced_fill_ratio"] = round(
+            (st["dispatch_lanes"] - before["dispatch_lanes"]) /
+            max(1, slots), 3)
+        return n / dt
+    finally:
+        svc.stop()
+
+
 _RUNNERS = {
     1: "chained_catchup",
     2: "unchained_resident",
     3: "partials_recover",
     4: "mixed_4chains",
     5: "streamed_store",
+    6: "coalesced_service",
 }
-# Order: config 2 compiles/loads the shared G1@PAD program that 5, 3 and
-# 4 reuse; G2 (1, then 4) go after the G1 family so a G2 compile overrun
-# cannot starve the G1 numbers.
-_ORDER = [2, 5, 3, 1, 4]
+# Order: config 2 compiles/loads the shared G1@PAD program that 5, 6, 3
+# and 4 reuse; G2 (1, then 4) go after the G1 family so a G2 compile
+# overrun cannot starve the G1 numbers.
+_ORDER = [2, 5, 6, 3, 1, 4]
 
 
 def _child(indices):
@@ -374,6 +441,7 @@ def _child(indices):
             3: bench_partials_recover,
             4: bench_mixed_4chains,
             5: lambda: bench_streamed_store(stats),
+            6: lambda: bench_coalesced_service(stats),
         }
         t0 = time.monotonic()
         try:
@@ -410,6 +478,7 @@ def _emit(configs, stats):
               "chained_catchup": N_CHAINED,
               "partials_recover": N_PARTIAL_ROUNDS,
               "mixed_4chains": N_CHAINED + 3 * N_MIXED,
+              "coalesced_service": N_STREAM,
               **stats},
     }
     print(json.dumps(out), flush=True)

@@ -6,9 +6,10 @@ from .locks import LockChecker
 from .secrets import SecretChecker
 from .trace import TraceChecker
 from .store import StoreChecker
+from .verifier import VerifierChecker
 
 ALL_CHECKERS = (ClockChecker, LockChecker, SecretChecker, TraceChecker,
-                StoreChecker)
+                StoreChecker, VerifierChecker)
 
 
 def checker_names():

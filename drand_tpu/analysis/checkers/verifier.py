@@ -1,0 +1,48 @@
+"""verifier-discipline: all device verification flows through the
+resident verify service.
+
+The verify service (crypto/verify_service.py) exists so the device sees
+ONE owner — coalesced canonical batches, priority lanes, a persistent
+mesh — instead of per-consumer ad-hoc dispatch.  That architecture only
+holds if consumers cannot quietly regrow private dispatch paths, so
+constructing `BatchBeaconVerifier` directly is banned outside `crypto/`
+(the service and the crypto package internals).  Everything else gets a
+`VerifyService.handle(...)` (or passes `device=False` for the jax-free
+`HostBatchVerifier` fallback behind the same submit API).
+"""
+
+import ast
+from typing import Iterator
+
+from ..core import Finding
+from ..symbols import ModuleInfo, dotted
+
+TARGET = "BatchBeaconVerifier"
+
+# modules allowed to construct the raw verifier: the crypto package owns
+# the device pipelines and the service that fronts them
+ALLOWED_PREFIX = "crypto/"
+
+
+class VerifierChecker:
+    name = "verifier"
+    description = ("direct BatchBeaconVerifier construction outside "
+                   "crypto/ (bypasses the resident verify service)")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if module.rel.startswith(ALLOWED_PREFIX):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = module.resolve(dotted(node.func) or "")
+            if qual.split(".")[-1] != TARGET:
+                continue
+            yield Finding(
+                checker=self.name, code="verifier-direct-construction",
+                message=(f"direct {TARGET}(...) construction outside "
+                         "crypto/; submit through the resident verify "
+                         "service (crypto/verify_service.py handle/"
+                         "submit API) so dispatch stays coalesced and "
+                         "priority-laned"),
+                path=module.rel, line=node.lineno, col=node.col_offset)

@@ -58,8 +58,12 @@ class SyncManager:
         self.peers = list(peers)
         self.chunk = chunk
         if verifier is None:                # lazy: keep jax out of host-only
-            from ..crypto.batch import BatchBeaconVerifier   # callers' path
-            verifier = BatchBeaconVerifier(scheme, public_key_bytes)
+            # all device dispatch goes through the resident verify
+            # service (one owner, coalesced batches, priority lanes) —
+            # sync/heal work rides the BACKGROUND lane so live-round
+            # partial aggregation preempts it at chunk boundaries
+            from ..crypto.verify_service import get_service
+            verifier = get_service().handle(scheme, public_key_bytes)
         self.verifier = verifier
         # shared policy: the daemon passes the one its ProtocolClient uses,
         # so partial-send failures steer sync peer selection and vice versa

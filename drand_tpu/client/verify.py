@@ -3,8 +3,9 @@
 Every result is verified against the chain info before it is returned.  In
 strict chained mode the client walks from its last point of trust to the
 requested round; where the reference verifies that walk one beacon at a
-time on the CPU (verify.go:139-160), this client fetches the span and
-verifies it in device-sized batches through `BatchBeaconVerifier` — the
+time on the CPU (verify.go:139-160), this client submits the span to the
+resident verify service (`crypto/verify_service.py`), which coalesces it
+with every other caller's work into device-sized batches — the
 chain-catchup case BASELINE config 1 measures.
 """
 
@@ -16,8 +17,6 @@ from ..chain.info import Info
 from ..crypto.schemes import scheme_from_name
 from ..log import Logger
 from .interface import Client, Result
-
-BATCH = 512
 
 
 def verify_beacon_with_info(info: Info, beacon: Beacon) -> bool:
@@ -53,23 +52,30 @@ class VerifyingClient(Client):
 
     def _ensure_crypto(self):
         if self._verifier is None:
-            from ..crypto.hostverify import HostBatchVerifier
+            # jax-free fallback handle behind the verify service's submit
+            # API: single interactive gets ride the LIVE-priority host
+            # path (a device round-trip and the jax import itself are
+            # wrong for a one-beacon check)
+            from ..crypto.verify_service import get_service
             info = self.info()
             self._scheme = scheme_from_name(info.scheme)
-            self._verifier = HostBatchVerifier(self._scheme,
-                                               info.public_key)
+            self._verifier = get_service().handle(self._scheme,
+                                                  info.public_key,
+                                                  device=False)
             self._device_verifier = None
         return self._scheme, self._verifier
 
     def _sweep_verifier(self, n: int):
-        """Device verifier for large spans, host verifier otherwise."""
+        """Device verify-service handle for large spans, host handle
+        otherwise (the service coalesces sweep chunks from all clients
+        into canonical padded batches)."""
         if n < self.DEVICE_MIN_BATCH:
             return self._verifier
         if self._device_verifier is None:
-            from ..crypto.batch import BatchBeaconVerifier
+            from ..crypto.verify_service import get_service
             info = self.info()
-            self._device_verifier = BatchBeaconVerifier(self._scheme,
-                                                        info.public_key)
+            self._device_verifier = get_service().handle(self._scheme,
+                                                         info.public_key)
         return self._device_verifier
 
     # -- Client --------------------------------------------------------------
@@ -99,7 +105,7 @@ class VerifyingClient(Client):
             self._walk_to(beacon)
         elif not verifier.verify_batch(
                 [beacon.round], [beacon.signature],
-                [beacon.previous_sig]).all():
+                [beacon.previous_sig], lane="live").all():
             raise ValueError(f"round {beacon.round}: invalid signature")
         with self._lock:
             if self._trusted is None or beacon.round > self._trusted.round:
@@ -120,7 +126,8 @@ class VerifyingClient(Client):
             # doesn't apply (it only extends the frontier); verify the
             # signature directly
             if not verifier.verify_batch([target.round], [target.signature],
-                                         [target.previous_sig]).all():
+                                         [target.previous_sig],
+                                         lane="live").all():
                 raise ValueError(
                     f"round {target.round}: invalid signature")
             return
@@ -135,13 +142,18 @@ class VerifyingClient(Client):
             if prev is not None and b.previous_sig != prev.signature:
                 raise ValueError(f"round {b.round}: chain linkage broken")
             prev = b
+        # ONE submission for the whole span: the verify service splits it
+        # into canonical padded chunks itself (and overlaps host packing
+        # with device compute), so the client no longer hand-rolls a
+        # BATCH-sized dispatch loop
+        # live lane like the sibling point checks: the walk serves an
+        # interactive get(), so it preempts background scans rather than
+        # queueing behind them
         sweeper = self._sweep_verifier(len(span))
-        for i in range(0, len(span), BATCH):
-            chunk = span[i:i + BATCH]
-            ok = sweeper.verify_batch(
-                [b.round for b in chunk],
-                [b.signature for b in chunk],
-                [b.previous_sig for b in chunk])
-            if not ok.all():
-                bad = [b.round for b, good in zip(chunk, ok) if not good]
-                raise ValueError(f"invalid signatures at rounds {bad}")
+        ok = sweeper.verify_batch(
+            [b.round for b in span],
+            [b.signature for b in span],
+            [b.previous_sig for b in span], lane="live")
+        if not ok.all():
+            bad = [b.round for b, good in zip(span, ok) if not good]
+            raise ValueError(f"invalid signatures at rounds {bad}")

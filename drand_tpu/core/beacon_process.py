@@ -179,9 +179,15 @@ class BeaconProcess:
                 return
             assert self.group is not None and self.share is not None
             self.store = self._create_store()
-            verifier_factory = (device_verifier_factory
-                                if self.cfg.use_device_verifier
-                                else _host_verifier_factory)
+            # ONE daemon-owned verify pipeline for everything this chain
+            # verifies: aggregation-time partials ride the LIVE lane
+            # (preempting background work at chunk boundaries), while the
+            # sync plane / integrity scans below share the BACKGROUND lane
+            # of the same service
+            verify_svc = self.cfg.verify_service()
+            verifier_factory = verify_svc.partials_factory(
+                device_verifier_factory if self.cfg.use_device_verifier
+                else _host_verifier_factory)
             self.monitor = ThresholdMonitor(self.beacon_id, self.log,
                                             self.group.threshold)
             self.monitor.start()
@@ -197,11 +203,9 @@ class BeaconProcess:
                 beacon_id=self.beacon_id)
             self.handler = Handler(handler_cfg)
             self.sync_server = SyncChainServer(self.handler.chain)
-            sync_verifier = None
-            if not self.cfg.use_device_verifier:
-                from ..crypto.hostverify import HostBatchVerifier
-                sync_verifier = HostBatchVerifier(
-                    self.group.scheme, self.group.public_key.key())
+            sync_verifier = verify_svc.handle(
+                self.group.scheme, self.group.public_key.key(),
+                device=self.cfg.use_device_verifier)
             self.syncm = SyncManager(
                 chain=self.handler.chain,
                 scheme=self.group.scheme,

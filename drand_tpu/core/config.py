@@ -48,6 +48,15 @@ class Config:
     dkg_callback: Optional[Callable] = None
     use_device_verifier: bool = True     # TPU-batched aggregation verify
     sync_chunk: int = 512
+    # resident verify service (crypto/verify_service.py): ONE daemon-owned
+    # pipeline that every verify consumer submits to.  verify_pad is the
+    # canonical coalesced batch width (bench.py's 8192 standard);
+    # verify_window is how long an under-filled BACKGROUND batch may wait
+    # for co-riders before flushing; live work always flushes immediately.
+    verify_pad: int = 8192
+    verify_window: float = 0.02
+    _verify_service: Optional[object] = field(default=None, init=False,
+                                              repr=False, compare=False)
     # startup chain-integrity pass (chain/integrity.py): "off" trusts the
     # disk, "linkage" is the structural host-only scan (gaps, torn rows,
     # prev_sig linkage), "full" adds batched signature verification —
@@ -82,6 +91,29 @@ class Config:
             **({"max_attempts": self.retry_max_attempts}
                if self.retry_max_attempts else {}),
             scope=scope, **kw)
+
+    def verify_service(self):
+        """The daemon-owned resident verify service, created on first use
+        and bound to the daemon's injected clock.  Every BeaconProcess of
+        this daemon (and its follow/sync planes) shares it, so partials,
+        integrity scans, catch-up sync and client sweeps coalesce into
+        the same device batches."""
+        if self._verify_service is None:
+            from ..crypto.verify_service import VerifyService
+            self._verify_service = VerifyService(
+                clock=self.clock, pad=self.verify_pad,
+                background_window=self.verify_window)
+        return self._verify_service
+
+    def stop_verify_service(self) -> None:
+        """Tear the daemon-owned service down (scheduler + packer threads,
+        cached backends).  Called from DrandDaemon.stop() — NOT from
+        BeaconProcess.stop(), since every process of the daemon shares the
+        one service.  Idempotent; a later verify_service() call builds a
+        fresh one."""
+        svc, self._verify_service = self._verify_service, None
+        if svc is not None:
+            svc.stop()
 
     def db_folder(self, beacon_id: str) -> str:
         from ..common import DEFAULT_BEACON_ID

@@ -75,6 +75,10 @@ class DrandDaemon:
             self.metrics.stop()
         if self.http_server is not None:
             self.http_server.stop()
+        # the daemon owns the resident verify service (cfg.verify_service
+        # is shared by every BeaconProcess, so individual bp.stop()s must
+        # not tear it down — the daemon's exit does)
+        self.cfg.stop_verify_service()
         self._exit.set()
 
     def wait_exit(self, timeout: Optional[float] = None) -> bool:

@@ -82,10 +82,11 @@ def follow_chain(daemon, bp, nodes: List[str], is_tls: bool, up_to: int,
     scheme = scheme_from_name(info.scheme)
     store = bp._create_store()
     facade = FollowFacade(store, scheme.chained, info.genesis_seed)
-    verifier = None
-    if not bp.cfg.use_device_verifier:
-        from ..crypto.hostverify import HostBatchVerifier
-        verifier = HostBatchVerifier(scheme, info.public_key)
+    # observer-mode sync rides the daemon's resident verify service too:
+    # its chunks coalesce with every other consumer's work (and a host
+    # handle behind the same submit API when the device path is off)
+    verifier = bp.cfg.verify_service().handle(
+        scheme, info.public_key, device=bp.cfg.use_device_verifier)
     syncm = SyncManager(
         chain=facade, scheme=scheme, public_key_bytes=info.public_key,
         period=info.period, clock=bp.clock,

@@ -377,7 +377,7 @@ class BatchBeaconVerifier:
     kind = "device"  # metrics label for integrity scans (chain/integrity.py)
 
     def __init__(self, scheme: Scheme, public_key_bytes: bytes,
-                 pad_to: int | None = None):
+                 pad_to: int | None = None, sharding=None):
         self.scheme = scheme
         self.g2sig = scheme.sig_group is GroupG2
         # pad_to: optional canonical batch width.  Batches pad UP to it so
@@ -385,6 +385,11 @@ class BatchBeaconVerifier:
         # pads every config to 8192: compile count is the scarce resource
         # on-chip, and pad slots cost ~linear device time but zero compiles)
         self.pad_to = pad_to
+        # sharding: optional persistent NamedSharding over the round axis,
+        # owned by the caller (the verify service builds ONE mesh for all
+        # backends); None falls back to a per-dispatch mesh when more than
+        # one device is visible
+        self.sharding = sharding
         self.pub_point = scheme.key_group.from_bytes(public_key_bytes)
         if self.g2sig:
             self.pk_aff = (L.encode_mont(self.pub_point[0]), L.encode_mont(self.pub_point[1]))
@@ -469,9 +474,12 @@ class BatchBeaconVerifier:
         if len(devs) < 2 or pad < self.SHARD_MIN_PAD \
                 or pad % len(devs) != 0:
             return enc
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-        mesh = Mesh(np.array(devs), ("round",))
-        sh = NamedSharding(mesh, P("round"))
+        if self.sharding is not None:
+            sh = self.sharding      # service-owned persistent mesh
+        else:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+            mesh = Mesh(np.array(devs), ("round",))
+            sh = NamedSharding(mesh, P("round"))
 
         def put(t):
             return jax.device_put(t, sh) if t.shape[0] == pad else t
@@ -545,6 +553,40 @@ class BatchBeaconVerifier:
                                 max(_pad_len(n), self.pad_to or 0))
         return self._verify_range(enc, 0, n, bad, top=True)
 
+    # -- pack / dispatch / resolve: the double-buffer triple -----------------
+    # The verify service's pipelined executor drives these three stages for
+    # EVERY caller (host packing of chunk k+1 overlaps device compute of
+    # chunk k); verify_stream below rides the same split for store replay.
+
+    def pack_chunk(self, rounds, sigs, prev_sigs=None):
+        """Stage 1, host side: numpy wire parse + batched hash-to-field.
+        Returns an opaque packed tuple for dispatch/resolve."""
+        n = len(rounds)
+        if prev_sigs is None:
+            prev_sigs = [None] * n
+        msgs = self._messages(rounds, prev_sigs)
+        enc, bad = self._encode(sigs, msgs,
+                                max(_pad_len(n), self.pad_to or 0))
+        return n, enc, bad
+
+    def dispatch_packed(self, packed):
+        """Stage 2: enqueue one RLC pass on device (no sync).  Returns the
+        device-side fused verdict, or None when malformed slots force the
+        exact fallback."""
+        n, enc, bad = packed
+        if bad.any():
+            return None                   # rare: straight to fallback
+        return self._rlc_dispatch(enc, n)
+
+    def resolve_packed(self, packed, verdict) -> np.ndarray:
+        """Stage 3: block on the verdict scalar; bisect to the culprits on
+        failure.  Returns the per-round validity array."""
+        n, enc, bad = packed
+        if verdict is not None and bool(verdict):
+            return np.ones(n, dtype=bool)
+        # slow path: bisection + exact checks locate the bad rounds
+        return self._verify_range(enc, 0, n, bad, top=True)
+
     def verify_stream(self, beacons, chunk_size: int = 8192):
         """Streamed verification of an iterable of beacons (BASELINE
         config 5: replay from a populated store).  Host packing of chunk
@@ -556,12 +598,9 @@ class BatchBeaconVerifier:
 
         def pack(chunk):
             rounds = [b.round for b in chunk]
-            prevs = [b.previous_sig for b in chunk]
-            sigs = [b.signature for b in chunk]
-            msgs = self._messages(rounds, prevs)
-            enc, bad = self._encode(
-                sigs, msgs, max(_pad_len(len(chunk)), self.pad_to or 0))
-            return rounds, enc, bad
+            return rounds, self.pack_chunk(rounds,
+                                           [b.signature for b in chunk],
+                                           [b.previous_sig for b in chunk])
 
         def chunks():
             buf = []
@@ -573,19 +612,13 @@ class BatchBeaconVerifier:
             if buf:
                 yield buf
 
-        def dispatch(packed):
-            rounds, enc, bad = packed
-            if bad.any():
-                return rounds, enc, bad, None     # rare: straight to fallback
-            return rounds, enc, bad, self._rlc_dispatch(enc, len(rounds))
+        def dispatch(item):
+            rounds, packed = item
+            return rounds, packed, self.dispatch_packed(packed)
 
         def resolve(item):
-            rounds, enc, bad, verdict = item
-            if verdict is not None and bool(verdict):
-                return rounds, np.ones(len(rounds), dtype=bool)
-            # slow path: bisection + exact checks locate the bad rounds
-            return rounds, self._verify_range(enc, 0, len(rounds), bad,
-                                              top=True)
+            rounds, packed, verdict = item
+            return rounds, self.resolve_packed(packed, verdict)
 
         # Two overlapped stages: the pack thread prepares chunk i+1 while
         # the device runs chunk i, and the fused-verdict readback of chunk

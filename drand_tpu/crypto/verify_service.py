@@ -1,0 +1,603 @@
+"""Resident verify service: ONE daemon-owned device pipeline for all
+verification (ROADMAP item 1; the architectural prerequisite for the
+occupancy campaign, multi-tenant serving, and Handel-style aggregation).
+
+PERF.md's roofline says the verify pipeline runs at ~1.8% of measured
+kernel field-mul throughput — a latency/occupancy problem, not an ALU
+one.  A big slice of that latency is structural: every consumer
+(catch-up sync, integrity scan, client sweeps, partial aggregation)
+used to construct its own `BatchBeaconVerifier` and dispatch its own
+ad-hoc batches, so the device saw many small, uncoordinated programs
+instead of few full ones.  This module centralizes dispatch:
+
+  * **One owner.**  A `VerifyService` singleton owns the device(s), the
+    compiled programs (one per (scheme kind, pad width) — compile once,
+    reuse forever) and, on multi-device hosts, a persistent
+    `Mesh`/`NamedSharding` over the round axis (the sharding
+    `__graft_entry__.dryrun_multichip` proved offline, promoted to the
+    serving path).
+  * **Request coalescing.**  Submissions from all callers of the same
+    chain merge into the canonical padded batches `bench.py`
+    standardized (default 8192 lanes); each caller gets a future for
+    exactly its slice of the verdict array.
+  * **Priority lanes.**  Live-round work (partial aggregation, urgent
+    client checks) preempts background integrity/catch-up work at the
+    next chunk boundary; a deadline-aware scheduler on the injected
+    `Clock` flushes under-filled background batches once their
+    coalescing window expires.
+  * **Double-buffered streaming.**  Host packing of chunk k+1 overlaps
+    device compute of chunk k for EVERY caller, via the same
+    pack/dispatch/resolve split `BatchBeaconVerifier.verify_stream`
+    uses for the store-stream path.
+  * **Host fallback.**  `crypto.hostverify.HostBatchVerifier` rides
+    behind the same submit API (`device=False`), so jax-free callers
+    keep working and still benefit from the lanes and the coalescer.
+
+Consumers hold a `VerifyHandle` (from `VerifyService.handle`) exposing
+the familiar `verify_batch(rounds, sigs, prev_sigs) -> bool array`
+blocking call plus the async `submit(...) -> VerifyFuture`.  Direct
+`BatchBeaconVerifier(...)` construction outside `crypto/` is forbidden
+by the tpu-vet `verifier` checker.
+
+This module imports no jax at module scope: device backends are built
+lazily on first device-handle request.
+"""
+
+import threading
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+LANE_LIVE = "live"
+LANE_BACKGROUND = "background"
+LANES = (LANE_LIVE, LANE_BACKGROUND)
+
+DEFAULT_PAD = 8192          # the canonical batch width bench.py standardized
+DEFAULT_BG_WINDOW = 0.02    # seconds a background batch may wait to fill
+DEFAULT_LIVE_WINDOW = 0.0   # live work flushes immediately
+
+# the submit API's future type: the stdlib one — set_result/set_exception/
+# result(timeout)/done() are exactly the contract the service needs, and
+# callers get cancellation/done-callbacks for free
+VerifyFuture = Future
+
+
+class _Request:
+    """One queued unit of work: either a coalescable verify-batch span or
+    an opaque callable (the partial-aggregation path, whose batching is
+    internal to `BatchPartialVerifier`)."""
+
+    __slots__ = ("kind", "key", "backend", "rounds", "sigs", "prevs", "fn",
+                 "lane", "future", "enqueued", "n", "flush")
+
+    def __init__(self, kind, lane, future, enqueued, key=None, backend=None,
+                 rounds=None, sigs=None, prevs=None, fn=None, flush=False):
+        self.kind = kind            # "batch" | "call"
+        self.lane = lane
+        self.future = future
+        self.enqueued = enqueued
+        self.key = key
+        self.backend = backend
+        self.rounds = rounds
+        self.sigs = sigs
+        self.prevs = prevs
+        self.fn = fn
+        self.n = len(rounds) if rounds is not None else 1
+        self.flush = flush          # dispatch-ready: skip the window
+
+
+class _Batch:
+    """One coalesced dispatch unit handed to the executor."""
+
+    __slots__ = ("lane", "backend", "requests", "call")
+
+    def __init__(self, lane, backend=None, requests=None, call=None):
+        self.lane = lane
+        self.backend = backend
+        self.requests: List[_Request] = requests or []
+        self.call: Optional[_Request] = call
+
+    @property
+    def n(self) -> int:
+        return sum(r.n for r in self.requests)
+
+
+class VerifyHandle:
+    """Per-chain submit surface; drop-in for the old per-consumer
+    verifier objects (`verify_batch` + `kind` for the integrity-scan
+    metrics label)."""
+
+    def __init__(self, service: "VerifyService", key, scheme, backend):
+        self.service = service
+        self.key = key
+        self.scheme = scheme
+        self.backend = backend
+        self.kind = getattr(backend, "kind", "host")
+
+    def submit(self, rounds, sigs, prev_sigs=None,
+               lane: str = LANE_BACKGROUND,
+               flush_now: bool = False) -> VerifyFuture:
+        return self.service.submit(self, rounds, sigs, prev_sigs, lane=lane,
+                                   flush_now=flush_now)
+
+    def verify_batch(self, rounds, sigs, prev_sigs=None,
+                     lane: str = LANE_BACKGROUND) -> np.ndarray:
+        # a BLOCKING caller cannot submit more work while it waits, so
+        # holding its request for the coalescing window buys nothing and
+        # costs latency per call (and a serial chunk loop — catch-up
+        # sync — would pay it per chunk).  flush_now skips the window;
+        # already-queued same-chain work still merges at gather time.
+        return self.submit(rounds, sigs, prev_sigs, lane=lane,
+                           flush_now=True).result()
+
+
+class _PartialLaneVerifier:
+    """Aggregation-time partial verifier routed through the service's
+    LIVE lane: wraps any inner `.verify(msg, partials)` implementation
+    (Device/HostPartialVerifier) so live-round aggregation preempts
+    background scans at the next chunk boundary instead of contending
+    for the device ad hoc."""
+
+    def __init__(self, service: "VerifyService", inner):
+        self.service = service
+        self.inner = inner
+        self.kind = getattr(inner, "kind", "host")
+
+    def verify(self, msg: bytes, partials):
+        fut = self.service.submit_call(
+            lambda: self.inner.verify(msg, partials), lane=LANE_LIVE)
+        return fut.result()
+
+
+class VerifyService:
+    """The daemon-owned coalescing, priority-laned verify dispatcher.
+
+    All mutable scheduler state lives under `self._cond`; device/host
+    work always executes OUTSIDE the lock on the single service thread,
+    so callers only ever block on their own futures."""
+
+    def __init__(self, clock=None, pad: int = DEFAULT_PAD,
+                 live_window: float = DEFAULT_LIVE_WINDOW,
+                 background_window: float = DEFAULT_BG_WINDOW):
+        if clock is None:
+            # deferred import: crypto must not hard-depend on beacon at
+            # module scope (same layering softening as net/resilience.py)
+            from ..beacon.clock import RealClock
+            clock = RealClock()
+        self.clock = clock
+        self.pad = max(1, pad)
+        self.windows = {LANE_LIVE: live_window,
+                        LANE_BACKGROUND: background_window}
+        self._cond = threading.Condition()
+        self._queues: Dict[str, deque] = {ln: deque() for ln in LANES}
+        self._handles: Dict[Tuple, VerifyHandle] = {}
+        self._mesh = None
+        self._thread: Optional[threading.Thread] = None
+        self._packer = None
+        self._stopped = False
+        # stats (guarded by _cond; ints so tests need not scrape prom)
+        self._submitted = 0
+        self._dispatches = 0
+        self._dispatch_lanes = 0    # sum of real lanes over all dispatches
+        self._dispatch_slots = 0    # sum of padded widths over all dispatches
+        self._preemptions = 0
+
+    # -- handles / backends --------------------------------------------------
+
+    def handle(self, scheme, public_key_bytes: bytes, device: bool = True,
+               backend=None) -> VerifyHandle:
+        """The per-chain submit surface.  `device=False` (or jax being
+        unavailable) selects the `HostBatchVerifier` fallback behind the
+        same API; `backend=` injects a custom verifier (tests)."""
+        pk = bytes(public_key_bytes)
+        kind = "custom" if backend is not None else \
+            ("device" if device and self._device_available() else "host")
+        key = (scheme.id, pk, kind, id(backend) if backend is not None else 0)
+        with self._cond:
+            h = self._handles.get(key)
+        if h is not None:
+            return h
+        if backend is None:
+            backend = self._make_backend(scheme, pk, kind)
+        h = VerifyHandle(self, key, scheme, backend)
+        with self._cond:
+            # two racing builders: first insert wins, both see one handle
+            h = self._handles.setdefault(key, h)
+        return h
+
+    def partials_factory(self, inner_factory: Callable) -> Callable:
+        """Wrap a partial-verifier factory (beacon.node.device_verifier_
+        factory or _host_verifier_factory) so aggregation-time partial
+        verification runs on the service thread in the LIVE lane."""
+        def factory(scheme, pub_poly, n_nodes):
+            return _PartialLaneVerifier(
+                self, inner_factory(scheme, pub_poly, n_nodes))
+        return factory
+
+    @staticmethod
+    def _device_available() -> bool:
+        try:
+            import jax  # noqa: F401
+            return True
+        except Exception:
+            return False
+
+    def _make_backend(self, scheme, pk: bytes, kind: str):
+        if kind == "device":
+            from .batch import BatchBeaconVerifier
+            return BatchBeaconVerifier(scheme, pk, pad_to=self.pad,
+                                       sharding=self._device_sharding())
+        from .hostverify import HostBatchVerifier
+        return HostBatchVerifier(scheme, pk)
+
+    def _device_sharding(self):
+        """Persistent round-axis placement, built once and shared by
+        every device backend (the service owns the mesh; per-dispatch
+        mesh construction was pure overhead)."""
+        import jax
+        devs = jax.devices()
+        if len(devs) < 2:
+            return None
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        if self._mesh is None:
+            self._mesh = Mesh(np.array(devs), ("round",))
+        return NamedSharding(self._mesh, PartitionSpec("round"))
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, handle: VerifyHandle, rounds, sigs, prev_sigs=None,
+               lane: str = LANE_BACKGROUND,
+               flush_now: bool = False) -> VerifyFuture:
+        if lane not in self._queues:
+            raise ValueError(f"unknown lane {lane!r}")
+        fut = VerifyFuture()
+        n = len(rounds)
+        if n == 0:
+            fut.set_result(np.zeros(0, dtype=bool))
+            return fut
+        req = _Request("batch", lane, fut, self.clock.monotonic(),
+                       key=handle.key, backend=handle.backend,
+                       rounds=list(rounds), sigs=list(sigs),
+                       prevs=list(prev_sigs) if prev_sigs is not None
+                       else [None] * n, flush=flush_now)
+        self._enqueue(req)
+        return fut
+
+    def submit_call(self, fn: Callable, lane: str = LANE_LIVE) -> VerifyFuture:
+        """Opaque device work (e.g. a partial-aggregation RLC block) that
+        participates in the lanes and preemption but not the coalescer."""
+        fut = VerifyFuture()
+        req = _Request("call", lane, fut, self.clock.monotonic(), fn=fn)
+        self._enqueue(req)
+        return fut
+
+    def _enqueue(self, req: _Request) -> None:
+        from ..metrics import verify_queue_depth, verify_requests
+        with self._cond:
+            if self._stopped:
+                req.future.set_exception(
+                    RuntimeError("verify service stopped"))
+                return
+            self._queues[req.lane].append(req)
+            self._submitted += 1
+            verify_requests.labels(req.lane).inc()
+            verify_queue_depth.labels(req.lane).set(
+                len(self._queues[req.lane]))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="verify-service")
+                self._thread.start()
+            self._cond.notify_all()
+
+    # -- scheduler -----------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                return
+            self._execute(batch)
+
+    # Real-seconds ceiling on coalescing waits: the window runs on the
+    # injected clock (deterministic under FakeClock), but a daemon wired
+    # to a clock that never advances must not hold verification hostage —
+    # after this much accumulated real cv-wait the batch flushes anyway.
+    REAL_FLUSH_CAP = 5.0
+
+    def _next_batch(self) -> Optional[_Batch]:
+        """Block until a batch is ready: live work flushes immediately,
+        background work may wait out its coalescing window to fill.  The
+        whole lane queue is scanned, not just its head — one chain's
+        unexpired window must not head-of-line-block another chain's
+        dispatch-ready batch (multi-beacon daemons share one service)."""
+        waited = 0.0        # accumulated real cv-wait towards the cap
+        with self._cond:
+            while True:
+                if self._stopped:
+                    return None
+                if self._queues[LANE_LIVE]:
+                    lane = LANE_LIVE
+                elif self._queues[LANE_BACKGROUND]:
+                    lane = LANE_BACKGROUND
+                else:
+                    self._cond.wait(0.1)
+                    waited = 0.0
+                    continue
+                chosen, next_flush = self._pick_ready_locked(lane, waited)
+                if chosen is None:
+                    # every queued chain is inside its window and under
+                    # pad: cv-wait until the earliest flush deadline, with
+                    # a real-time bound so a FakeClock advance is observed
+                    # promptly; only an actual timeout counts toward the
+                    # frozen-clock flush cap
+                    step = min(max(next_flush - self.clock.monotonic(),
+                                   0.001), 0.05)
+                    if not self._cond.wait(step):
+                        waited += step
+                    continue
+                return self._gather_locked(lane, chosen)
+
+    def _pick_ready_locked(self, lane: str, waited: float):
+        """First dispatch-ready request in `lane` FIFO order, plus the
+        earliest flush deadline when none is ready.  Ready = an opaque
+        call, a chain whose coalesced fill reaches the pad, an expired
+        window, or the accumulated real-wait cap.  Caller holds the lock."""
+        window = self.windows[lane]
+        now = self.clock.monotonic()
+        fills: Dict[Tuple, int] = {}
+        for ln in LANES:
+            for r in self._queues[ln]:
+                if r.kind == "batch":
+                    fills[r.key] = fills.get(r.key, 0) + r.n
+        next_flush = None
+        for r in self._queues[lane]:
+            if r.kind == "call" or r.flush or window <= 0 \
+                    or fills[r.key] >= self.pad \
+                    or now >= r.enqueued + window \
+                    or waited >= self.REAL_FLUSH_CAP:
+                return r, None
+            flush_at = r.enqueued + window
+            if next_flush is None or flush_at < next_flush:
+                next_flush = flush_at
+        return None, next_flush
+
+    def _try_next(self, lane: str) -> Optional[_Batch]:
+        """Non-blocking, no window: the preemption path's grab."""
+        with self._cond:
+            if self._stopped or not self._queues[lane]:
+                return None
+            return self._gather_locked(lane, self._queues[lane][0])
+
+    def _gather_locked(self, lane: str, head: _Request) -> _Batch:
+        """Pop `head` plus every same-chain batch request from BOTH lanes
+        (they ride the same dispatch for free).  Caller-holds-lock helper:
+        every call site sits inside `with self._cond` (same shape as
+        sqlitedb._fill_previous).
+        """
+        from ..metrics import verify_queue_depth
+        if head.kind == "call":
+            self._queues[lane].remove(head)
+            verify_queue_depth.labels(lane).set(len(self._queues[lane]))
+            return _Batch(lane, call=head)
+        requests = []
+        for ln in (lane,) + tuple(l for l in LANES if l != lane):
+            keep: deque = deque()
+            for r in self._queues[ln]:
+                if r is head or (r.kind == "batch" and r.key == head.key):
+                    requests.append(r)
+                else:
+                    keep.append(r)
+            # tpu-vet: disable=lock  (caller holds self._cond, see docstring)
+            self._queues[ln] = keep
+            verify_queue_depth.labels(ln).set(len(keep))
+        return _Batch(lane, backend=head.backend, requests=requests)
+
+    # -- execution (service thread, outside the lock) -------------------------
+
+    def _execute(self, batch: _Batch) -> None:
+        if batch.call is not None:
+            t0 = self.clock.monotonic()
+            try:
+                out = batch.call.fn()
+            except BaseException as e:
+                batch.call.future.set_exception(e)
+            else:
+                batch.call.future.set_result(out)
+            self._account(batch.lane, 1, 1,
+                          self.clock.monotonic() - t0)
+            return
+        try:
+            results = self._run_chunks(batch)
+        except BaseException as e:
+            for r in batch.requests:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return
+        # fan the verdict array back out, one contiguous slice per caller
+        off = 0
+        for r in batch.requests:
+            r.future.set_result(results[off:off + r.n].copy())
+            off += r.n
+
+    def _run_chunks(self, batch: _Batch) -> np.ndarray:
+        rounds: List = []
+        sigs: List = []
+        prevs: List = []
+        for r in batch.requests:
+            rounds.extend(r.rounds)
+            sigs.extend(r.sigs)
+            prevs.extend(r.prevs)
+        n = len(rounds)
+        spans = [(lo, min(lo + self.pad, n)) for lo in range(0, n, self.pad)]
+        results = np.empty(n, dtype=bool)
+        backend = batch.backend
+        if hasattr(backend, "pack_chunk"):
+            self._run_pipelined(batch, backend, rounds, sigs, prevs, spans,
+                                results)
+        else:
+            for lo, hi in spans:
+                self._maybe_preempt(batch)
+                t0 = self.clock.monotonic()
+                results[lo:hi] = backend.verify_batch(
+                    rounds[lo:hi], sigs[lo:hi], prevs[lo:hi])
+                self._account(batch.lane, hi - lo, hi - lo,
+                              self.clock.monotonic() - t0)
+        return results
+
+    def _run_pipelined(self, batch, backend, rounds, sigs, prevs, spans,
+                       results) -> None:
+        """Device path: host packing of chunk k+1 overlaps device compute
+        of chunk k (the verify_stream double buffer, generalized to every
+        caller), with the preemption check at each chunk boundary."""
+        packer = self._ensure_packer()
+        pad_width = max(self.pad, getattr(backend, "pad_to", 0) or 0)
+
+        def pack(lo, hi):
+            return lo, hi, backend.pack_chunk(
+                rounds[lo:hi], sigs[lo:hi], prevs[lo:hi])
+
+        def dispatch(item):
+            lo, hi, packed = item
+            t0 = self.clock.monotonic()
+            return lo, hi, packed, backend.dispatch_packed(packed), t0
+
+        def resolve(item):
+            lo, hi, packed, verdict, t0 = item
+            results[lo:hi] = backend.resolve_packed(packed, verdict)
+            self._account(batch.lane, hi - lo, pad_width,
+                          self.clock.monotonic() - t0)
+
+        pending = None
+        inflight: deque = deque()
+        for lo, hi in spans:
+            self._maybe_preempt(batch)
+            nxt = packer.submit(pack, lo, hi)
+            if pending is not None:
+                inflight.append(dispatch(pending.result()))
+                if len(inflight) > 1:
+                    resolve(inflight.popleft())
+            pending = nxt
+        if pending is not None:
+            self._maybe_preempt(batch)
+            inflight.append(dispatch(pending.result()))
+        while inflight:
+            resolve(inflight.popleft())
+
+    def _maybe_preempt(self, batch: _Batch) -> None:
+        """At a chunk boundary of BACKGROUND work, run any queued LIVE
+        work to completion first.  Live batches never preempt, so the
+        recursion depth is bounded at two."""
+        from ..metrics import verify_preemptions
+        if batch.lane == LANE_LIVE:
+            return
+        with self._cond:
+            pending = bool(self._queues[LANE_LIVE])
+            if pending:
+                self._preemptions += 1
+        if not pending:
+            return
+        verify_preemptions.inc()
+        while True:
+            live = self._try_next(LANE_LIVE)
+            if live is None:
+                return
+            self._execute(live)
+
+    def _ensure_packer(self):
+        if self._packer is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._packer = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="verify-pack")
+        return self._packer
+
+    def _account(self, lane: str, lanes: int, slots: int,
+                 elapsed: float) -> None:
+        from ..metrics import (verify_dispatch_latency, verify_dispatches,
+                               verify_fill_ratio)
+        verify_dispatches.labels(lane).inc()
+        verify_fill_ratio.observe(lanes / max(1, slots))
+        verify_dispatch_latency.labels(lane).observe(max(0.0, elapsed))
+        with self._cond:
+            self._dispatches += 1
+            self._dispatch_lanes += lanes
+            self._dispatch_slots += slots
+
+    # -- observability / lifecycle -------------------------------------------
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "submitted": self._submitted,
+                "dispatches": self._dispatches,
+                "preemptions": self._preemptions,
+                "fill_ratio": (self._dispatch_lanes /
+                               self._dispatch_slots
+                               if self._dispatch_slots else 0.0),
+                # raw accumulators so callers can delta a measured window
+                # (bench config 6) instead of blending cold+warm runs
+                "dispatch_lanes": self._dispatch_lanes,
+                "dispatch_slots": self._dispatch_slots,
+                "queue_depth": {ln: len(self._queues[ln]) for ln in LANES},
+            }
+
+    def summary(self) -> str:
+        """One line for /health."""
+        s = self.stats()
+        q = s["queue_depth"]
+        return (f"dispatches={s['dispatches']} requests={s['submitted']} "
+                f"fill={s['fill_ratio']:.2f} preempt={s['preemptions']} "
+                f"queue={q[LANE_LIVE]}/{q[LANE_BACKGROUND]}")
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            drained = [r for ln in LANES for r in self._queues[ln]]
+            for ln in LANES:
+                self._queues[ln] = deque()
+            thread, self._thread = self._thread, None
+            self._cond.notify_all()
+        for r in drained:
+            if not r.future.done():
+                r.future.set_exception(RuntimeError("verify service stopped"))
+        if thread is not None:
+            thread.join(timeout=5)
+        packer, self._packer = self._packer, None
+        if packer is not None:
+            packer.shutdown(wait=False)
+
+
+# -- process-wide singleton ---------------------------------------------------
+#
+# Daemons own a service via Config.verify_service() (bound to the injected
+# clock); standalone consumers (VerifyingClient, a bare SyncManager) share
+# this module-level default.
+
+_global_service: Optional[VerifyService] = None
+_global_lock = threading.Lock()
+
+
+def get_service(**kwargs) -> VerifyService:
+    """The process-default service, created on first use."""
+    global _global_service
+    with _global_lock:
+        if _global_service is None:
+            _global_service = VerifyService(**kwargs)
+        return _global_service
+
+
+def set_service(service: Optional[VerifyService]) -> Optional[VerifyService]:
+    """Install (or clear) the process-default service; returns the old
+    one.  Daemon wiring and tests use this."""
+    global _global_service
+    with _global_lock:
+        old, _global_service = _global_service, service
+        return old
+
+
+def current_service() -> Optional[VerifyService]:
+    """The installed default, or None — never creates one (health probes
+    must not spin up a worker as a side effect)."""
+    with _global_lock:
+        return _global_service

@@ -28,6 +28,30 @@ from .metrics import api_call_counter, http_latency
 LONG_POLL_TIMEOUT = 60.0
 
 
+def _beacon_etag(b: Beacon) -> str:
+    """Strong ETag for an immutable round: every node of a chain serves
+    identical bytes for round N, so hashing (round, signature) gives a
+    validator that is stable across the whole edge tier."""
+    import hashlib
+    h = hashlib.sha256(b.round.to_bytes(8, "big") + bytes(b.signature))
+    return '"' + h.hexdigest()[:32] + '"'
+
+
+def _etag_matches(if_none_match: str, etag: str) -> bool:
+    """RFC 9110 If-None-Match: weak comparison (a CDN may weaken our
+    strong tag, e.g. after content-coding — `W/"x"` matches `"x"`), and
+    `*` matches any current representation."""
+    if if_none_match.strip() == "*":
+        return True
+    for tok in if_none_match.split(","):
+        tok = tok.strip()
+        if tok.startswith("W/"):
+            tok = tok[2:]
+        if tok == etag:
+            return True
+    return False
+
+
 def _beacon_json(b: Beacon) -> bytes:
     obj = {"round": b.round, "randomness": b.randomness().hex(),
            "signature": b.signature.hex()}
@@ -125,7 +149,9 @@ class RestServer:
             def do_GET(self):
                 t0 = time.perf_counter()
                 try:
-                    code, body, headers = outer._route(self.path)
+                    code, body, headers = outer._route(
+                        self.path,
+                        if_none_match=self.headers.get("If-None-Match"))
                 except Exception as e:
                     code, body, headers = 500, str(e).encode(), {}
                 self.send_response(code)
@@ -158,7 +184,7 @@ class RestServer:
             bh.ensure_callback()
             return bh
 
-    def _route(self, path: str):
+    def _route(self, path: str, if_none_match: Optional[str] = None):
         parts = [p for p in path.split("/") if p]
         if parts == ["health"]:
             return self._health()
@@ -189,8 +215,15 @@ class RestServer:
             beacon = self._bh(bp).get(round_, info)
             if beacon is None:
                 return 404, b'{"error":"round not available"}', {}
-            return 200, _beacon_json(beacon), self._cache_headers(
-                info, beacon.round, latest=(round_ == 0))
+            headers = self._cache_headers(info, beacon,
+                                          latest=(round_ == 0))
+            etag = headers.get("ETag")
+            if etag is not None and if_none_match is not None \
+                    and _etag_matches(if_none_match, etag):
+                # revalidation hit: immutable rounds never change, so the
+                # edge answers 304 without re-serializing the beacon
+                return 304, b"", headers
+            return 200, _beacon_json(beacon), headers
         return 404, b'{"error":"no such route"}', {}
 
     def _health(self):
@@ -208,17 +241,37 @@ class RestServer:
                                      info.genesis_time)
             if head >= expected - 1:
                 status = 200
-        body = json.dumps({"status": status == 200, "current": head,
-                           "expected": expected}).encode()
+        payload = {"status": status == 200, "current": head,
+                   "expected": expected}
+        # one-line verify-service summary: the daemon-owned service when
+        # one exists, else the process default (never create one here)
+        svc = None
+        if bp is not None:
+            svc = getattr(getattr(bp, "cfg", None), "_verify_service", None)
+        if svc is None:
+            from .crypto.verify_service import current_service
+            svc = current_service()
+        if svc is not None:
+            payload["verify"] = svc.summary()
+        body = json.dumps(payload).encode()
         return status, body, {}
 
-    def _cache_headers(self, info, round_: int, latest: bool) -> dict:
-        """CDN `Expires` at the next round boundary (server.go headers)."""
+    def _cache_headers(self, info, beacon: Beacon, latest: bool) -> dict:
+        """CDN headers (server.go headers + ROADMAP item 5a edge tier).
+
+        `latest` expires at the next round boundary.  A numbered round is
+        IMMUTABLE — same bytes forever on every node of the chain — so it
+        gets a strong, deterministic `ETag` (derived from the signature,
+        which the round's bytes commit to) plus `immutable` cache
+        control: a CDN revalidates with If-None-Match and gets a bodyless
+        304 instead of re-fetching the beacon."""
         if latest:
-            nxt = time_of_round(info.period, info.genesis_time, round_ + 1)
+            nxt = time_of_round(info.period, info.genesis_time,
+                                beacon.round + 1)
             return {"Expires": formatdate(nxt, usegmt=True),
                     "Cache-Control": f"public, max-age={info.period}"}
-        return {"Cache-Control": "public, max-age=604800, immutable"}
+        return {"Cache-Control": "public, max-age=604800, immutable",
+                "ETag": _beacon_etag(beacon)}
 
     # -- lifecycle -----------------------------------------------------------
 

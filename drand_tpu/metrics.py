@@ -114,6 +114,35 @@ batch_verify_rounds = Counter(
 batch_verify_seconds = Histogram(
     "tpu_batch_verify_seconds", "Device batch-verify wall time",
     ["scheme"], registry=PRIVATE)
+# Resident verify service (crypto/verify_service.py): every verify
+# consumer submits through one daemon-owned pipeline; these series answer
+# "is coalescing working" (fill ratio up, dispatches well below requests)
+# and "are live rounds starved" (live queue depth, preemption count).
+verify_requests = Counter(
+    "verify_service_requests_total",
+    "Verification submissions accepted by the verify service",
+    ["lane"], registry=PRIVATE)
+verify_dispatches = Counter(
+    "verify_service_dispatches_total",
+    "Device/host dispatches issued by the verify service",
+    ["lane"], registry=PRIVATE)
+verify_queue_depth = Gauge(
+    "verify_service_queue_depth",
+    "Requests waiting in a verify-service lane", ["lane"],
+    registry=PRIVATE)
+verify_fill_ratio = Histogram(
+    "verify_service_batch_fill_ratio",
+    "Real lanes / padded width per coalesced dispatch",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+    registry=PRIVATE)
+verify_dispatch_latency = Histogram(
+    "verify_service_dispatch_latency_seconds",
+    "Dispatch-to-verdict wall time per coalesced chunk", ["lane"],
+    registry=PRIVATE)
+verify_preemptions = Counter(
+    "verify_service_preemptions_total",
+    "Background batches preempted at a chunk boundary by live work",
+    registry=PRIVATE)
 
 
 def scrape(which: str = "group") -> bytes:

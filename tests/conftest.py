@@ -145,20 +145,50 @@ if os.environ.get("PALLAS_AXON_POOL_IPS"):
     clear_backends()
 
 
-# Device-kernel files cold-compile for many minutes per program (no
-# persistent cache on CPU — see above).  Run them LAST so a time-bounded
-# run still exercises the whole framework first.
-_HEAVY = ("test_batch", "test_multichip", "test_ops_curve_pairing",
-          "test_partials", "test_ops_pallas",
-          # the one integrity test that runs the DEVICE verifier: ordered
-          # into the heavy bucket (after test_batch, which compiles the
-          # same pad-8 RLC pipeline) so a cold XLA cache can't stall the
-          # fast group
-          "test_chain_doctor_scan_clean_uses_device_verifier")
+# Device-kernel files cold-compile for many minutes per program.  Run
+# them LAST so a time-bounded run still exercises the whole framework
+# first — and mark them out of the tier-1 budget entirely (below).
+# Matched by exact file stem / exact test name (NOT nodeid substring:
+# now that a match deselects from tier-1 rather than just reordering, a
+# future tests/test_batching.py must not silently vanish from the gate).
+_HEAVY_FILES = {"test_batch", "test_batch_sign", "test_multichip",
+                "test_ops_curve_pairing", "test_partials",
+                "test_ops_pallas", "test_ops_pallas_pairing"}
+# the one integrity test that runs the DEVICE verifier: ordered into the
+# heavy bucket (after test_batch, which compiles the same pad-8 RLC
+# pipeline) so a cold XLA cache can't stall the fast group
+_HEAVY_TESTS = {"test_chain_doctor_scan_clean_uses_device_verifier"}
+
+
+def _is_heavy(item) -> bool:
+    return item.path.stem in _HEAVY_FILES \
+        or item.name.split("[")[0] in _HEAVY_TESTS
 
 
 def pytest_collection_modifyitems(config, items):
-    items.sort(key=lambda it: any(h in it.nodeid for h in _HEAVY))
+    """Order the heavy compile-bound bucket last AND gate it structurally
+    (ROADMAP "known friction", ISSUE 6 satellite): on the 2-core no-TPU
+    container a cold XLA cache costs tens of minutes for the big pairing
+    programs, which blew the tier-1 870 s budget (rc=124) on every run
+    where the persistent cache above was cold or invalidated (any edit
+    that shifts lines in a traced file rewrites the Mosaic cache keys).
+    The heavy bucket is therefore auto-marked `slow` + `heavy_compile`:
+    tier-1 (`-m 'not slow'`) stays green and budget-bound, while the
+    device pipelines keep their coverage via
+
+      * naming a file directly (`pytest tests/test_batch.py` — no -m
+        filter, everything runs; the "pass standalone" workflow),
+      * `pytest -m heavy_compile tests/` (just the device bucket), or
+      * DRAND_TPU_RUN_HEAVY=1 (suppresses the auto-`slow` mark so a
+        nightly/driver run with a warm cache exercises everything).
+    """
+    items.sort(key=_is_heavy)
+    run_heavy = os.environ.get("DRAND_TPU_RUN_HEAVY", "0") == "1"
+    for it in items:
+        if _is_heavy(it):
+            it.add_marker(pytest.mark.heavy_compile)
+            if not run_heavy:
+                it.add_marker(pytest.mark.slow)
 
 
 # XLA's CPU compiler recurses deeply on the big scan/pairing programs.

@@ -1,0 +1,10 @@
+"""Negative case for the verifier checker: this file's rel starts with
+crypto/, the package that owns the device pipelines — direct
+construction here is the sanctioned internal path and must NOT be
+flagged."""
+
+from drand_tpu.crypto.batch import BatchBeaconVerifier
+
+
+def service_internal_construction(scheme, pk):
+    return BatchBeaconVerifier(scheme, pk, pad_to=8192)     # allowed

@@ -1,0 +1,39 @@
+"""Seeded verifier-discipline violations (tests/test_vet.py fixture).
+
+This file's rel has no crypto/ prefix, so every direct
+BatchBeaconVerifier construction below must be flagged; the crypto/
+sibling fixture (crypto/verifier_ok.py) proves the exemption."""
+
+from drand_tpu.crypto.batch import BatchBeaconVerifier
+from drand_tpu.crypto import batch
+from drand_tpu.crypto.batch import BatchBeaconVerifier as BBV
+
+
+def direct_construction(scheme, pk):
+    return BatchBeaconVerifier(scheme, pk)          # VIOLATION
+
+
+def module_attr_construction(scheme, pk):
+    return batch.BatchBeaconVerifier(scheme, pk, pad_to=8192)   # VIOLATION
+
+
+def aliased_construction(scheme, pk):
+    return BBV(scheme, pk)                          # VIOLATION: alias
+
+
+def service_route_is_fine(scheme, pk):
+    # the sanctioned path: NOT flagged
+    from drand_tpu.crypto.verify_service import get_service
+    return get_service().handle(scheme, pk)
+
+
+def host_fallback_is_fine(scheme, pk):
+    # HostBatchVerifier is the jax-free fallback, not the device pipeline:
+    # NOT flagged
+    from drand_tpu.crypto.hostverify import HostBatchVerifier
+    return HostBatchVerifier(scheme, pk)
+
+
+def suppressed(scheme, pk):
+    # tpu-vet: disable=verifier
+    return BatchBeaconVerifier(scheme, pk)

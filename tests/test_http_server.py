@@ -88,6 +88,64 @@ def test_public_round_and_latest(served):
     assert obj2 == obj
 
 
+def test_immutable_round_etag_and_304(served):
+    """ROADMAP 5a edge win: immutable rounds carry a strong deterministic
+    ETag + immutable cache-control, and If-None-Match revalidation gets a
+    bodyless 304."""
+    sc, server, _ = served
+    obj, headers = _get(server, "/public/1")
+    etag = headers.get("ETag")
+    assert etag and etag.startswith('"') and etag.endswith('"')
+    assert "immutable" in headers.get("Cache-Control", "")
+    assert "max-age=" in headers.get("Cache-Control", "")
+    # same round, same ETag (deterministic across requests/nodes)
+    _, headers2 = _get(server, "/public/1")
+    assert headers2.get("ETag") == etag
+    # conditional request: 304, empty body, ETag still present
+    url = f"http://127.0.0.1:{server.port}/public/1"
+    req = urllib.request.Request(url, headers={"If-None-Match": etag})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=10)
+    assert e.value.code == 304
+    assert e.value.headers.get("ETag") == etag
+    # weak comparison (RFC 9110): a CDN-weakened validator and `*` still
+    # revalidate to 304
+    for inm in (f"W/{etag}", "*", f'"zzz", {etag}'):
+        req = urllib.request.Request(url, headers={"If-None-Match": inm})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 304, inm
+    # a stale/mismatched validator still gets the full body
+    req = urllib.request.Request(url, headers={"If-None-Match": '"nope"'})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert json.loads(r.read())["round"] == 1
+    # `latest` is mutable: no ETag, Expires instead
+    _, lheaders = _get(server, "/public/latest")
+    assert "ETag" not in lheaders
+
+
+def test_health_includes_verify_service_summary(served):
+    """/health carries the one-line verify-service summary when the
+    process has a service installed."""
+    from drand_tpu.beacon.clock import FakeClock
+    from drand_tpu.crypto.verify_service import VerifyService, set_service
+
+    svc = VerifyService(clock=FakeClock(0.0))
+    old = set_service(svc)
+    try:
+        url = f"http://127.0.0.1:{served[1].port}/health"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                body = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            body = json.loads(e.read())
+        assert "verify" in body
+        assert "dispatches=" in body["verify"]
+    finally:
+        set_service(old)
+        svc.stop()
+
+
 def test_future_round_404(served):
     _, server, _ = served
     with pytest.raises(urllib.error.HTTPError) as e:

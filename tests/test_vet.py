@@ -164,6 +164,24 @@ def test_store_checker_catches_fixture():
     assert sum("ForeignConnCursor" in m for m in msgs) == 1
 
 
+def test_verifier_checker_catches_fixture():
+    report = _fixture_report("verifier")
+    codes = _codes(report, "verifier_bad.py")
+    assert ("verifier_bad.py", "verifier-direct-construction") in codes
+    lines = {f.line for f in report.findings
+             if f.path == "verifier_bad.py"}
+    # direct, module-attr and aliased constructions are all caught
+    assert len(lines) == 3, sorted(lines)
+    msgs = "\n".join(f.message for f in report.findings)
+    # the service route and the host fallback are NOT flagged
+    assert "get_service" not in msgs
+    assert "HostBatchVerifier" not in msgs
+    assert len([f for f in report.suppressed
+                if f.path == "verifier_bad.py"]) == 1
+    # crypto/-prefixed modules own the pipelines: exempt
+    assert not any(f.path.startswith("crypto/") for f in report.findings)
+
+
 def test_all_fixture_violations_found_by_full_run():
     """One full-corpus run: every checker contributes findings (no
     checker silently stopped matching its fixture)."""
@@ -311,7 +329,8 @@ def test_cli_write_baseline_then_clean(tmp_path, capsys):
 
 
 def test_checker_registry_names_are_suppression_tokens():
-    assert checker_names() == ["clock", "lock", "secret", "trace", "store"]
-    assert len(ALL_CHECKERS) == 5
+    assert checker_names() == ["clock", "lock", "secret", "trace", "store",
+                               "verifier"]
+    assert len(ALL_CHECKERS) == 6
     with pytest.raises(KeyError):
         by_names(["not-a-checker"])
