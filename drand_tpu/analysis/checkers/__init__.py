@@ -7,9 +7,10 @@ from .secrets import SecretChecker
 from .trace import TraceChecker
 from .store import StoreChecker
 from .verifier import VerifierChecker
+from .wait import WaitChecker
 
 ALL_CHECKERS = (ClockChecker, LockChecker, SecretChecker, TraceChecker,
-                StoreChecker, VerifierChecker)
+                StoreChecker, VerifierChecker, WaitChecker)
 
 
 def checker_names():

@@ -1,0 +1,68 @@
+"""wait-discipline: no unbounded blocking waits outside test code.
+
+The failure-domain work (verify-service watchdog, host failover) exists
+because a wedged dependency must cost a deadline, never a hang.  That
+guarantee is only as strong as the weakest wait: one `future.result()`
+with no timeout and a single stuck dispatch freezes its caller forever,
+invisibly.  This checker flags the three stdlib waits that default to
+"forever":
+
+  * ``Future.result()``        (concurrent.futures)
+  * ``Thread.join()``          (threading)
+  * ``Condition.wait()`` / ``Event.wait()``
+
+A call is flagged when it has NO positional argument and NO ``timeout=``
+keyword.  Matching is name-based (``.result()`` / ``.join()`` /
+``.wait()`` with zero arguments): static typing is out of reach for an
+AST pass, but the zero-argument forms of these names are blocking waits
+in practice — ``str.join``/``os.path.join`` always take an argument, and
+a bounded wait always carries one.  Paths that legitimately wait forever
+(a caller whose resolution is guaranteed by a supervising watchdog, a
+shutdown join on a daemon thread) carry a
+``# tpu-vet: disable=wait`` suppression WITH a justification comment.
+
+Test code is exempt: tests wait on work they control, and pytest's own
+timeout machinery bounds them.
+"""
+
+import ast
+from typing import Iterator
+
+from ..core import Finding
+from ..symbols import ModuleInfo
+
+# zero-arg attribute calls that block forever by default
+UNBOUNDED = {"result", "join", "wait"}
+
+
+def _is_test_code(rel: str) -> bool:
+    base = rel.rsplit("/", 1)[-1]
+    return rel.startswith("tests/") or "/tests/" in rel \
+        or base.startswith("test_") or base == "conftest.py"
+
+
+class WaitChecker:
+    name = "wait"
+    description = ("unbounded Future.result()/Thread.join()/"
+                   "Condition.wait() (no timeout) outside test code")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if _is_test_code(module.rel):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr not in UNBOUNDED:
+                continue
+            if node.args or node.keywords:
+                continue        # bounded (or at least parameterized)
+            yield Finding(
+                checker=self.name, code="wait-unbounded",
+                message=(f"unbounded .{func.attr}() — pass a timeout (a "
+                         "wedged dependency must cost a deadline, not a "
+                         "hang) or suppress with a justification naming "
+                         "what guarantees resolution"),
+                path=module.rel, line=node.lineno, col=node.col_offset)

@@ -95,7 +95,8 @@ class ChainStore:
 
     def integrity_scan(self, verifier=None, mode: str = "full",
                        upto: Optional[int] = None, progress=None,
-                       beacon_id: str = "default", chunk: int = 512):
+                       beacon_id: str = "default", chunk: int = 512,
+                       trigger: str = "startup"):
         """Scan the RAW backend (below the decorators — corruption hides
         underneath them) against this chain's scheme + genesis seed.
         Returns a chain.integrity.ScanReport; pair with
@@ -104,8 +105,8 @@ class ChainStore:
         return IntegrityScanner(
             self.backend, self.vault.scheme, verifier=verifier,
             genesis_seed=self.group.get_genesis_seed(), chunk=chunk,
-            beacon_id=beacon_id).scan(mode=mode, upto=upto,
-                                      progress=progress)
+            beacon_id=beacon_id, trigger=trigger).scan(mode=mode, upto=upto,
+                                                       progress=progress)
 
     def wait_for_round(self, round_: int, timeout: float,
                        scheduled_time: bool = False) -> Optional[Beacon]:

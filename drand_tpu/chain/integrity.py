@@ -117,13 +117,19 @@ class IntegrityScanner:
 
     def __init__(self, store, scheme, verifier=None,
                  genesis_seed: Optional[bytes] = None,
-                 chunk: int = DEFAULT_CHUNK, beacon_id: str = "default"):
+                 chunk: int = DEFAULT_CHUNK, beacon_id: str = "default",
+                 trigger: str = "startup"):
         self.store = store
         self.scheme = scheme
         self.verifier = verifier
         self.genesis_seed = genesis_seed
         self.chunk = max(1, chunk)
         self.beacon_id = beacon_id
+        # metrics label: what started this scan (startup | scheduled |
+        # manual) — a daemon rerunning the pass on integrity_scan_interval
+        # or an operator's check-chain RPC must be distinguishable from
+        # the boot-time pass in one scrape
+        self.trigger = trigger
 
     # -- scanning ------------------------------------------------------------
 
@@ -171,7 +177,7 @@ class IntegrityScanner:
                 buf_prevs.clear()
             if unflushed:
                 integrity_beacons_scanned.labels(
-                    self.beacon_id, vkind).inc(unflushed)
+                    self.beacon_id, vkind, self.trigger).inc(unflushed)
                 unflushed = 0
             if progress is not None:
                 progress(done_round, report.upto)
@@ -230,7 +236,8 @@ class IntegrityScanner:
 
         self._reclassify_corrupt_anchors(report, unverified)
         for f in report.findings:
-            integrity_corrupt_found.labels(self.beacon_id, f.kind).inc()
+            integrity_corrupt_found.labels(self.beacon_id, f.kind,
+                                           self.trigger).inc()
         report.findings.sort(key=lambda f: (f.round, f.kind))
         return report
 

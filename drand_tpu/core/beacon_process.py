@@ -71,6 +71,10 @@ class BeaconProcess:
         # bundles that raced ahead of board creation (a peer can start
         # dealing the instant it has the group, before our board is up)
         self._pending_dkg: List[pb.DKGPacket] = []
+        # scheduled background integrity scans (cfg.integrity_scan_interval)
+        self._scan_stop: Optional[threading.Event] = None
+        self._scan_thread: Optional[threading.Thread] = None
+        self._repair_thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
 
     # -- persistence (drand_beacon.go:110-162) ------------------------------
@@ -185,9 +189,14 @@ class BeaconProcess:
             # sync plane / integrity scans below share the BACKGROUND lane
             # of the same service
             verify_svc = self.cfg.verify_service()
+            # device partial verification falls back to the host factory
+            # when the service's failure domain abandons a device call —
+            # live aggregation must survive accelerator loss mid-round
             verifier_factory = verify_svc.partials_factory(
                 device_verifier_factory if self.cfg.use_device_verifier
-                else _host_verifier_factory)
+                else _host_verifier_factory,
+                fallback_factory=(_host_verifier_factory
+                                  if self.cfg.use_device_verifier else None))
             self.monitor = ThresholdMonitor(self.beacon_id, self.log,
                                             self.group.threshold)
             self.monitor.start()
@@ -230,7 +239,9 @@ class BeaconProcess:
                 "startup_integrity must be off|linkage|full, got "
                 f"{self.cfg.startup_integrity!r}")
         if self.cfg.startup_integrity != "off":
-            self._startup_integrity_pass()
+            self._integrity_pass(trigger="startup")
+        if self.cfg.integrity_scan_interval > 0:
+            self._start_scheduled_scans()
         if catchup:
             self.handler.catchup()
         else:
@@ -254,14 +265,20 @@ class BeaconProcess:
         return current_round(now, self.group.period,
                              self.group.genesis_time)
 
-    def _startup_integrity_pass(self) -> None:
-        """Scan the store we just reopened before serving from it
-        (cfg.startup_integrity: linkage | full).  The scan is synchronous
-        — it is the point of the knob — but the repair runs on a daemon
-        thread so unreachable peers can't stall startup past the sync
-        budget; until repair lands the corrupt rounds are quarantined
-        (deleted), which is strictly safer than serving them."""
+    def _integrity_pass(self, trigger: str = "startup") -> None:
+        """Scan the store against its own chain identity
+        (cfg.startup_integrity: linkage | full).  At startup the scan is
+        synchronous — it is the point of the knob — but the repair runs
+        on a daemon thread so unreachable peers can't stall startup past
+        the sync budget; until repair lands the corrupt rounds are
+        quarantined (deleted), which is strictly safer than serving them.
+        Scheduled reruns (`trigger="scheduled"`, cfg.integrity_scan_
+        interval) take the same path on the scan thread: full-mode
+        verification submits through the verify service's BACKGROUND
+        lane, so live partials preempt a scan at every chunk boundary."""
         mode = self.cfg.startup_integrity
+        if mode == "off":
+            mode = "linkage"    # scheduled scans with no startup knob set
         verifier = self.syncm.verifier if mode == "full" else None
         try:
             stored_head = self.handler.chain.last().round
@@ -286,22 +303,23 @@ class BeaconProcess:
         try:
             report = self.handler.chain.integrity_scan(
                 verifier=verifier, mode=mode, upto=stored_head or None,
-                beacon_id=self.beacon_id)
+                beacon_id=self.beacon_id, trigger=trigger)
         except Exception as e:
-            self.log.error("startup integrity scan failed", err=str(e))
+            self.log.error("integrity scan failed", trigger=trigger,
+                           err=str(e))
             return
         if report.clean:
-            self.log.info("startup integrity scan clean",
+            self.log.info("integrity scan clean", trigger=trigger,
                           mode=mode, scanned=report.scanned)
             return
         faulty = report.faulty_rounds
         shown = ",".join(str(r) for r in faulty[:20])
         if len(faulty) > 20:
             shown += f",+{len(faulty) - 20} more"
-        self.log.warn("startup integrity scan found corruption; "
+        self.log.warn("integrity scan found corruption; "
                       "quarantining and re-fetching from peers",
-                      mode=mode, findings=len(report.findings),
-                      rounds=shown)
+                      trigger=trigger, mode=mode,
+                      findings=len(report.findings), rounds=shown)
         # quarantine SYNCHRONOUSLY — the docstring's guarantee is that a
         # known-corrupt round is never served, so the deletes cannot wait
         # for the repair thread (a peer could sync the bad row in that
@@ -309,7 +327,8 @@ class BeaconProcess:
         # rows are skipped without double-counting the metric.
         from ..chain.integrity import IntegrityScanner
         IntegrityScanner(self.handler.chain.backend, self.syncm.scheme,
-                         beacon_id=self.beacon_id).quarantine(report)
+                         beacon_id=self.beacon_id,
+                         trigger=trigger).quarantine(report)
 
         def repair():
             try:
@@ -317,8 +336,11 @@ class BeaconProcess:
                     self.handler.chain.backend, report,
                     peers=self._peers(), beacon_id=self.beacon_id)
             except Exception as e:
-                self.log.error("startup integrity repair failed", err=str(e))
+                self.log.error("integrity repair failed", err=str(e))
                 return
+            finally:
+                with self._lock:
+                    self._repair_thread = None
             if remaining:
                 self.log.error("integrity repair incomplete; rounds remain "
                                "quarantined",
@@ -327,8 +349,55 @@ class BeaconProcess:
                 self.log.info("integrity repair complete",
                               repaired=len(report.faulty_rounds))
 
-        threading.Thread(target=repair, daemon=True,
-                         name=f"integrity-repair-{self.beacon_id}").start()
+        # one repair in flight at a time: a SCHEDULED pass that re-finds
+        # the same quarantined rounds while peers are unreachable must not
+        # stack another heal() (each retries under a multi-minute sync
+        # budget — unbounded thread growth and duplicated peer traffic)
+        with self._lock:
+            if self._repair_thread is not None \
+                    and self._repair_thread.is_alive():
+                self.log.warn("integrity repair already in flight; "
+                              "scan findings left for it", trigger=trigger)
+                return
+            self._repair_thread = threading.Thread(
+                target=repair, daemon=True,
+                name=f"integrity-repair-{self.beacon_id}")
+            self._repair_thread.start()
+
+    def _start_scheduled_scans(self) -> None:
+        """Rerun the integrity pass every cfg.integrity_scan_interval
+        seconds on the daemon clock (ROADMAP item 6: scans must not be a
+        startup-only event — at-rest corruption happens while serving
+        too).  Full-mode verification rides the verify service's
+        BACKGROUND lane, so a scan never starves live partials.  Each
+        pass re-walks the whole chain; the last-clean-round watermark
+        that would make this O(delta) is the ROADMAP "scan resumability"
+        follow-up."""
+        with self._lock:
+            if self._scan_thread is not None:
+                return
+            interval = self.cfg.integrity_scan_interval
+            self._scan_stop = stop = threading.Event()
+
+        def loop():
+            while True:
+                if not self.clock.wait_until(self.clock.now() + interval,
+                                             stop):
+                    return      # stopped
+                if stop.is_set() or self.handler is None \
+                        or self.syncm is None:
+                    return      # beacon stopped under us
+                try:
+                    self._integrity_pass(trigger="scheduled")
+                except Exception as e:
+                    self.log.error("scheduled integrity scan failed",
+                                   err=str(e))
+
+        with self._lock:
+            self._scan_thread = threading.Thread(
+                target=loop, daemon=True,
+                name=f"integrity-scan-{self.beacon_id}")
+            self._scan_thread.start()
 
     def _metrics_callback(self, b: Beacon) -> None:
         last_beacon_round.labels(self.beacon_id).set(b.round)
@@ -343,6 +412,9 @@ class BeaconProcess:
 
     def stop(self) -> None:
         with self._lock:
+            if self._scan_stop is not None:
+                self._scan_stop.set()
+                self._scan_thread = None
             if self.syncm is not None:
                 self.syncm.stop()
             if self.handler is not None:

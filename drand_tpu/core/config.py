@@ -55,6 +55,14 @@ class Config:
     # for co-riders before flushing; live work always flushes immediately.
     verify_pad: int = 8192
     verify_window: float = 0.02
+    # device failure domain (verify_service watchdog/failover/probe):
+    # watchdog deadline = max(floor, factor * observed p99 dispatch
+    # latency); the probe interval rate-limits the canary that re-promotes
+    # a degraded device backend.  0 = module default (itself overridable
+    # via DRAND_VERIFY_WATCHDOG_FACTOR / DRAND_VERIFY_WATCHDOG_FLOOR /
+    # DRAND_VERIFY_PROBE_INTERVAL).
+    verify_watchdog_factor: float = 0.0
+    verify_probe_interval: float = 0.0
     _verify_service: Optional[object] = field(default=None, init=False,
                                               repr=False, compare=False)
     # startup chain-integrity pass (chain/integrity.py): "off" trusts the
@@ -64,6 +72,12 @@ class Config:
     # Corrupt rounds found are quarantined and re-fetched from peers in
     # the background (SyncManager.heal, under the sync budget).
     startup_integrity: str = "off"       # off | linkage | full
+    # scheduled background integrity scans (ROADMAP item 6): rerun the
+    # startup-style pass every N seconds on the daemon clock, submitting
+    # verification through the service's BACKGROUND lane so live partials
+    # preempt it at chunk boundaries.  0 = disabled.  The scheduled pass
+    # uses the startup_integrity mode ("linkage" when that is "off").
+    integrity_scan_interval: float = 0.0
     # resilience layer (net/resilience.py; every default is additionally
     # env-overridable there: DRAND_RETRY_*, DRAND_BREAKER_*, DRAND_SYNC_BUDGET)
     retry_max_attempts: int = 0          # 0 = module default
@@ -102,7 +116,9 @@ class Config:
             from ..crypto.verify_service import VerifyService
             self._verify_service = VerifyService(
                 clock=self.clock, pad=self.verify_pad,
-                background_window=self.verify_window)
+                background_window=self.verify_window,
+                watchdog_factor=self.verify_watchdog_factor or None,
+                probe_interval=self.verify_probe_interval or None)
         return self._verify_service
 
     def stop_verify_service(self) -> None:

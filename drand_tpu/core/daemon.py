@@ -470,7 +470,7 @@ class ControlService:
             try:
                 result["report"] = bp.handler.chain.integrity_scan(
                     verifier=bp.syncm.verifier, mode="full", upto=upto,
-                    beacon_id=bp.beacon_id,
+                    beacon_id=bp.beacon_id, trigger="manual",
                     progress=lambda c, t: events.put((c, t)))
             except Exception as e:
                 result["error"] = e

@@ -628,17 +628,20 @@ class BatchBeaconVerifier:
         # ~1 RPC latency + readback per chunk of pure serial stall).
         from collections import deque
         inflight = deque()
+        # pack is in-process numpy + native hash-to-field — minutes of
+        # silence means the process is wedged, not slow; bound the wait
+        pack_timeout = 600.0
         with ThreadPoolExecutor(max_workers=1) as ex:
             pending = None
             for chunk in chunks():
                 nxt = ex.submit(pack, chunk)
                 if pending is not None:
-                    inflight.append(dispatch(pending.result()))
+                    inflight.append(dispatch(pending.result(pack_timeout)))
                     if len(inflight) > 1:
                         yield resolve(inflight.popleft())
                 pending = nxt
             if pending is not None:
-                inflight.append(dispatch(pending.result()))
+                inflight.append(dispatch(pending.result(pack_timeout)))
             while inflight:
                 yield resolve(inflight.popleft())
 

@@ -32,6 +32,21 @@ instead of few full ones.  This module centralizes dispatch:
   * **Host fallback.**  `crypto.hostverify.HostBatchVerifier` rides
     behind the same submit API (`device=False`), so jax-free callers
     keep working and still benefit from the lanes and the coalescer.
+  * **Device failure domain.**  Centralizing dispatch made one wedged
+    or vanished accelerator a single point of failure for every
+    consumer at once (bench round r04: 0 r/s, chip unreachable; the
+    beacon-client security review arXiv:2109.11677 names exactly this
+    — a healthy consensus core starved by an unsupervised internal
+    dependency — as the dominant real-world beacon failure mode).  So
+    the service supervises itself: every dispatch carries a watchdog
+    deadline derived from the service's own latency history; a
+    dispatch that blows it or raises marks the backend *suspect*, is
+    retried once, and on a second strike the handle's backend is
+    atomically swapped to the host fallback — with every in-flight and
+    queued request REQUEUED, never failed (coalesced callers must not
+    see an exception caused by someone else's chunk).  A rate-limited
+    canary probe re-promotes the device backend when it answers again:
+    `healthy → suspect → degraded → probing → healthy`.
 
 Consumers hold a `VerifyHandle` (from `VerifyService.handle`) exposing
 the familiar `verify_batch(rounds, sigs, prev_sigs) -> bool array`
@@ -43,6 +58,7 @@ This module imports no jax at module scope: device backends are built
 lazily on first device-handle request.
 """
 
+import os
 import threading
 from collections import deque
 from concurrent.futures import Future
@@ -58,10 +74,48 @@ DEFAULT_PAD = 8192          # the canonical batch width bench.py standardized
 DEFAULT_BG_WINDOW = 0.02    # seconds a background batch may wait to fill
 DEFAULT_LIVE_WINDOW = 0.0   # live work flushes immediately
 
+# Failure-domain knobs (Config.verify_watchdog_factor / verify_probe_interval
+# override per daemon; the env vars override the module defaults the same way
+# net/resilience.py's DRAND_RETRY_* family does).  The deadline for a device
+# dispatch is max(FLOOR, FACTOR * observed p99 of this service's own dispatch
+# latencies): the factor keeps a healthy-but-slow chip off the trip wire, the
+# floor covers cold XLA compiles, which are minutes-scale and look exactly
+# like a hang to anything less patient.
+DEFAULT_WATCHDOG_FACTOR = float(
+    os.environ.get("DRAND_VERIFY_WATCHDOG_FACTOR", "8"))
+DEFAULT_WATCHDOG_FLOOR = float(
+    os.environ.get("DRAND_VERIFY_WATCHDOG_FLOOR", "120"))
+DEFAULT_PROBE_INTERVAL = float(
+    os.environ.get("DRAND_VERIFY_PROBE_INTERVAL", "5"))
+
+# Backend failover states (the verify_service_backend_state gauge values).
+STATE_HEALTHY = "healthy"
+STATE_SUSPECT = "suspect"
+STATE_DEGRADED = "degraded"
+STATE_PROBING = "probing"
+_STATE_CODE = {STATE_HEALTHY: 0, STATE_SUSPECT: 1, STATE_DEGRADED: 2,
+               STATE_PROBING: 3}
+
 # the submit API's future type: the stdlib one — set_result/set_exception/
 # result(timeout)/done() are exactly the contract the service needs, and
 # callers get cancellation/done-callbacks for free
 VerifyFuture = Future
+
+
+class DeviceFailure(RuntimeError):
+    """A device dispatch was abandoned by the watchdog (hang) or failed
+    its retry; surfaced only where no fallback path exists."""
+
+
+class _Abandoned(Exception):
+    """Internal: the watchdog cancelled this dispatch while it was in
+    flight — the (stale) executing thread must discard its result and
+    never touch the requests' futures."""
+
+
+class _Requeued(Exception):
+    """Internal: this batch's requests were requeued (failover); the
+    executing thread unwinds without resolving any future."""
 
 
 class _Request:
@@ -70,7 +124,7 @@ class _Request:
     internal to `BatchPartialVerifier`)."""
 
     __slots__ = ("kind", "key", "backend", "rounds", "sigs", "prevs", "fn",
-                 "lane", "future", "enqueued", "n", "flush")
+                 "lane", "future", "enqueued", "n", "flush", "retried")
 
     def __init__(self, kind, lane, future, enqueued, key=None, backend=None,
                  rounds=None, sigs=None, prevs=None, fn=None, flush=False):
@@ -86,22 +140,77 @@ class _Request:
         self.fn = fn
         self.n = len(rounds) if rounds is not None else 1
         self.flush = flush          # dispatch-ready: skip the window
+        self.retried = False        # one watchdog-driven requeue spent
 
 
 class _Batch:
     """One coalesced dispatch unit handed to the executor."""
 
-    __slots__ = ("lane", "backend", "requests", "call")
+    __slots__ = ("lane", "backend", "requests", "call", "key", "slot")
 
-    def __init__(self, lane, backend=None, requests=None, call=None):
+    def __init__(self, lane, backend=None, requests=None, call=None,
+                 key=None, slot=None):
         self.lane = lane
         self.backend = backend
         self.requests: List[_Request] = requests or []
         self.call: Optional[_Request] = call
+        self.key = key
+        self.slot = slot
 
     @property
     def n(self) -> int:
         return sum(r.n for r in self.requests)
+
+
+class _Ticket:
+    """One in-flight dispatch under watchdog supervision."""
+
+    __slots__ = ("slot", "batch", "kind", "started", "deadline_at",
+                 "cancelled")
+
+    def __init__(self, slot, batch, kind, started, deadline_at):
+        self.slot = slot
+        self.batch = batch
+        self.kind = kind            # "chunk" | "call" | "probe"
+        self.started = started
+        self.deadline_at = deadline_at
+        self.cancelled = False
+
+
+class _BackendSlot:
+    """Failover state for one handle key: the primary (device) backend,
+    the lazily-built fallback, the state machine, and the dispatch
+    latency history the watchdog deadline derives from."""
+
+    __slots__ = ("key", "label", "primary", "fallback_factory", "fallback",
+                 "state", "latencies", "sample", "failovers", "degraded_at",
+                 "first_fault_at")
+
+    def __init__(self, key, label, primary, fallback_factory=None):
+        self.key = key
+        self.label = label
+        self.primary = primary
+        self.fallback_factory = fallback_factory
+        self.fallback = None
+        self.state = STATE_HEALTHY
+        self.latencies: deque = deque(maxlen=64)
+        # (rounds, sigs, prevs, verdict) of a known-good 1-lane dispatch:
+        # the canary probe replays it and requires the same verdict, so a
+        # poisoned device (answers, but wrongly) cannot re-promote itself
+        self.sample = None
+        self.failovers = 0
+        self.degraded_at = None
+        self.first_fault_at = None
+
+    @property
+    def can_failover(self) -> bool:
+        return self.fallback_factory is not None
+
+    def active(self):
+        if self.state in (STATE_DEGRADED, STATE_PROBING) \
+                and self.fallback is not None:
+            return self.fallback
+        return self.primary
 
 
 class VerifyHandle:
@@ -129,6 +238,12 @@ class VerifyHandle:
         # costs latency per call (and a serial chunk loop — catch-up
         # sync — would pay it per chunk).  flush_now skips the window;
         # already-queued same-chain work still merges at gather time.
+        #
+        # The unbounded result() is deliberate: the failure domain
+        # guarantees resolution — a hung device dispatch is abandoned at
+        # its watchdog deadline and the request requeued to the host
+        # fallback, and stop() fails every still-queued future.
+        # tpu-vet: disable=wait
         return self.submit(rounds, sigs, prev_sigs, lane=lane,
                            flush_now=True).result()
 
@@ -138,17 +253,35 @@ class _PartialLaneVerifier:
     LIVE lane: wraps any inner `.verify(msg, partials)` implementation
     (Device/HostPartialVerifier) so live-round aggregation preempts
     background scans at the next chunk boundary instead of contending
-    for the device ad hoc."""
+    for the device ad hoc.  When a fallback factory is provided, a
+    device failure (watchdog abandon or repeated raise) falls back to
+    the host partial verifier instead of costing the round."""
 
-    def __init__(self, service: "VerifyService", inner):
+    def __init__(self, service: "VerifyService", inner,
+                 fallback_factory: Optional[Callable] = None):
         self.service = service
         self.inner = inner
         self.kind = getattr(inner, "kind", "host")
+        self._fallback_factory = fallback_factory
+        self._fallback = None
 
     def verify(self, msg: bytes, partials):
         fut = self.service.submit_call(
             lambda: self.inner.verify(msg, partials), lane=LANE_LIVE)
-        return fut.result()
+        try:
+            # bounded by the service watchdog + stop(), like verify_batch
+            # tpu-vet: disable=wait
+            return fut.result()
+        except Exception:
+            if self._fallback_factory is None:
+                raise
+            if self._fallback is None:
+                self._fallback = self._fallback_factory()
+            fb = self._fallback
+            fut = self.service.submit_call(
+                lambda: fb.verify(msg, partials), lane=LANE_LIVE)
+            # tpu-vet: disable=wait
+            return fut.result()
 
 
 class VerifyService:
@@ -156,11 +289,21 @@ class VerifyService:
 
     All mutable scheduler state lives under `self._cond`; device/host
     work always executes OUTSIDE the lock on the single service thread,
-    so callers only ever block on their own futures."""
+    so callers only ever block on their own futures.
+
+    The failure domain rides alongside: `_guarded` registers a watchdog
+    ticket around every backend call (an O(1) dict insert on the
+    dispatch path — the watchdog OBSERVES, it never sits between submit
+    and dispatch), the `verify-watchdog` thread trips tickets that blow
+    their deadline, and `verify-probe` canaries degraded backends back
+    to health."""
 
     def __init__(self, clock=None, pad: int = DEFAULT_PAD,
                  live_window: float = DEFAULT_LIVE_WINDOW,
-                 background_window: float = DEFAULT_BG_WINDOW):
+                 background_window: float = DEFAULT_BG_WINDOW,
+                 watchdog_factor: Optional[float] = None,
+                 watchdog_floor: Optional[float] = None,
+                 probe_interval: Optional[float] = None):
         if clock is None:
             # deferred import: crypto must not hard-depend on beacon at
             # module scope (same layering softening as net/resilience.py)
@@ -170,11 +313,18 @@ class VerifyService:
         self.pad = max(1, pad)
         self.windows = {LANE_LIVE: live_window,
                         LANE_BACKGROUND: background_window}
+        self.watchdog_factor = watchdog_factor or DEFAULT_WATCHDOG_FACTOR
+        self.watchdog_floor = watchdog_floor or DEFAULT_WATCHDOG_FLOOR
+        self.probe_interval = probe_interval or DEFAULT_PROBE_INTERVAL
         self._cond = threading.Condition()
         self._queues: Dict[str, deque] = {ln: deque() for ln in LANES}
         self._handles: Dict[Tuple, VerifyHandle] = {}
+        self._slots: Dict[Tuple, _BackendSlot] = {}
+        self._tickets: Dict[int, _Ticket] = {}
         self._mesh = None
         self._thread: Optional[threading.Thread] = None
+        self._watchdog_thread: Optional[threading.Thread] = None
+        self._probe_thread: Optional[threading.Thread] = None
         self._packer = None
         self._stopped = False
         # stats (guarded by _cond; ints so tests need not scrape prom)
@@ -183,14 +333,19 @@ class VerifyService:
         self._dispatch_lanes = 0    # sum of real lanes over all dispatches
         self._dispatch_slots = 0    # sum of padded widths over all dispatches
         self._preemptions = 0
+        self._failovers = 0
+        self._promotions = 0
+        self._watchdog_trips = 0
 
     # -- handles / backends --------------------------------------------------
 
     def handle(self, scheme, public_key_bytes: bytes, device: bool = True,
-               backend=None) -> VerifyHandle:
+               backend=None, fallback=None) -> VerifyHandle:
         """The per-chain submit surface.  `device=False` (or jax being
         unavailable) selects the `HostBatchVerifier` fallback behind the
-        same API; `backend=` injects a custom verifier (tests)."""
+        same API; `backend=` injects a custom verifier (tests/chaos) and
+        `fallback=` its failover target.  Device handles get a lazy
+        `HostBatchVerifier` failover target automatically."""
         pk = bytes(public_key_bytes)
         kind = "custom" if backend is not None else \
             ("device" if device and self._device_available() else "host")
@@ -202,18 +357,38 @@ class VerifyService:
         if backend is None:
             backend = self._make_backend(scheme, pk, kind)
         h = VerifyHandle(self, key, scheme, backend)
+        if fallback is not None:
+            fallback_factory = lambda fb=fallback: fb  # noqa: E731
+        elif kind == "device":
+            def fallback_factory(s=scheme, p=pk):
+                from .hostverify import HostBatchVerifier
+                return HostBatchVerifier(s, p)
+        else:
+            fallback_factory = None     # host handles have nowhere to go
+        slot = _BackendSlot(key, f"{scheme.id}:{pk[:4].hex()}", backend,
+                            fallback_factory)
         with self._cond:
             # two racing builders: first insert wins, both see one handle
             h = self._handles.setdefault(key, h)
+            slot = self._slots.setdefault(key, slot)
+        self._set_state_gauge(slot)
         return h
 
-    def partials_factory(self, inner_factory: Callable) -> Callable:
+    def partials_factory(self, inner_factory: Callable,
+                         fallback_factory: Optional[Callable] = None
+                         ) -> Callable:
         """Wrap a partial-verifier factory (beacon.node.device_verifier_
         factory or _host_verifier_factory) so aggregation-time partial
-        verification runs on the service thread in the LIVE lane."""
+        verification runs on the service thread in the LIVE lane.  A
+        `fallback_factory` (same signature) provides the host path a
+        failed device partial-verify falls back to — live partials must
+        survive device loss without costing the round."""
         def factory(scheme, pub_poly, n_nodes):
+            fb = None
+            if fallback_factory is not None:
+                fb = lambda: fallback_factory(scheme, pub_poly, n_nodes)  # noqa: E731,E501
             return _PartialLaneVerifier(
-                self, inner_factory(scheme, pub_poly, n_nodes))
+                self, inner_factory(scheme, pub_poly, n_nodes), fb)
         return factory
 
     @staticmethod
@@ -267,7 +442,8 @@ class VerifyService:
 
     def submit_call(self, fn: Callable, lane: str = LANE_LIVE) -> VerifyFuture:
         """Opaque device work (e.g. a partial-aggregation RLC block) that
-        participates in the lanes and preemption but not the coalescer."""
+        participates in the lanes, preemption and the watchdog but not
+        the coalescer."""
         fut = VerifyFuture()
         req = _Request("call", lane, fut, self.clock.monotonic(), fn=fn)
         self._enqueue(req)
@@ -285,16 +461,54 @@ class VerifyService:
             verify_requests.labels(req.lane).inc()
             verify_queue_depth.labels(req.lane).set(
                 len(self._queues[req.lane]))
-            if self._thread is None:
-                self._thread = threading.Thread(
-                    target=self._run, daemon=True, name="verify-service")
-                self._thread.start()
+            self._ensure_threads_locked()
             self._cond.notify_all()
+
+    def _ensure_threads_locked(self) -> None:
+        """Caller holds the lock.  The scheduler and its watchdog start
+        together; either may be replaced later (a wedged dispatch
+        abandons its thread, see `_trip`)."""
+        if self._thread is None:
+            # tpu-vet: disable=lock  (caller holds self._cond, see docstring)
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="verify-scheduler")
+            self._thread.start()
+        if self._watchdog_thread is None:
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_run, daemon=True,
+                name="verify-watchdog")
+            self._watchdog_thread.start()
+
+    def _requeue(self, requests: List[_Request]) -> None:
+        """Put requests back at the FRONT of their lanes (flush-ready, so
+        failover redispatch does not wait out a coalescing window).  The
+        failover contract: requeued, not failed."""
+        from ..metrics import verify_queue_depth
+        drained = []
+        with self._cond:
+            if self._stopped:
+                drained = list(requests)
+            else:
+                for r in reversed(requests):
+                    r.flush = True
+                    self._queues[r.lane].appendleft(r)
+                for ln in LANES:
+                    verify_queue_depth.labels(ln).set(len(self._queues[ln]))
+            self._cond.notify_all()
+        for r in drained:
+            if not r.future.done():
+                r.future.set_exception(RuntimeError("verify service stopped"))
 
     # -- scheduler -----------------------------------------------------------
 
     def _run(self) -> None:
+        me = threading.current_thread()
         while True:
+            with self._cond:
+                # a watchdog trip may have replaced this thread while it
+                # was wedged in a device call — the queue is no longer ours
+                if self._thread is not me:
+                    return
             batch = self._next_batch()
             if batch is None:
                 return
@@ -315,7 +529,8 @@ class VerifyService:
         waited = 0.0        # accumulated real cv-wait towards the cap
         with self._cond:
             while True:
-                if self._stopped:
+                if self._stopped \
+                        or self._thread is not threading.current_thread():
                     return None
                 if self._queues[LANE_LIVE]:
                     lane = LANE_LIVE
@@ -372,7 +587,10 @@ class VerifyService:
 
     def _gather_locked(self, lane: str, head: _Request) -> _Batch:
         """Pop `head` plus every same-chain batch request from BOTH lanes
-        (they ride the same dispatch for free).  Caller-holds-lock helper:
+        (they ride the same dispatch for free).  The backend is resolved
+        HERE, at dispatch time, through the key's failover slot — a
+        degraded chain's requeued requests land on the host fallback, a
+        re-promoted one back on the device.  Caller-holds-lock helper:
         every call site sits inside `with self._cond` (same shape as
         sqlitedb._fill_previous).
         """
@@ -392,36 +610,68 @@ class VerifyService:
             # tpu-vet: disable=lock  (caller holds self._cond, see docstring)
             self._queues[ln] = keep
             verify_queue_depth.labels(ln).set(len(keep))
-        return _Batch(lane, backend=head.backend, requests=requests)
+        slot = self._slots.get(head.key)
+        backend = slot.active() if slot is not None else head.backend
+        return _Batch(lane, backend=backend, requests=requests,
+                      key=head.key, slot=slot)
 
     # -- execution (service thread, outside the lock) -------------------------
 
     def _execute(self, batch: _Batch) -> None:
         if batch.call is not None:
-            t0 = self.clock.monotonic()
-            try:
-                out = batch.call.fn()
-            except BaseException as e:
-                batch.call.future.set_exception(e)
-            else:
-                batch.call.future.set_result(out)
-            self._account(batch.lane, 1, 1,
-                          self.clock.monotonic() - t0)
+            self._execute_call(batch)
             return
         try:
-            results = self._run_chunks(batch)
+            results, errors = self._run_chunks(batch)
+        except _Abandoned:
+            return      # watchdog took this batch over; futures are not ours
+        except _Requeued:
+            return      # failover requeued every request; a later dispatch
+                        # on the fallback backend resolves the futures
         except BaseException as e:
+            # belt and braces — chunk errors are contained below, so only
+            # bookkeeping bugs land here; never leave a future pending
             for r in batch.requests:
                 if not r.future.done():
                     r.future.set_exception(e)
             return
-        # fan the verdict array back out, one contiguous slice per caller
+        # fan the verdict array back out, one contiguous slice per caller;
+        # a failed chunk's exception reaches ONLY the requests whose span
+        # overlaps it — other callers coalesced into the same dispatch get
+        # their verdicts (the r7 containment fix: one poisoned chunk used
+        # to fail every rider's future)
         off = 0
         for r in batch.requests:
-            r.future.set_result(results[off:off + r.n].copy())
+            exc = next((err for lo, hi, err in errors
+                        if lo < off + r.n and off < hi), None)
+            if not r.future.done():
+                if exc is not None:
+                    r.future.set_exception(exc)
+                else:
+                    r.future.set_result(results[off:off + r.n].copy())
             off += r.n
 
-    def _run_chunks(self, batch: _Batch) -> np.ndarray:
+    def _execute_call(self, batch: _Batch) -> None:
+        req = batch.call
+        t0 = self.clock.monotonic()
+        try:
+            out = self._guarded(None, batch, req.fn, kind="call")
+        except _Abandoned:
+            return
+        except BaseException:
+            try:        # opaque device work gets the same one retry
+                out = self._guarded(None, batch, req.fn, kind="call")
+            except _Abandoned:
+                return
+            except BaseException as e2:
+                req.future.set_exception(e2)
+                self._account(batch.lane, 1, 1,
+                              self.clock.monotonic() - t0)
+                return
+        req.future.set_result(out)
+        self._account(batch.lane, 1, 1, self.clock.monotonic() - t0)
+
+    def _run_chunks(self, batch: _Batch):
         rounds: List = []
         sigs: List = []
         prevs: List = []
@@ -431,26 +681,43 @@ class VerifyService:
             prevs.extend(r.prevs)
         n = len(rounds)
         spans = [(lo, min(lo + self.pad, n)) for lo in range(0, n, self.pad)]
-        results = np.empty(n, dtype=bool)
+        results = np.zeros(n, dtype=bool)
+        errors: List[Tuple[int, int, BaseException]] = []
         backend = batch.backend
+        slot = batch.slot
         if hasattr(backend, "pack_chunk"):
-            self._run_pipelined(batch, backend, rounds, sigs, prevs, spans,
-                                results)
+            self._run_pipelined(batch, slot, backend, rounds, sigs, prevs,
+                                spans, results, errors)
         else:
             for lo, hi in spans:
                 self._maybe_preempt(batch)
                 t0 = self.clock.monotonic()
-                results[lo:hi] = backend.verify_batch(
-                    rounds[lo:hi], sigs[lo:hi], prevs[lo:hi])
+                try:
+                    results[lo:hi] = self._chunk_call(
+                        slot, batch,
+                        lambda lo=lo, hi=hi: self._call_verify(
+                            backend, rounds[lo:hi], sigs[lo:hi],
+                            prevs[lo:hi]))
+                except (_Abandoned, _Requeued):
+                    raise
+                except BaseException as e:
+                    errors.append((lo, hi, e))
+                    continue
                 self._account(batch.lane, hi - lo, hi - lo,
-                              self.clock.monotonic() - t0)
-        return results
+                              self.clock.monotonic() - t0, slot=slot)
+                self._stash_sample(slot, rounds, sigs, prevs, results, lo)
+        return results, errors
 
-    def _run_pipelined(self, batch, backend, rounds, sigs, prevs, spans,
-                       results) -> None:
+    # host packing is in-process numpy — minutes of silence there means the
+    # process is wedged, not slow; bound it so the wait can't be forever
+    PACK_TIMEOUT = 600.0
+
+    def _run_pipelined(self, batch, slot, backend, rounds, sigs, prevs,
+                       spans, results, errors) -> None:
         """Device path: host packing of chunk k+1 overlaps device compute
         of chunk k (the verify_stream double buffer, generalized to every
-        caller), with the preemption check at each chunk boundary."""
+        caller), with the preemption check at each chunk boundary and
+        per-chunk error containment."""
         packer = self._ensure_packer()
         pad_width = max(self.pad, getattr(backend, "pad_to", 0) or 0)
 
@@ -461,29 +728,392 @@ class VerifyService:
         def dispatch(item):
             lo, hi, packed = item
             t0 = self.clock.monotonic()
-            return lo, hi, packed, backend.dispatch_packed(packed), t0
+            d = self._chunk_call(slot, batch,
+                                 lambda: backend.dispatch_packed(packed))
+            return lo, hi, packed, d, t0
 
         def resolve(item):
             lo, hi, packed, verdict, t0 = item
-            results[lo:hi] = backend.resolve_packed(packed, verdict)
+            results[lo:hi] = self._chunk_call(
+                slot, batch, lambda: self._validated(
+                    backend.resolve_packed(packed, verdict), hi - lo))
             self._account(batch.lane, hi - lo, pad_width,
-                          self.clock.monotonic() - t0)
+                          self.clock.monotonic() - t0, slot=slot)
+            self._stash_sample(slot, rounds, sigs, prevs, results, lo)
+
+        inflight: deque = deque()
+
+        def advance(p):
+            fut, lo, hi = p
+            try:
+                inflight.append(dispatch(fut.result(self.PACK_TIMEOUT)))
+            except (_Abandoned, _Requeued):
+                raise
+            except BaseException as e:
+                errors.append((lo, hi, e))
+
+        def drain_one():
+            item = inflight.popleft()
+            lo, hi = item[0], item[1]
+            try:
+                resolve(item)
+            except (_Abandoned, _Requeued):
+                raise
+            except BaseException as e:
+                errors.append((lo, hi, e))
 
         pending = None
-        inflight: deque = deque()
         for lo, hi in spans:
             self._maybe_preempt(batch)
-            nxt = packer.submit(pack, lo, hi)
+            nxt = (packer.submit(pack, lo, hi), lo, hi)
             if pending is not None:
-                inflight.append(dispatch(pending.result()))
+                advance(pending)
                 if len(inflight) > 1:
-                    resolve(inflight.popleft())
+                    drain_one()
             pending = nxt
         if pending is not None:
             self._maybe_preempt(batch)
-            inflight.append(dispatch(pending.result()))
+            advance(pending)
         while inflight:
-            resolve(inflight.popleft())
+            drain_one()
+
+    @staticmethod
+    def _call_verify(backend, rounds, sigs, prevs) -> np.ndarray:
+        """verify_batch with the verdict validated: a poisoned device that
+        answers with the wrong shape (or something that is not a bool
+        array at all) is a backend FAULT, not a caller error."""
+        out = np.asarray(backend.verify_batch(rounds, sigs, prevs),
+                         dtype=bool)
+        return VerifyService._validated(out, len(rounds))
+
+    @staticmethod
+    def _validated(out, n: int) -> np.ndarray:
+        arr = np.asarray(out, dtype=bool)
+        if arr.shape != (n,):
+            raise DeviceFailure(
+                f"backend returned verdict shape {arr.shape}, want ({n},)")
+        return arr
+
+    # -- the failure domain ---------------------------------------------------
+
+    def _deadline_for(self, slot: Optional[_BackendSlot]) -> float:
+        """Watchdog deadline: a generous multiple of this slot's observed
+        p99 dispatch latency, floored for cold compiles; opaque calls
+        (no slot) get the floor."""
+        with self._cond:
+            lat = sorted(slot.latencies) if slot is not None else []
+        if lat:
+            p99 = lat[min(len(lat) - 1, int(round(0.99 * (len(lat) - 1))))]
+            return max(self.watchdog_floor, self.watchdog_factor * p99)
+        return self.watchdog_floor
+
+    def _guarded(self, slot: Optional[_BackendSlot], batch: _Batch, fn,
+                 kind: str = "chunk"):
+        """Run one backend call under watchdog supervision.  The dispatch
+        path only registers/deregisters a ticket (O(1) under the lock the
+        scheduler already takes); deadline enforcement lives entirely on
+        the watchdog thread."""
+        deadline = self._deadline_for(slot)
+        with self._cond:
+            started = self.clock.monotonic()
+            ticket = _Ticket(slot, batch, kind, started, started + deadline)
+            self._tickets[id(ticket)] = ticket
+            self._cond.notify_all()     # the watchdog re-arms on new work
+        err = None
+        out = None
+        try:
+            out = fn()
+        except BaseException as e:
+            err = e
+        cleared = None
+        with self._cond:
+            self._tickets.pop(id(ticket), None)
+            cancelled = ticket.cancelled
+            if err is None and not cancelled and kind == "chunk" \
+                    and slot is not None and slot.state == STATE_SUSPECT:
+                # a successful dispatch clears the strike
+                slot.state = STATE_HEALTHY
+                cleared = slot
+        if cleared is not None:
+            self._set_state_gauge(cleared)
+        if cancelled:
+            raise _Abandoned()
+        if err is not None:
+            raise err
+        return out
+
+    def _chunk_call(self, slot: Optional[_BackendSlot], batch: _Batch, fn):
+        """One chunk dispatch with the failover ladder: first failure on
+        the primary backend marks it suspect and retries ONCE; a second
+        failure degrades the slot (atomic swap to the fallback) and
+        requeues every request of the batch.  Chunks on non-failover
+        backends (host, custom-without-fallback, or already-degraded)
+        raise through — the caller contains the error to that chunk."""
+        try:
+            return self._guarded(slot, batch, fn)
+        except _Abandoned:
+            raise
+        except BaseException:
+            if slot is None or not slot.can_failover \
+                    or batch.backend is not slot.primary:
+                raise
+            self._note_fault(slot)
+            self._note_suspect(slot)
+            try:
+                return self._guarded(slot, batch, fn)
+            except _Abandoned:
+                raise
+            except BaseException as e2:
+                self._degrade(slot, e2)
+                self._requeue(batch.requests)
+                raise _Requeued()
+
+    def _note_fault(self, slot: _BackendSlot) -> None:
+        with self._cond:
+            if slot.first_fault_at is None:
+                slot.first_fault_at = self.clock.monotonic()
+
+    def _note_suspect(self, slot: _BackendSlot) -> None:
+        changed = False
+        with self._cond:
+            if slot.state == STATE_HEALTHY:
+                slot.state = STATE_SUSPECT
+                changed = True
+        if changed:
+            self._set_state_gauge(slot)
+
+    def _degrade(self, slot: _BackendSlot, err: BaseException) -> None:
+        """Atomic backend swap: build the fallback outside the lock, then
+        flip the slot state; every dispatch gathered after this resolves
+        to the fallback.  Idempotent — racing strikes degrade once."""
+        from ..metrics import verify_failovers
+        fb = None
+        if slot.fallback is None and slot.fallback_factory is not None:
+            fb = slot.fallback_factory()
+        changed = False
+        with self._cond:
+            if slot.fallback is None and fb is not None:
+                slot.fallback = fb
+            if slot.state != STATE_DEGRADED:
+                was_active = slot.state in (STATE_HEALTHY, STATE_SUSPECT)
+                slot.state = STATE_DEGRADED
+                if was_active:
+                    slot.degraded_at = self.clock.monotonic()
+                    slot.failovers += 1
+                    self._failovers += 1
+                    changed = True
+        if changed:
+            verify_failovers.labels(slot.label, "to_host").inc()
+            self._set_state_gauge(slot)
+        self._ensure_probe()
+
+    def _promote(self, slot: _BackendSlot) -> None:
+        from ..metrics import verify_failovers
+        with self._cond:
+            slot.state = STATE_HEALTHY
+            slot.first_fault_at = None
+            self._promotions += 1
+        verify_failovers.labels(slot.label, "to_device").inc()
+        self._set_state_gauge(slot)
+
+    def _set_state_gauge(self, slot: _BackendSlot) -> None:
+        from ..metrics import verify_backend_state
+        verify_backend_state.labels(slot.label).set(_STATE_CODE[slot.state])
+
+    # -- watchdog thread ------------------------------------------------------
+
+    def _watchdog_run(self) -> None:
+        me = threading.current_thread()
+        while True:
+            tripped = []
+            with self._cond:
+                if self._watchdog_thread is not me:
+                    return
+                if self._stopped and not self._tickets:
+                    return
+                now = self.clock.monotonic()
+                for tid, t in list(self._tickets.items()):
+                    if not t.cancelled and now >= t.deadline_at:
+                        t.cancelled = True
+                        del self._tickets[tid]
+                        tripped.append(t)
+                if not tripped:
+                    # real-bounded poll so FakeClock advances are observed;
+                    # idle (no tickets) polls more lazily
+                    self._cond.wait(0.05 if self._tickets else 0.2)
+                    continue
+            for t in tripped:
+                self._trip(t)
+
+    def _trip(self, ticket: _Ticket) -> None:
+        """A dispatch blew its deadline.  The executing thread is wedged
+        inside native code and cannot be interrupted — abandon it (it
+        discards its result via the cancelled ticket when/if it returns),
+        hand its work back to the queue, and hand the queue to a fresh
+        scheduler thread."""
+        from ..metrics import verify_watchdog_trips
+        slot, batch = ticket.slot, ticket.batch
+        verify_watchdog_trips.labels(
+            slot.label if slot is not None else "call").inc()
+        with self._cond:
+            self._watchdog_trips += 1
+        if ticket.kind == "probe":
+            # the probe thread itself is wedged: stay degraded, replace it
+            with self._cond:
+                if slot is not None and slot.state == STATE_PROBING:
+                    slot.state = STATE_DEGRADED
+                self._probe_thread = None
+            if slot is not None:
+                self._set_state_gauge(slot)
+            self._ensure_probe()
+            return
+        if batch.call is not None:
+            req = batch.call
+            if not req.retried:
+                req.retried = True
+                self._requeue([req])
+            elif not req.future.done():
+                req.future.set_exception(DeviceFailure(
+                    "device call abandoned twice by the watchdog"))
+            self._ensure_scheduler()
+            return
+        if slot is not None and slot.can_failover \
+                and batch.backend is slot.primary:
+            self._note_fault(slot)
+            with self._cond:
+                first_strike = slot.state == STATE_HEALTHY
+                if first_strike:
+                    slot.state = STATE_SUSPECT
+            self._set_state_gauge(slot)
+            if not first_strike:
+                self._degrade(slot, DeviceFailure(
+                    "device dispatch blew its watchdog deadline twice"))
+            # requeued, not failed — on the device once (the suspect
+            # retry), on the fallback after the second strike
+            self._requeue(batch.requests)
+        else:
+            if batch.requests and not batch.requests[0].retried:
+                for r in batch.requests:
+                    r.retried = True
+                self._requeue(batch.requests)
+            else:
+                err = DeviceFailure(
+                    "dispatch abandoned twice by the watchdog "
+                    "(no fallback backend)")
+                for r in batch.requests:
+                    if not r.future.done():
+                        r.future.set_exception(err)
+        self._ensure_scheduler()
+
+    def _ensure_scheduler(self) -> None:
+        """Replace a wedged scheduler thread (the tripped dispatch still
+        owns the old one — it exits via the staleness check when the
+        native call eventually returns)."""
+        with self._cond:
+            if self._stopped:
+                return
+            if self._thread is not threading.current_thread():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="verify-scheduler")
+                self._thread.start()
+
+    # -- canary probe ---------------------------------------------------------
+
+    def _ensure_probe(self) -> None:
+        with self._cond:
+            if self._stopped:
+                return
+            if self._probe_thread is None or not self._probe_thread.is_alive():
+                self._probe_thread = threading.Thread(
+                    target=self._probe_run, daemon=True, name="verify-probe")
+                self._probe_thread.start()
+
+    # Real-seconds ceiling on the probe's coalesced clock wait, mirroring
+    # REAL_FLUSH_CAP: a daemon on a frozen FakeClock must still get its
+    # canary eventually.  The probe deliberately does NOT use
+    # clock.wait_until — chaos clocks (AutoClock) advance fake time inside
+    # wait_until, and a probe loop must observe scenario time, not drive it.
+    PROBE_REAL_CAP = 60.0
+
+    def _probe_wait(self, until: float) -> bool:
+        """cv-wait until the injected clock reaches `until` (or the real
+        cap), without ever advancing the clock itself.  False = stopped
+        or this thread was replaced.  The cap measures real ELAPSED time
+        (perf_counter delta) rather than counting timed-out waits — a
+        busy service notifies the condition on every submit/dispatch, and
+        those wakeups must not starve the canary on a frozen clock."""
+        from time import perf_counter
+        start = perf_counter()
+        with self._cond:
+            while not self._stopped \
+                    and self._probe_thread is threading.current_thread():
+                if self.clock.monotonic() >= until \
+                        or perf_counter() - start >= self.PROBE_REAL_CAP:
+                    return True
+                self._cond.wait(0.05)
+            return False
+
+    def _probe_run(self) -> None:
+        me = threading.current_thread()
+        while True:
+            with self._cond:
+                if self._stopped or self._probe_thread is not me:
+                    return
+                degraded = [s for s in self._slots.values()
+                            if s.state == STATE_DEGRADED and s.can_failover]
+                if not degraded:
+                    self._probe_thread = None
+                    return
+            # rate-limited on the injected clock: one canary round per
+            # interval, not a hot loop against a dead chip
+            if not self._probe_wait(self.clock.monotonic()
+                                    + self.probe_interval):
+                return
+            for slot in degraded:
+                self._probe_slot(slot)
+
+    def _probe_slot(self, slot: _BackendSlot) -> None:
+        """One canary dispatch against the degraded PRIMARY backend.  The
+        probe replays the last known-good 1-lane sample and demands the
+        same verdict — a device that answers but answers WRONG (poisoned)
+        stays degraded.  With no sample yet, any well-shaped answer
+        counts.  The probe runs under the same watchdog as real work, so
+        a probe that hangs is abandoned, not waited on."""
+        from ..metrics import verify_probe_latency
+        with self._cond:
+            if self._stopped or slot.state != STATE_DEGRADED:
+                return
+            slot.state = STATE_PROBING
+            sample = slot.sample
+        self._set_state_gauge(slot)
+        if sample is not None:
+            rounds, sigs, prevs, want = sample
+        else:
+            rounds, sigs, prevs, want = [1], [b""], [None], None
+        marker = _Batch(LANE_LIVE)      # ticket context only
+        t0 = self.clock.monotonic()
+        ok = False
+        try:
+            out = self._guarded(
+                slot, marker,
+                lambda: self._call_verify(slot.primary, rounds, sigs, prevs),
+                kind="probe")
+            ok = want is None or bool(out[0]) == want
+        except _Abandoned:
+            return      # the watchdog demoted us and replaced this thread
+        except BaseException:
+            ok = False
+        verify_probe_latency.labels(slot.label).observe(
+            max(0.0, self.clock.monotonic() - t0))
+        if ok:
+            self._promote(slot)
+        else:
+            with self._cond:
+                if slot.state == STATE_PROBING:
+                    slot.state = STATE_DEGRADED
+            self._set_state_gauge(slot)
+
+    # -- preemption / packing -------------------------------------------------
 
     def _maybe_preempt(self, batch: _Batch) -> None:
         """At a chunk boundary of BACKGROUND work, run any queued LIVE
@@ -493,6 +1123,8 @@ class VerifyService:
         if batch.lane == LANE_LIVE:
             return
         with self._cond:
+            if self._thread is not threading.current_thread():
+                return      # stale (abandoned) executor: not our queue
             pending = bool(self._queues[LANE_LIVE])
             if pending:
                 self._preemptions += 1
@@ -500,6 +1132,9 @@ class VerifyService:
             return
         verify_preemptions.inc()
         while True:
+            with self._cond:
+                if self._thread is not threading.current_thread():
+                    return
             live = self._try_next(LANE_LIVE)
             if live is None:
                 return
@@ -509,11 +1144,11 @@ class VerifyService:
         if self._packer is None:
             from concurrent.futures import ThreadPoolExecutor
             self._packer = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="verify-pack")
+                max_workers=1, thread_name_prefix="verify-packer")
         return self._packer
 
     def _account(self, lane: str, lanes: int, slots: int,
-                 elapsed: float) -> None:
+                 elapsed: float, slot: Optional[_BackendSlot] = None) -> None:
         from ..metrics import (verify_dispatch_latency, verify_dispatches,
                                verify_fill_ratio)
         verify_dispatches.labels(lane).inc()
@@ -523,6 +1158,19 @@ class VerifyService:
             self._dispatches += 1
             self._dispatch_lanes += lanes
             self._dispatch_slots += slots
+            if slot is not None:
+                # the latency history the watchdog deadline derives from
+                slot.latencies.append(max(0.0, elapsed))
+
+    def _stash_sample(self, slot: Optional[_BackendSlot], rounds, sigs,
+                      prevs, results, lo: int) -> None:
+        """Remember one verified lane of a successful dispatch as the
+        canary probe's replay sample."""
+        if slot is None:
+            return
+        with self._cond:
+            slot.sample = (list(rounds[lo:lo + 1]), list(sigs[lo:lo + 1]),
+                           list(prevs[lo:lo + 1]), bool(results[lo]))
 
     # -- observability / lifecycle -------------------------------------------
 
@@ -532,6 +1180,11 @@ class VerifyService:
                 "submitted": self._submitted,
                 "dispatches": self._dispatches,
                 "preemptions": self._preemptions,
+                "failovers": self._failovers,
+                "promotions": self._promotions,
+                "watchdog_trips": self._watchdog_trips,
+                "backends": {s.label: s.state
+                             for s in self._slots.values()},
                 "fill_ratio": (self._dispatch_lanes /
                                self._dispatch_slots
                                if self._dispatch_slots else 0.0),
@@ -542,13 +1195,27 @@ class VerifyService:
                 "queue_depth": {ln: len(self._queues[ln]) for ln in LANES},
             }
 
+    def degraded_backends(self) -> List[str]:
+        """Labels of backends currently failed over to the host path
+        (degraded or mid-probe) — the /health degraded line."""
+        with self._cond:
+            return sorted(s.label for s in self._slots.values()
+                          if s.state in (STATE_DEGRADED, STATE_PROBING))
+
     def summary(self) -> str:
         """One line for /health."""
         s = self.stats()
         q = s["queue_depth"]
-        return (f"dispatches={s['dispatches']} requests={s['submitted']} "
+        line = (f"dispatches={s['dispatches']} requests={s['submitted']} "
                 f"fill={s['fill_ratio']:.2f} preempt={s['preemptions']} "
                 f"queue={q[LANE_LIVE]}/{q[LANE_BACKGROUND]}")
+        if s["failovers"] or s["watchdog_trips"]:
+            line += (f" failovers={s['failovers']}"
+                     f" trips={s['watchdog_trips']}")
+        deg = self.degraded_backends()
+        if deg:
+            line += " DEGRADED=" + ",".join(deg)
+        return line
 
     def stop(self) -> None:
         with self._cond:
@@ -557,12 +1224,20 @@ class VerifyService:
             for ln in LANES:
                 self._queues[ln] = deque()
             thread, self._thread = self._thread, None
+            wd, self._watchdog_thread = self._watchdog_thread, None
+            probe, self._probe_thread = self._probe_thread, None
+            # cancel in-flight tickets so the watchdog exits and any
+            # wedged executor discards its result on return
+            for t in self._tickets.values():
+                t.cancelled = True
+            self._tickets.clear()
             self._cond.notify_all()
         for r in drained:
             if not r.future.done():
                 r.future.set_exception(RuntimeError("verify service stopped"))
-        if thread is not None:
-            thread.join(timeout=5)
+        for t in (thread, wd, probe):
+            if t is not None and t is not threading.current_thread():
+                t.join(timeout=5)
         packer, self._packer = self._packer, None
         if packer is not None:
             packer.shutdown(wait=False)

@@ -253,6 +253,14 @@ class RestServer:
             svc = current_service()
         if svc is not None:
             payload["verify"] = svc.summary()
+            # the failure-domain degraded line: name every backend that is
+            # currently failed over to the host path (or mid-probe) so an
+            # operator scraping /health sees accelerator loss immediately
+            # instead of inferring it from throughput
+            degraded = svc.degraded_backends()
+            payload["verify_degraded"] = bool(degraded)
+            if degraded:
+                payload["verify_degraded_backends"] = degraded
         body = json.dumps(payload).encode()
         return status, body, {}
 

@@ -94,11 +94,11 @@ deadline_exceeded_total = Counter(
 integrity_beacons_scanned = Counter(
     "chain_integrity_beacons_scanned_total",
     "Beacon rounds examined by integrity scans",
-    ["beacon_id", "verifier"], registry=GROUP)
+    ["beacon_id", "verifier", "trigger"], registry=GROUP)
 integrity_corrupt_found = Counter(
     "chain_integrity_corrupt_found_total",
     "Corrupt/missing rounds flagged by integrity scans",
-    ["beacon_id", "kind"], registry=GROUP)
+    ["beacon_id", "kind", "trigger"], registry=GROUP)
 integrity_quarantined = Counter(
     "chain_integrity_quarantined_total",
     "Corrupt rounds deleted (quarantined) pending re-fetch",
@@ -143,6 +143,27 @@ verify_preemptions = Counter(
     "verify_service_preemptions_total",
     "Background batches preempted at a chunk boundary by live work",
     registry=PRIVATE)
+# Device failure domain (crypto/verify_service.py watchdog/failover):
+# `chain` is "<scheme>:<pk hex prefix>" — one series per backend handle.
+# backend_state encodes the failover state machine (0 healthy, 1 suspect,
+# 2 degraded, 3 probing); failovers count device→host swaps AND host→device
+# re-promotions (the `direction` label tells them apart).
+verify_failovers = Counter(
+    "verify_service_failovers_total",
+    "Verify-service backend swaps (device->host and re-promotions)",
+    ["chain", "direction"], registry=PRIVATE)
+verify_backend_state = Gauge(
+    "verify_service_backend_state",
+    "Verify backend failover state (0 healthy, 1 suspect, 2 degraded, "
+    "3 probing)", ["chain"], registry=PRIVATE)
+verify_watchdog_trips = Counter(
+    "verify_service_watchdog_trips_total",
+    "Device dispatches abandoned after blowing their watchdog deadline",
+    ["chain"], registry=PRIVATE)
+verify_probe_latency = Histogram(
+    "verify_service_probe_latency_seconds",
+    "Canary probe dispatch latency on a degraded device backend",
+    ["chain"], registry=PRIVATE)
 
 
 def scrape(which: str = "group") -> bytes:

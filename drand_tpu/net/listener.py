@@ -44,7 +44,9 @@ class Listener:
         self.server.start()
 
     def stop(self, grace: float = 1.0) -> None:
-        self.server.stop(grace).wait()
+        # bounded: grace covers in-flight RPC drain, the pad covers gRPC's
+        # own teardown — a stuck handler must not hang daemon shutdown
+        self.server.stop(grace).wait(timeout=grace + 10.0)
 
 
 class PrivateGateway:

@@ -21,6 +21,19 @@ Building blocks:
                      (torn write, bit flip, deleted row) written INTO a
                      store, for the chain-integrity scan/repair path
                      (chain/integrity.py, tools/chain_doctor.py).
+  * `DeviceFaultPlan` / `FaultyDeviceBackend` — seeded DEVICE faults
+                     injected at the verify-service backend boundary
+                     (hang-forever, raise-on-dispatch, flappy window,
+                     poisoned/wrong-shape result), zero real-chip
+                     dependency.
+  * `DeviceChaosScenario` — mixed live/background workload through a
+                     flapping device: every future must resolve with
+                     verdicts identical to a host-only run, failover
+                     within one watchdog deadline, re-promotion after
+                     recovery.
+  * `DeviceFailoverSyncScenario` — kill the device backend mid-catch-up
+                     sync on a 3-node network; convergence must come via
+                     the host path before the round deadline.
 """
 
 import hashlib
@@ -581,3 +594,335 @@ class StorageChaosScenario:
             all_detected=all_detected, unrepaired=unrepaired,
             rescan_clean=rescan.clean, converged=converged,
             chain_digest=digests[0])
+
+# ---------------------------------------------------------------------------
+# device faults at the backend boundary (the verify-service failure domain):
+# PR 6 funneled ALL verification through one resident device pipeline, which
+# made one wedged/vanished accelerator a single point of failure for every
+# consumer at once (bench r04: 0 r/s, chip unreachable).  These plans fault
+# the service's *backend*, never a real chip, so the watchdog → failover →
+# probe state machine is testable on any CPU box.
+# ---------------------------------------------------------------------------
+
+DEVICE_HANG = "hang"          # dispatch blocks until released (a wedged chip)
+DEVICE_RAISE = "raise"        # dispatch raises (chip unreachable)
+DEVICE_POISON = "poison"      # dispatch answers with a wrong-shape result
+
+
+@dataclass
+class DeviceFaultPlan:
+    """Seeded device-fault schedule.  A fault is a pure function of
+    (seed, dispatch#) plus two deterministic failure windows: a
+    fake-time flap window [down_from, down_until) and a dispatch-count
+    kill switch (every dispatch >= die_after fails, no recovery)."""
+
+    seed: int = 0
+    down_from: Optional[float] = None     # fake-time window in which every
+    down_until: Optional[float] = None    # dispatch fails with `down_mode`
+    down_mode: str = DEVICE_RAISE
+    die_after: Optional[int] = None       # dispatch# from which the device
+                                          # is dead for good
+    raise_p: float = 0.0                  # P(raise) per dispatch, seeded
+    poison_p: float = 0.0                 # P(wrong-shape result), seeded
+
+    def fault_at(self, dispatch_no: int, now: float) -> Optional[str]:
+        if self.die_after is not None and dispatch_no >= self.die_after:
+            return self.down_mode
+        if self.down_from is not None and now >= self.down_from \
+                and (self.down_until is None or now < self.down_until):
+            return self.down_mode
+        dice = random.Random(stable_seed(self.seed, "device", dispatch_no))
+        if dice.random() < self.raise_p:
+            return DEVICE_RAISE
+        if dice.random() < self.poison_p:
+            return DEVICE_POISON
+        return None
+
+
+class FaultyDeviceBackend:
+    """Wrap any verify backend with a DeviceFaultPlan at the service's
+    backend boundary.  `release` frees hung dispatches (set it in
+    teardown so abandoned watchdog threads exit instead of leaking)."""
+
+    kind = "device"
+
+    def __init__(self, inner, plan: DeviceFaultPlan, clock):
+        self.inner = inner
+        self.plan = plan
+        self.clock = clock
+        self.release = threading.Event()
+        self.dispatches = 0
+        self.faults: List[tuple] = []     # (dispatch#, fault kind)
+        self.first_fault_time: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def verify_batch(self, rounds, sigs, prev_sigs=None):
+        now = self.clock.now()
+        with self._lock:
+            i = self.dispatches
+            self.dispatches += 1
+            fault = self.plan.fault_at(i, now)
+            if fault is not None:
+                self.faults.append((i, fault))
+                if self.first_fault_time is None:
+                    self.first_fault_time = self.clock.monotonic()
+        if fault == DEVICE_HANG:
+            # parks until the scenario releases it; the watchdog abandons
+            # the dispatch long before, the 600 s cap merely bounds a
+            # teardown that forgot to release
+            self.release.wait(600)
+            raise ConnectionError("device hung (released by teardown)")
+        if fault == DEVICE_RAISE:
+            raise ConnectionError("device unreachable")
+        out = self.inner.verify_batch(rounds, sigs, prev_sigs)
+        if fault == DEVICE_POISON:
+            return out[:-1]               # wrong shape: one lane short
+        return out
+
+
+@dataclass
+class DeviceScenarioResult:
+    all_resolved: bool                # zero forever-pending futures
+    verdicts_match_host: bool         # identical to a host-only run
+    failovers: int
+    watchdog_trips: int
+    failover_latency: Optional[float]  # fake seconds, fault -> degraded
+    deadline: float                   # the watchdog deadline at that time
+    repromoted: bool                  # device healthy again after recovery
+    device_served_after_recovery: bool
+    final_state: str
+
+    @property
+    def ok(self) -> bool:
+        return (self.all_resolved and self.verdicts_match_host
+                and self.failovers >= 1
+                and (self.failover_latency is None
+                     or self.failover_latency <= self.deadline)
+                and self.repromoted and self.device_served_after_recovery)
+
+
+class DeviceChaosScenario:
+    """Mixed live/background workload through a flapping device.
+
+    Timeline (fake seconds from start=1000): healthy traffic, then the
+    device enters a raise-on-dispatch flap window at +100, traffic during
+    the outage (must fail over, nobody's future may break), recovery at
+    +200, canary probe re-promotes, post-recovery traffic runs on the
+    device again."""
+
+    def __init__(self, seed: int, rounds: int = 24,
+                 chain: Optional[TrueChain] = None,
+                 watchdog_floor: float = 30.0, probe_interval: float = 5.0):
+        from drand_tpu.crypto.verify_service import VerifyService
+
+        self.seed = seed
+        self.rounds = rounds
+        self.clock = AutoClock(start=1_000.0)
+        self.chain = chain if chain is not None and chain.n >= rounds \
+            else TrueChain(n=rounds)
+        sch = self.chain.scheme
+        self.host = HostBatchVerifier(sch, self.chain.public)
+        self.plan = DeviceFaultPlan(seed=stable_seed(seed, "device-flap"),
+                                    down_from=1_100.0, down_until=1_200.0,
+                                    down_mode=DEVICE_RAISE)
+        self.device = FaultyDeviceBackend(
+            HostBatchVerifier(sch, self.chain.public), self.plan, self.clock)
+        self.svc = VerifyService(clock=self.clock, pad=8,
+                                 background_window=0.0,
+                                 watchdog_floor=watchdog_floor,
+                                 probe_interval=probe_interval)
+        self.handle = self.svc.handle(
+            sch, self.chain.public, backend=self.device,
+            fallback=HostBatchVerifier(sch, self.chain.public))
+
+    def _workload(self):
+        """(rounds, sigs, prevs) with seeded forged rounds, so verdict
+        parity against the host-only run is a real check, not all-True."""
+        dice = random.Random(stable_seed(self.seed, "forge"))
+        rounds = list(range(1, self.rounds + 1))
+        forged = set(dice.sample(rounds, max(2, self.rounds // 8)))
+        sigs, prevs = [], []
+        for r in rounds:
+            b = self.chain.beacons[r]
+            sigs.append(corrupt_signature(b).signature if r in forged
+                        else b.signature)
+            prevs.append(b.previous_sig)
+        return rounds, sigs, prevs
+
+    def run(self) -> DeviceScenarioResult:
+        import numpy as np
+
+        rounds, sigs, prevs = self._workload()
+        expected = self.host.verify_batch(rounds, sigs, prevs)
+
+        futs = []           # ((lo, hi), future)
+
+        def submit(lo, hi, lane):
+            futs.append(((lo, hi), self.handle.submit(
+                rounds[lo:hi], sigs[lo:hi], prevs[lo:hi], lane=lane,
+                flush_now=True)))
+
+        def settle(timeout=30):
+            for _, f in futs:
+                f.result(timeout)
+
+        try:
+            # phase 1: healthy — device serves both lanes
+            submit(0, 8, "background")
+            submit(8, 10, "live")
+            settle()
+            # phase 2: the flap window — mixed traffic during the outage
+            self.clock.jump(100.0)        # now 1100: device down
+            submit(10, 16, "background")
+            submit(16, 18, "live")
+            submit(18, 20, "background")
+            settle()                      # resolves via host failover
+            slot = self.svc._slots[self.handle.key]
+            deadline = self.svc._deadline_for(slot)
+            failover_latency = None
+            if slot.degraded_at is not None \
+                    and slot.first_fault_at is not None:
+                failover_latency = slot.degraded_at - slot.first_fault_at
+            # phase 3: recovery — past the window, the canary re-promotes
+            self.clock.jump(150.0)        # now >= 1250: device answers
+            repromoted = False
+            for _ in range(400):          # real-time wait on the probe
+                if slot.state == "healthy":
+                    repromoted = True
+                    break
+                self.clock.jump(self.svc.probe_interval)
+                threading.Event().wait(0.05)
+            # phase 4: post-recovery traffic runs on the device again
+            before = self.device.dispatches
+            submit(20, self.rounds, "live")
+            settle()
+            device_served = self.device.dispatches > before
+
+            all_resolved = all(f.done() for _, f in futs)
+            got = np.zeros(self.rounds, dtype=bool)
+            for (lo, hi), f in futs:
+                got[lo:hi] = f.result(0)
+            st = self.svc.stats()
+            return DeviceScenarioResult(
+                all_resolved=all_resolved,
+                verdicts_match_host=bool((got == expected).all()),
+                failovers=st["failovers"],
+                watchdog_trips=st["watchdog_trips"],
+                failover_latency=failover_latency,
+                deadline=deadline,
+                repromoted=repromoted,
+                device_served_after_recovery=device_served,
+                final_state=slot.state)
+        finally:
+            self.device.release.set()
+            self.svc.stop()
+
+
+@dataclass
+class SyncFailoverResult:
+    converged: bool
+    faulty_after_sync: List[int]
+    elapsed: float                    # fake seconds spent syncing
+    period: float
+    degraded: bool                    # the service failed over mid-sync
+    device_dispatches: int
+
+    @property
+    def ok(self) -> bool:
+        return (self.converged and not self.faulty_after_sync
+                and self.degraded and self.elapsed <= self.period)
+
+
+class DeviceFailoverSyncScenario:
+    """Kill the device backend mid-catch-up-sync on a live 3-node
+    network: node0 holds the true chain, node1 catches up through a
+    verify-service handle whose device backend dies for good after
+    `die_after` dispatches.  The sync must converge via the host
+    failover path before the round deadline (one period of fake time —
+    failover is raise-driven here, so it costs retries, not a watchdog
+    wait)."""
+
+    def __init__(self, seed: int, rounds: int = 24, period: float = 30.0,
+                 die_after: int = 2, chain: Optional[TrueChain] = None):
+        from drand_tpu.crypto.verify_service import VerifyService
+
+        self.seed = seed
+        self.rounds = rounds
+        self.period = period
+        self.clock = AutoClock(start=1_000.0)
+        self.chain = chain if chain is not None and chain.n >= rounds \
+            else TrueChain(n=rounds)
+        sch = self.chain.scheme
+        self.plan = DeviceFaultPlan(seed=stable_seed(seed, "device-kill"),
+                                    die_after=die_after,
+                                    down_mode=DEVICE_RAISE)
+        self.device = FaultyDeviceBackend(
+            HostBatchVerifier(sch, self.chain.public), self.plan, self.clock)
+        self.svc = VerifyService(clock=self.clock, pad=8,
+                                 background_window=0.0,
+                                 watchdog_floor=30.0, probe_interval=5.0)
+        self.handle = self.svc.handle(
+            sch, self.chain.public, backend=self.device,
+            fallback=HostBatchVerifier(sch, self.chain.public))
+        self.addresses = ["node0", "node1", "node2"]
+        self.stores: Dict[str, MemDBStore] = {}
+        self.facades: Dict[str, FollowFacade] = {}
+        for a in self.addresses:
+            store = MemDBStore(buffer_size=rounds + 8)
+            facade = FollowFacade(store, sch.chained, self.chain.genesis_seed)
+            if a == "node0":
+                for r in range(1, rounds + 1):
+                    facade.put(self.chain.beacons[r])
+            self.stores[a] = store
+            self.facades[a] = facade
+
+    def fetch(self, peer, from_round: int):
+        store = self.stores[str(peer)]
+        for r in range(from_round, self.rounds + 1):
+            try:
+                yield store.get(r)
+            except Exception:
+                return
+
+    def run(self) -> SyncFailoverResult:
+        policy = ResiliencePolicy(
+            clock=self.clock, backoff=BackoffPolicy(base=0.2, cap=2.0),
+            breakers=BreakerRegistry(clock=self.clock,
+                                     scope="chaos-device-sync"),
+            scope="chaos-device-sync", seed=stable_seed(self.seed, "sync"))
+        syncm = SyncManager(
+            chain=self.facades["node1"], scheme=self.chain.scheme,
+            public_key_bytes=self.chain.public, period=self.period,
+            clock=self.clock, fetch=self.fetch,
+            peers=["node0", "node2"], chunk=8,
+            verifier=self.handle, resilience=policy,
+            sync_budget=10_000.0)
+        t0 = self.clock.now()
+        converged = True
+        try:
+            try:
+                syncm.sync(self.rounds, syncm.peers)
+            except Exception:
+                converged = False
+            faulty = syncm.check_past_beacons(self.rounds)
+            elapsed = self.clock.now() - t0
+            digests = []
+            for a in ("node0", "node1"):
+                h = hashlib.sha256()
+                for r in range(1, self.rounds + 1):
+                    try:
+                        h.update(self.stores[a].get(r).signature)
+                    except Exception:
+                        h.update(b"missing")
+                        converged = False
+                digests.append(h.hexdigest())
+            converged = converged and len(set(digests)) == 1
+            st = self.svc.stats()
+            return SyncFailoverResult(
+                converged=converged, faulty_after_sync=faulty,
+                elapsed=elapsed, period=self.period,
+                degraded=st["failovers"] >= 1,
+                device_dispatches=self.device.dispatches)
+        finally:
+            self.device.release.set()
+            self.svc.stop()

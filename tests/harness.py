@@ -5,12 +5,43 @@ fabricated from a single polynomial (test/test.go BatchIdentities pattern) —
 DKG-produced shares are exercised by the dkg tests instead."""
 
 import threading
+import time
 
 from drand_tpu.beacon import FakeClock, Handler, HandlerConfig
 from drand_tpu.chain import MemDBStore
 from drand_tpu.crypto import tbls
 from drand_tpu.crypto.schemes import scheme_from_name
 from drand_tpu.key import DistPublic, Share, new_group, new_keypair
+
+
+# every thread the verify service owns carries one of these names
+# (crypto/verify_service.py); a daemon stop() must reap them all
+SERVICE_THREAD_PREFIXES = ("verify-scheduler", "verify-packer",
+                           "verify-watchdog", "verify-probe")
+
+
+def service_threads():
+    """Alive verify-service threads, for before/after leak accounting."""
+    return [t for t in threading.enumerate()
+            if t.is_alive()
+            and any(t.name.startswith(p) for p in SERVICE_THREAD_PREFIXES)]
+
+
+def assert_no_leaked_service_threads(before=(), timeout: float = 5.0):
+    """Fail if any verify-service thread outlives its daemon.  `before`
+    (a `service_threads()` snapshot taken at setup) exempts threads that
+    pre-date the code under test — e.g. the process-default singleton
+    another test module's client spun up and never stops.  Threads get
+    `timeout` real seconds to finish their bounded shutdown joins."""
+    exempt = set(id(t) for t in before)
+    deadline = time.monotonic() + timeout
+    leaked = [t for t in service_threads() if id(t) not in exempt]
+    while leaked and time.monotonic() < deadline:
+        time.sleep(0.05)
+        leaked = [t for t in service_threads() if id(t) not in exempt]
+    assert not leaked, (
+        "leaked verify-service threads after daemon stop: "
+        + ", ".join(t.name for t in leaked))
 
 
 class LocalNetwork:

@@ -1,0 +1,54 @@
+"""Seeded violations for the `wait` checker: unbounded blocking waits.
+
+Four findings (future.result / thread.join / condition.wait /
+event.wait, all zero-argument), one suppressed, and negatives that must
+stay silent: bounded variants, str.join (always has an argument), and a
+non-blocking queue get.
+"""
+
+import queue
+import threading
+from concurrent.futures import Future
+
+
+def bad_future(f: Future):
+    return f.result()                           # finding: wait-unbounded
+
+
+def bad_join(t: threading.Thread):
+    t.join()                                    # finding: wait-unbounded
+
+
+class Waiter:
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.ev = threading.Event()
+
+    def bad_cond_wait(self):
+        with self.cond:
+            self.cond.wait()                    # finding: wait-unbounded
+
+    def bad_event_wait(self):
+        self.ev.wait()                          # finding: wait-unbounded
+
+
+def ok_bounded(f: Future, t: threading.Thread, w: Waiter):
+    f.result(5)
+    f.result(timeout=5)
+    t.join(timeout=2)
+    with w.cond:
+        w.cond.wait(0.1)
+    w.ev.wait(timeout=1.0)
+
+
+def ok_str_join(parts):
+    return ", ".join(parts)
+
+
+def ok_queue_nonblocking(q: queue.Queue):
+    return q.get_nowait()
+
+
+def ok_suppressed(f: Future):
+    # the supervising test harness guarantees resolution here
+    return f.result()  # tpu-vet: disable=wait

@@ -24,6 +24,8 @@ from drand_tpu.net import ControlClient, Peer, ProtocolClient
 from drand_tpu.net import convert
 from drand_tpu.protos import drand_pb2 as pb
 
+from harness import assert_no_leaked_service_threads, service_threads
+
 SECRET = b"e2e-secret"
 
 
@@ -107,10 +109,19 @@ def _wait_round(client, addr, round_, timeout=90, beacon_id="default"):
 
 @pytest.fixture()
 def trio(tmp_path):
-    daemons = [_mk_daemon(tmp_path, i, metrics_port=0) for i in range(3)]
+    # snapshot BEFORE the daemons exist: the process-default verify
+    # service another test module's client left running is not a leak
+    # these daemons caused
+    before = service_threads()
+    daemons = [_mk_daemon(tmp_path, i, metrics_port=0,
+                          startup_integrity="linkage",
+                          integrity_scan_interval=1.0) for i in range(3)]
     yield daemons
     for d in daemons:
         d.stop()
+    # the failure-domain teardown contract: a leaked verify-scheduler/
+    # packer/watchdog/probe thread fails the suite
+    assert_no_leaked_service_threads(before=before)
 
 
 def test_dkg_beacons_and_sync(trio):
@@ -161,6 +172,16 @@ def test_dkg_beacons_and_sync(trio):
     # non-members 404 (reference: only group members are scrapable)
     with pytest.raises(urllib.error.HTTPError):
         urllib.request.urlopen(f"{base}/peer/127.0.0.1:1/metrics")
+
+    # scheduled background integrity scans (integrity_scan_interval=1.0 in
+    # the fixture): the rerun pass fires on the daemon clock and its
+    # metrics carry trigger="scheduled", distinct from the startup pass
+    from drand_tpu.metrics import integrity_beacons_scanned
+    sched = integrity_beacons_scanned.labels("default", "none", "scheduled")
+    deadline = time.time() + 30
+    while time.time() < deadline and sched._value.get() == 0:
+        time.sleep(0.5)
+    assert sched._value.get() > 0, "no scheduled integrity scan ran"
 
 
 def test_version_skew_gate(trio):
@@ -274,6 +295,7 @@ def test_reshare_add_node(tmp_path):
     """3-node network reshares to 4 nodes (one newcomer); the chain keeps
     its genesis seed + public key and continues past the transition
     (drand_beacon_control.go:425-529, node.go:257-281)."""
+    before = service_threads()
     daemons = [_mk_daemon(tmp_path, i) for i in range(4)]
     try:
         old_group = _run_dkg(daemons[:3], n=3, thr=2)
@@ -334,12 +356,14 @@ def test_reshare_add_node(tmp_path):
     finally:
         for d in daemons:
             d.stop()
+        assert_no_leaked_service_threads(before=before)
 
 
 @pytest.mark.slow
 def test_follow_chain_observer(tmp_path):
     """A non-member daemon follows the chain in observer mode via the
     control plane (StartFollowChain, drand_beacon_control.go:1097-1227)."""
+    before = service_threads()
     daemons = [_mk_daemon(tmp_path, i) for i in range(3)]
     observer = _mk_daemon(tmp_path, 9)
     try:
@@ -359,12 +383,14 @@ def test_follow_chain_observer(tmp_path):
         observer.stop()
         for d in daemons:
             d.stop()
+        assert_no_leaked_service_threads(before=before)
 
 
 @pytest.mark.slow
 def test_multibeacon_routing(tmp_path):
     """One daemon trio hosts two independent chains; RPCs route by
     beaconID (drand_daemon.go:20-41, drand_daemon_helper.go:77)."""
+    before = service_threads()
     daemons = [_mk_daemon(tmp_path, i) for i in range(3)]
     try:
         g1 = _run_dkg(daemons, n=3, thr=2, period=3, beacon_id="alpha")
@@ -384,3 +410,4 @@ def test_multibeacon_routing(tmp_path):
     finally:
         for d in daemons:
             d.stop()
+        assert_no_leaked_service_threads(before=before)

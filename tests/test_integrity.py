@@ -238,7 +238,8 @@ def test_chain_doctor_scan_clean_uses_device_verifier(chain, tmp_path):
     import chain_doctor
 
     db = _doctor_db(chain, tmp_path)
-    counter = integrity_beacons_scanned.labels("default", "device")
+    counter = integrity_beacons_scanned.labels("default", "device",
+                                               "startup")
     before = counter._value.get()
     # chunk 8 keeps the device pass on the pad-8 pipeline shape the batch
     # suite already compiles (cold XLA compiles are minutes on 2 CPU cores)
@@ -306,7 +307,7 @@ def test_chain_doctor_repair_linkage_mode(chain, tmp_path):
 
 
 def test_startup_integrity_pass_glue(chain):
-    """core/beacon_process._startup_integrity_pass: scan synchronously,
+    """core/beacon_process._integrity_pass (startup trigger): scan synchronously,
     quarantine, repair on a background thread — exercised against a stub
     process so it needs no DKG, with in-memory peers and a fake clock."""
     import time
@@ -340,18 +341,21 @@ def test_startup_integrity_pass_glue(chain):
             return victim.last()
 
         def integrity_scan(self, verifier=None, mode="full", upto=None,
-                           progress=None, beacon_id="default", chunk=512):
+                           progress=None, beacon_id="default", chunk=512,
+                           trigger="startup"):
             return scanner.scan(mode=mode, upto=upto or N)
 
+    import threading as _threading
     bp = SimpleNamespace(
         cfg=SimpleNamespace(startup_integrity="full"),
         syncm=syncm, handler=SimpleNamespace(chain=FakeChain()),
+        _lock=_threading.Lock(), _repair_thread=None,
         log=Logger(), beacon_id="startup-test", _peers=lambda: ["peer0"],
         # clock-derived expected head (the head-truncation follow-up):
         # the real method needs group timing; the stub pins it to N
         _expected_head_round=lambda: N,
         _on_sync_needed=lambda target: None)
-    BeaconProcess._startup_integrity_pass(bp)
+    BeaconProcess._integrity_pass(bp)
     deadline = time.monotonic() + 20
     while time.monotonic() < deadline:
         if scanner.scan(mode="full", upto=N).clean:
@@ -399,18 +403,21 @@ def test_startup_scan_catches_head_truncation(chain):
             return victim.last()
 
         def integrity_scan(self, verifier=None, mode="full", upto=None,
-                           progress=None, beacon_id="default", chunk=512):
+                           progress=None, beacon_id="default", chunk=512,
+                           trigger="startup"):
             return scanner.scan(mode=mode, upto=upto)
 
+    import threading as _threading
     bp_pass = SimpleNamespace(
         cfg=SimpleNamespace(startup_integrity="linkage"),
         syncm=SimpleNamespace(verifier=None),
         handler=SimpleNamespace(chain=FakeChain()),
+        _lock=_threading.Lock(), _repair_thread=None,
         log=Logger(), beacon_id="truncation-test",
         _peers=lambda: [], clock=bp.clock, group=bp.group,
         _expected_head_round=lambda: expected,
         _on_sync_needed=sync_requests.append)
-    BeaconProcess._startup_integrity_pass(bp_pass)
+    BeaconProcess._integrity_pass(bp_pass)
     assert sync_requests == [expected]   # truncated tail -> catch-up sync
 
     # an up-to-date head (restart mid-round, head == expected - 1 — the
@@ -418,7 +425,7 @@ def test_startup_scan_catches_head_truncation(chain):
     for r in range(N - 2, N):
         victim.put(chain.beacons[r])     # restore through N-1
     sync_requests.clear()
-    BeaconProcess._startup_integrity_pass(bp_pass)
+    BeaconProcess._integrity_pass(bp_pass)
     assert sync_requests == []
 
     # before genesis nothing is expected (fresh network, empty store)

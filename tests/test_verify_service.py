@@ -444,3 +444,278 @@ def test_device_backend_gets_service_owned_sharding():
     placed = ver._shard_round_axis((arr,))[0]
     assert dict(placed.sharding.mesh.shape)["round"] == len(jax.devices())
     svc.stop()
+
+
+# -- the device failure domain ------------------------------------------------
+# watchdog deadlines, retry-once + atomic failover, requeue-not-fail,
+# canary re-promotion, per-chunk error containment (ISSUE 7)
+
+
+import time  # noqa: E402  (test code; real-time waits on service threads)
+
+
+class FlakyBackend(StubBackend):
+    """Raises on every dispatch until `healed` is set."""
+
+    def __init__(self):
+        super().__init__()
+        self.healed = threading.Event()
+        self.attempts = 0
+
+    def verify_batch(self, rounds, sigs, prev_sigs=None):
+        self.attempts += 1
+        if not self.healed.is_set():
+            raise ConnectionError("device unreachable")
+        return super().verify_batch(rounds, sigs, prev_sigs)
+
+
+def test_failing_chunk_contained_to_its_callers():
+    """The r7 containment regression: two coalesced callers, one poisoned
+    chunk — only the overlapping caller sees the exception, the other
+    rider gets its verdicts (no fallback configured here, so the error
+    surfaces instead of failing over)."""
+    class PoisonChunk(StubBackend):
+        def verify_batch(self, rounds, sigs, prev_sigs=None):
+            if 3 in rounds:
+                raise ValueError("poisoned chunk")
+            return super().verify_batch(rounds, sigs, prev_sigs)
+
+    svc = make_service(pad=4, background_window=100.0)
+    h = svc.handle(SCHEME, PK, backend=PoisonChunk())
+    f1 = h.submit(*beacons([1, 2, 3, 4]))       # fills (poisoned) chunk 1
+    f2 = h.submit(*beacons([10, 11]))           # rides in clean chunk 2
+    svc.clock.advance(101.0)
+    with pytest.raises(ValueError):
+        f1.result(10)
+    assert f2.result(10).tolist() == [True, True]
+    svc.stop()
+
+
+def test_raise_failover_swaps_to_fallback_and_requeues():
+    """raise-on-dispatch: one strike (suspect) + one retry, then the
+    backend is swapped to the fallback and the requests REQUEUED — the
+    blocking caller resolves with correct verdicts, no exception."""
+    svc = make_service(pad=8)
+    dev, fb = FlakyBackend(), StubBackend()
+    h = svc.handle(SCHEME, PK, backend=dev, fallback=fb)
+    ok = h.verify_batch(*beacons([1, 2, 3], bad={2}))
+    assert ok.tolist() == [True, False, True]
+    assert dev.attempts == 2                    # original + the one retry
+    assert fb.calls == [[1, 2, 3]]
+    st = svc.stats()
+    assert st["failovers"] == 1
+    assert list(st["backends"].values()) == ["degraded"]
+    assert "DEGRADED" in svc.summary()
+    svc.stop()
+
+
+def test_wrong_shape_result_is_a_fault_and_fails_over():
+    """A poisoned device that ANSWERS with a wrong-shape verdict is a
+    backend fault, not a caller error."""
+    class Poisoned(StubBackend):
+        def verify_batch(self, rounds, sigs, prev_sigs=None):
+            return super().verify_batch(rounds, sigs, prev_sigs)[:-1]
+
+    svc = make_service(pad=8)
+    fb = StubBackend()
+    h = svc.handle(SCHEME, PK, backend=Poisoned(), fallback=fb)
+    ok = h.verify_batch(*beacons([1, 2, 3]))
+    assert ok.all()
+    assert fb.calls == [[1, 2, 3]]
+    assert svc.stats()["failovers"] == 1
+    svc.stop()
+
+
+def test_watchdog_abandons_hung_dispatch_and_fails_over():
+    """hang-forever: the first trip marks the backend suspect and
+    requeues on the device (the retry), the second trip degrades to the
+    fallback — the caller's future resolves, never an exception, and the
+    wedged dispatch threads are abandoned, not waited on."""
+    class HangingBackend(StubBackend):
+        def __init__(self):
+            super().__init__()
+            self.release = threading.Event()
+            self.hangs = 0
+
+        def verify_batch(self, rounds, sigs, prev_sigs=None):
+            self.hangs += 1
+            self.started.set()
+            self.release.wait(30)
+            raise ConnectionError("hung dispatch released")
+
+    svc = make_service(pad=8, watchdog_floor=10.0)
+    dev, fb = HangingBackend(), StubBackend()
+    h = svc.handle(SCHEME, PK, backend=dev, fallback=fb)
+    f = h.submit(*beacons([1, 2]), lane=LANE_LIVE)
+    assert dev.started.wait(10)
+    svc.clock.advance(11.0)         # trip 1: suspect, retry on the device
+    deadline = time.monotonic() + 10
+    while dev.hangs < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert dev.hangs == 2
+    svc.clock.advance(11.0)         # trip 2: degrade, requeue on fallback
+    assert f.result(10).tolist() == [True, True]
+    assert fb.calls == [[1, 2]]
+    st = svc.stats()
+    assert st["watchdog_trips"] == 2
+    assert st["failovers"] == 1
+    dev.release.set()               # free the abandoned dispatch threads
+    svc.stop()
+
+
+def test_watchdog_deadline_derives_from_latency_history():
+    svc = make_service(watchdog_floor=0.5, watchdog_factor=4.0)
+    h = svc.handle(SCHEME, PK, backend=StubBackend(), fallback=StubBackend())
+    slot = svc._slots[h.key]
+    assert svc._deadline_for(slot) == 0.5       # no history: the floor
+    slot.latencies.extend([0.1, 0.2, 1.0])
+    assert svc._deadline_for(slot) == pytest.approx(4.0)   # factor * p99
+    slot.latencies.clear()
+    slot.latencies.extend([0.01] * 50)
+    assert svc._deadline_for(slot) == 0.5       # floor covers cold compiles
+    svc.stop()
+
+
+def test_probe_repromotes_after_recovery():
+    svc = make_service(pad=8, probe_interval=5.0)
+    dev, fb = FlakyBackend(), StubBackend()
+    h = svc.handle(SCHEME, PK, backend=dev, fallback=fb)
+    dev.healed.set()
+    assert h.verify_batch(*beacons([1, 2])).all()   # healthy; sample stashed
+    dev.healed.clear()
+    assert h.verify_batch(*beacons([3, 4])).all()   # fails over
+    slot = svc._slots[h.key]
+    assert slot.state == "degraded"
+    dev.healed.set()                                # the device is back
+    svc.clock.advance(6.0)                          # past the probe interval
+    deadline = time.monotonic() + 10
+    while slot.state != "healthy" and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert slot.state == "healthy"
+    before = len(dev.calls)
+    assert h.verify_batch(*beacons([5])).all()
+    assert len(dev.calls) > before                  # device serves again
+    assert svc.stats()["promotions"] == 1
+    svc.stop()
+
+
+def test_probe_rejects_wrong_verdict_device():
+    """Re-promotion requires the canary to MATCH the stashed known-good
+    verdict: a device that answers but answers wrong stays degraded."""
+    class LyingBackend(StubBackend):
+        def __init__(self):
+            super().__init__()
+            self.mode = "ok"
+
+        def verify_batch(self, rounds, sigs, prev_sigs=None):
+            if self.mode == "raise":
+                raise ConnectionError("down")
+            out = super().verify_batch(rounds, sigs, prev_sigs)
+            return ~out if self.mode == "lie" else out
+
+    svc = make_service(pad=8, probe_interval=5.0)
+    dev, fb = LyingBackend(), StubBackend()
+    h = svc.handle(SCHEME, PK, backend=dev, fallback=fb)
+    assert h.verify_batch(*beacons([1, 2])).all()   # sample: round 1 -> True
+    dev.mode = "raise"
+    assert h.verify_batch(*beacons([3, 4])).all()   # degrade (via fallback)
+    slot = svc._slots[h.key]
+    assert slot.state == "degraded"
+    dev.mode = "lie"                                # answers, wrongly
+    svc.clock.advance(6.0)
+    time.sleep(0.5)                                 # let the probe run
+    assert slot.state in ("degraded", "probing")
+    assert svc.stats()["promotions"] == 0
+    svc.stop()
+
+
+def test_partials_fall_back_to_host_factory_on_device_failure():
+    """Live partial aggregation survives device loss: the opaque call is
+    retried once, then the lane verifier falls back to the host factory
+    instead of costing the round."""
+    svc = make_service()
+    calls = {"dev": 0, "host": 0}
+
+    def dev_factory(scheme, poly, n):
+        def verify(msg, ps):
+            calls["dev"] += 1
+            raise ConnectionError("device gone")
+        return types.SimpleNamespace(verify=verify, kind="device")
+
+    def host_factory(scheme, poly, n):
+        def verify(msg, ps):
+            calls["host"] += 1
+            return [True] * len(ps)
+        return types.SimpleNamespace(verify=verify, kind="host")
+
+    pv = svc.partials_factory(dev_factory, fallback_factory=host_factory)(
+        SCHEME, None, 3)
+    assert pv.verify(b"m", [b"p1", b"p2"]) == [True, True]
+    assert calls["dev"] == 2 and calls["host"] == 1
+    svc.stop()
+
+
+def test_service_threads_are_named_and_reaped():
+    svc = make_service()
+    h = svc.handle(SCHEME, PK, backend=StubBackend())
+    assert h.verify_batch(*beacons([1])).all()
+    sched, wd = svc._thread, svc._watchdog_thread
+    assert sched.name == "verify-scheduler"
+    assert wd.name == "verify-watchdog"
+    svc.stop()
+    sched.join(5)
+    wd.join(5)
+    assert not sched.is_alive() and not wd.is_alive()
+
+
+# -- seeded device-fault chaos (ISSUE 7 acceptance) ---------------------------
+
+
+@pytest.fixture(scope="module")
+def chaos_chain():
+    from chaos import TrueChain
+    return TrueChain(n=24)
+
+
+def test_device_flap_chaos_scenario(chaos_chain):
+    """The acceptance scenario: mixed live/background workload through a
+    flapping device — every future resolves, verdicts identical to a
+    host-only run, failover within one watchdog deadline, re-promotion
+    after recovery, then the device serves again."""
+    from chaos import DeviceChaosScenario
+
+    result = DeviceChaosScenario(seed=1234, rounds=24,
+                                 chain=chaos_chain).run()
+    assert result.all_resolved
+    assert result.verdicts_match_host
+    assert result.failovers >= 1
+    assert result.failover_latency is not None
+    assert result.failover_latency <= result.deadline
+    assert result.repromoted and result.final_state == "healthy"
+    assert result.device_served_after_recovery
+    assert result.ok
+
+
+def test_device_flap_scenario_is_seed_deterministic(chaos_chain):
+    from chaos import DeviceChaosScenario
+
+    r1 = DeviceChaosScenario(seed=77, chain=chaos_chain).run()
+    r2 = DeviceChaosScenario(seed=77, chain=chaos_chain).run()
+    assert r1.ok and r2.ok
+    assert r1.failovers == r2.failovers
+    assert r1.verdicts_match_host and r2.verdicts_match_host
+
+
+def test_device_death_mid_catchup_sync_converges_via_host(chaos_chain):
+    """Kill the device backend mid-catch-up-sync on a 3-node network:
+    the sync plane must converge through the host failover path before
+    the round deadline."""
+    from chaos import DeviceFailoverSyncScenario
+
+    result = DeviceFailoverSyncScenario(seed=99, rounds=24,
+                                        chain=chaos_chain).run()
+    assert result.converged
+    assert result.degraded                  # the device really died mid-sync
+    assert not result.faulty_after_sync
+    assert result.elapsed <= result.period
+    assert result.ok

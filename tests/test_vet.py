@@ -182,6 +182,35 @@ def test_verifier_checker_catches_fixture():
     assert not any(f.path.startswith("crypto/") for f in report.findings)
 
 
+def test_wait_checker_catches_fixture():
+    report = _fixture_report("wait")
+    codes = _codes(report, "wait_bad.py")
+    assert ("wait_bad.py", "wait-unbounded") in codes
+    lines = {f.line for f in report.findings if f.path == "wait_bad.py"}
+    # future.result, thread.join, condition.wait, event.wait — all caught
+    assert len(lines) == 4, sorted(lines)
+    msgs = [f.message for f in report.findings
+            if f.path == "wait_bad.py"]
+    assert any(".result()" in m for m in msgs)
+    assert any(".join()" in m for m in msgs)
+    assert any(".wait()" in m for m in msgs)
+    # bounded variants, str.join, get_nowait stay silent; the justified
+    # suppression is a suppression, not a finding
+    assert len([f for f in report.suppressed
+                if f.path == "wait_bad.py"]) == 1
+
+
+def test_wait_checker_exempts_test_code(tmp_path):
+    """The discipline targets production code: tests wait on work they
+    control, bounded by pytest's own timeout machinery."""
+    src = tmp_path / "test_something.py"
+    src.write_text(
+        "def test_x(fut):\n"
+        "    return fut.result()\n")
+    report = run_vet([str(src)], checkers=by_names(["wait"]))
+    assert report.findings == []
+
+
 def test_all_fixture_violations_found_by_full_run():
     """One full-corpus run: every checker contributes findings (no
     checker silently stopped matching its fixture)."""
@@ -330,7 +359,7 @@ def test_cli_write_baseline_then_clean(tmp_path, capsys):
 
 def test_checker_registry_names_are_suppression_tokens():
     assert checker_names() == ["clock", "lock", "secret", "trace", "store",
-                               "verifier"]
-    assert len(ALL_CHECKERS) == 6
+                               "verifier", "wait"]
+    assert len(ALL_CHECKERS) == 7
     with pytest.raises(KeyError):
         by_names(["not-a-checker"])

@@ -56,6 +56,46 @@ def storage_main(args) -> int:
     return 0 if result.ok else 1
 
 
+def device_main(args) -> int:
+    """--device mode: the device-fault failover scenario — a flapping
+    device backend under a mixed live/background verify workload.  Every
+    future must resolve with verdicts identical to a host-only run,
+    failover must land within one watchdog deadline, and the canary
+    probe must re-promote the device after recovery."""
+    from chaos import DeviceChaosScenario, DeviceFailoverSyncScenario
+
+    scenario = DeviceChaosScenario(seed=args.seed, rounds=args.rounds)
+    result = scenario.run()
+    print(f"seed            : {args.seed}")
+    print(f"rounds          : {args.rounds}")
+    print(f"all resolved    : {result.all_resolved}")
+    print(f"verdict parity  : {result.verdicts_match_host}")
+    print(f"failovers       : {result.failovers}")
+    print(f"watchdog trips  : {result.watchdog_trips}")
+    print(f"failover latency: {result.failover_latency} "
+          f"(deadline {result.deadline})")
+    print(f"re-promoted     : {result.repromoted}")
+    print(f"device resumed  : {result.device_served_after_recovery}")
+    print(f"final state     : {result.final_state}")
+
+    sync = DeviceFailoverSyncScenario(seed=args.seed,
+                                      rounds=args.rounds).run()
+    print(f"sync converged  : {sync.converged} (device killed mid-sync)")
+    print(f"sync elapsed    : {sync.elapsed:.1f}s fake "
+          f"(round period {sync.period:.0f}s)")
+    print(f"sync degraded   : {sync.degraded}")
+
+    from drand_tpu.metrics import scrape
+    lines = [l for l in scrape("private").decode().splitlines()
+             if l.startswith(("verify_service_failovers",
+                              "verify_service_backend_state",
+                              "verify_service_watchdog_trips"))]
+    print("failover series :")
+    for line in lines:
+        print(f"  {line}")
+    return 0 if result.ok and sync.ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=42)
@@ -66,10 +106,17 @@ def main() -> int:
                     help="run the at-rest storage-fault scenario "
                          "(integrity scan + quarantine + peer repair) "
                          "instead of the network chaos scenario")
+    ap.add_argument("--device", action="store_true",
+                    help="run the device-fault failover scenario "
+                         "(watchdog + host failover + canary "
+                         "re-promotion) instead of the network chaos "
+                         "scenario")
     args = ap.parse_args()
 
     if args.storage:
         return storage_main(args)
+    if args.device:
+        return device_main(args)
 
     from chaos import ChaosScenario
 
