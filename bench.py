@@ -499,6 +499,14 @@ def _emit(configs, stats):
         "unit": "rounds/s",
         "vs_baseline": round(headline / BASELINE_RPS, 3),
         "degraded": degraded,
+        # serving-plane headline (tools/loadgen.py --selftest, CPU-only):
+        # REST reads served/sec through the bounded edge + admission
+        # controller, and the shed ratio under the deliberate overload
+        "loadgen": {
+            k.replace("loadgen_", ""): stats[k]
+            for k in ("loadgen_rounds_served_per_s", "loadgen_shed_ratio",
+                      "loadgen_shed_well_formed", "loadgen_error")
+            if k in stats} or None,
         "backends": backends,
         "configs": configs,
         "n": {"streamed_store": N_STREAM, "unchained_resident": N_RESIDENT,
@@ -512,6 +520,35 @@ def _emit(configs, stats):
     return headline
 
 
+def _loadgen_numbers(stats):
+    """Record the serving-plane headline (ROADMAP 5a): a short in-process
+    loadgen selftest — CPU-only, independent of the chip — whose
+    rounds-served/sec + shed-ratio land next to the degraded flag.  Any
+    failure is recorded, never fatal: the verify numbers must not hostage
+    the edge numbers or vice versa."""
+    import subprocess
+    lg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "loadgen.py")
+    try:
+        proc = subprocess.run(
+            [sys.executable, lg, "--selftest", "--json", "--duration", "3"],
+            capture_output=True, text=True, timeout=120)
+        line = [l for l in proc.stdout.splitlines()
+                if l.startswith("{")][-1]
+        rep = json.loads(line)
+        stats["loadgen_rounds_served_per_s"] = rep["rounds_served_per_s"]
+        stats["loadgen_shed_ratio"] = rep["shed_ratio"]
+        stats["loadgen_shed_well_formed"] = rep["shed_well_formed"]
+        if proc.returncode != 0:
+            # numbers are recorded, but a failing selftest (errors, or a
+            # flood that never shed) must be visible in the artifact
+            stats["loadgen_error"] = (
+                f"selftest exit {proc.returncode}: ok={rep.get('ok')} "
+                f"shed={rep.get('shed')} errors={rep.get('errors')}")
+    except Exception as e:
+        stats["loadgen_error"] = f"{type(e).__name__}: {e}"[:200]
+
+
 def main():
     import subprocess
     import threading
@@ -520,6 +557,7 @@ def main():
     order = [i for i in _ORDER if i in which]
     configs = {_RUNNERS[i]: None for i in order}
     stats = {}
+    _loadgen_numbers(stats)
     # per-config ceiling (a hung compile RPC blocks in native code and can
     # only be killed from outside) and a whole-bench budget
     cfg_budget = int(os.environ.get("DRAND_TPU_BENCH_CONFIG_TIMEOUT", "2400"))

@@ -8,9 +8,10 @@ from .trace import TraceChecker
 from .store import StoreChecker
 from .verifier import VerifierChecker
 from .wait import WaitChecker
+from .bounds import BoundsChecker
 
 ALL_CHECKERS = (ClockChecker, LockChecker, SecretChecker, TraceChecker,
-                StoreChecker, VerifierChecker, WaitChecker)
+                StoreChecker, VerifierChecker, WaitChecker, BoundsChecker)
 
 
 def checker_names():

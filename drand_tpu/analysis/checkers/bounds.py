@@ -1,0 +1,125 @@
+"""bounds-discipline: serving-path queues, pools and servers must be
+bounded.
+
+The serving plane survives overload by *refusing* work (net/admission.py),
+and that only holds if no construct underneath can absorb unbounded work
+first: an unbounded `queue.Queue()` buffers a flood instead of shedding
+it, a `ThreadPoolExecutor()` without `max_workers` scales threads with
+CPU count silently, and `ThreadingHTTPServer` spawns one thread per
+request with no ceiling at all — the exact resource-exhaustion bug the
+beacon-client security review (arXiv:2109.11677) calls the dominant
+practical failure class.
+
+Scope: the serving paths only — `net/`, `http_server.py`, `relay.py`.
+Internal planes (DKG broadcast buffers, the aggregator's partial queue)
+are ingress-validated and threshold-bounded upstream, so they keep their
+simpler constructs.  A deliberate unbounded construct in scope carries a
+`# tpu-vet: disable=bounds` suppression WITH a justification.
+
+Flagged:
+  * ``queue.Queue()`` / ``LifoQueue`` / ``PriorityQueue`` /
+    ``SimpleQueue`` with no ``maxsize`` (or an explicit ``maxsize=0``) —
+    SimpleQueue cannot be bounded at all.
+  * ``ThreadPoolExecutor(...)`` / ``ProcessPoolExecutor(...)`` without
+    ``max_workers``.
+  * ``ThreadingHTTPServer`` / ``ThreadingTCPServer`` construction or
+    subclassing (thread-per-request; use http_server.BoundedHTTPServer).
+"""
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import Finding
+from ..symbols import ModuleInfo, dotted
+
+SCOPE_PREFIXES = ("net/",)
+SCOPE_FILES = ("http_server.py", "relay.py")
+
+BOUNDED_QUEUES = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue"}
+UNBOUNDABLE_QUEUES = {"queue.SimpleQueue"}
+EXECUTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+THREAD_PER_REQUEST = {"ThreadingHTTPServer", "ThreadingTCPServer",
+                      "ThreadingUnixStreamServer"}
+
+
+def _in_scope(rel: str) -> bool:
+    return any(rel.startswith(p) for p in SCOPE_PREFIXES) \
+        or rel in SCOPE_FILES
+
+
+def _positive_const(node: ast.AST) -> Optional[bool]:
+    """True/False for a literal int bound; None when the value is
+    computed (give it the benefit of the doubt — the bound exists)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value > 0
+    return None
+
+
+class BoundsChecker:
+    name = "bounds"
+    description = ("unbounded queue/executor/thread-per-request server "
+                   "construction on serving paths (net/, http_server.py, "
+                   "relay.py)")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if not _in_scope(module.rel):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                for base in node.bases:
+                    qual = module.resolve(dotted(base) or "")
+                    if qual.split(".")[-1] in THREAD_PER_REQUEST:
+                        yield self._finding(
+                            module, node, "bounds-thread-per-request",
+                            f"class {node.name} inherits "
+                            f"{qual.split('.')[-1]}: thread-per-request "
+                            "with no ceiling; build on a bounded worker "
+                            "pool (http_server.BoundedHTTPServer)")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            qual = module.resolve(dotted(node.func) or "")
+            last = qual.split(".")[-1]
+            if qual in UNBOUNDABLE_QUEUES:
+                yield self._finding(
+                    module, node, "bounds-unbounded-queue",
+                    "queue.SimpleQueue cannot be bounded; use "
+                    "queue.Queue(maxsize=...) on serving paths")
+            elif qual in BOUNDED_QUEUES:
+                if not self._has_bound(node, "maxsize"):
+                    yield self._finding(
+                        module, node, "bounds-unbounded-queue",
+                        f"{qual}() without a positive maxsize buffers an "
+                        "unbounded backlog on a serving path; bound it "
+                        "(shedding beats buffering under overload)")
+            elif last in EXECUTORS:
+                if not self._has_bound(node, "max_workers"):
+                    yield self._finding(
+                        module, node, "bounds-unbounded-executor",
+                        f"{last}() without max_workers sizes the pool "
+                        "from the machine, not the workload; pass an "
+                        "explicit bound on serving paths")
+            elif last in THREAD_PER_REQUEST:
+                yield self._finding(
+                    module, node, "bounds-thread-per-request",
+                    f"{last} spawns one thread per request with no "
+                    "ceiling; use a bounded worker pool "
+                    "(http_server.BoundedHTTPServer)")
+
+    @staticmethod
+    def _has_bound(node: ast.Call, kw_name: str) -> bool:
+        if node.args:
+            first = _positive_const(node.args[0])
+            return first is not False    # literal 0 is "unbounded" spelled out
+        for kw in node.keywords:
+            if kw.arg == kw_name:
+                return _positive_const(kw.value) is not False
+            if kw.arg is None:
+                return True              # **kwargs: cannot prove either way
+        return False
+
+    def _finding(self, module: ModuleInfo, node: ast.AST, code: str,
+                 message: str) -> Finding:
+        return Finding(checker=self.name, code=code, message=message,
+                       path=module.rel, line=node.lineno,
+                       col=node.col_offset)

@@ -96,17 +96,20 @@ class ChainStore:
     def integrity_scan(self, verifier=None, mode: str = "full",
                        upto: Optional[int] = None, progress=None,
                        beacon_id: str = "default", chunk: int = 512,
-                       trigger: str = "startup"):
+                       trigger: str = "startup", resume=None):
         """Scan the RAW backend (below the decorators — corruption hides
         underneath them) against this chain's scheme + genesis seed.
         Returns a chain.integrity.ScanReport; pair with
-        `SyncManager.heal` to quarantine + re-fetch what it finds."""
+        `SyncManager.heal` to quarantine + re-fetch what it finds.
+        `resume` (a chain.integrity.ScanCheckpoint) skips the prefix a
+        previous scan already proved clean."""
         from ..chain.integrity import IntegrityScanner
         return IntegrityScanner(
             self.backend, self.vault.scheme, verifier=verifier,
             genesis_seed=self.group.get_genesis_seed(), chunk=chunk,
             beacon_id=beacon_id, trigger=trigger).scan(mode=mode, upto=upto,
-                                                       progress=progress)
+                                                       progress=progress,
+                                                       resume=resume)
 
     def wait_for_round(self, round_: int, timeout: float,
                        scheduled_time: bool = False) -> Optional[Beacon]:

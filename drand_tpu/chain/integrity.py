@@ -25,6 +25,7 @@ under the sync budget; `tools/chain_doctor.py` drives the same loop
 offline).
 """
 
+import hashlib
 import json
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence
@@ -43,6 +44,57 @@ MODE_FULL = "full"
 
 DEFAULT_CHUNK = 512
 
+# initial state of the rolling scan digest (a fixed domain-separation
+# constant, so an empty-prefix checkpoint is distinguishable from junk)
+_DIGEST_SEED = hashlib.sha256(b"drand-tpu-scan-digest-v1").hexdigest()
+
+
+def _roll_digest(digest_hex: str, round_: int, sig: bytes) -> str:
+    return hashlib.sha256(bytes.fromhex(digest_hex)
+                          + round_.to_bytes(8, "big")
+                          + bytes(sig)).hexdigest()
+
+
+@dataclass(frozen=True)
+class ScanCheckpoint:
+    """Resumability watermark (ROADMAP item 6): the highest round R such
+    that every round 1..R scanned CLEAN, plus a rolling digest over those
+    rounds' (round, signature) pairs and the checkpoint row's own
+    signature hash.  A scheduled scan resumes at R+1 after re-reading row
+    R and matching `sig_sha` — the ONLY check a resume performs:
+    re-verifying the whole prefix would cost the O(chain) pass
+    resumability exists to skip, so a resume trusts the prefix on the
+    strength of that one row.  A truncated, restored-from-backup, or
+    row-R-rewritten store fails the match and triggers a full rescan; a
+    prefix rewritten UNDER an intact row R is caught by the next
+    full-walk trigger (the startup pass never resumes).  The rolling
+    `digest` is carried forward as an audit fingerprint of the vouched
+    prefix — comparable across scans, replicas, and backups by
+    operators/tooling — and is deliberately NOT re-derived on resume.
+    `mode` records what the prefix was proven AT: a full-crypto scan may
+    resume from a full checkpoint only (a linkage checkpoint never had
+    its signatures verified); a linkage scan resumes from either."""
+
+    round: int
+    digest: str      # rolling sha256 hex over the clean prefix
+    sig_sha: str     # sha256 hex of row `round`'s signature bytes
+    mode: str = MODE_FULL
+
+    def to_json(self) -> str:
+        return json.dumps({"round": self.round, "digest": self.digest,
+                           "sig_sha": self.sig_sha, "mode": self.mode},
+                          sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ScanCheckpoint":
+        d = json.loads(text)
+        return cls(round=int(d["round"]), digest=str(d["digest"]),
+                   sig_sha=str(d["sig_sha"]),
+                   mode=str(d.get("mode", MODE_FULL)))
+
+    def covers(self, mode: str) -> bool:
+        return self.mode == MODE_FULL or self.mode == mode
+
 
 @dataclass(frozen=True)
 class Finding:
@@ -58,6 +110,10 @@ class ScanReport:
     scanned: int = 0
     verifier: str = "none"
     findings: List[Finding] = field(default_factory=list)
+    # resumability: where this scan started (0 = genesis) and the new
+    # watermark for the next scan (None when no clean prefix exists)
+    resumed_from: int = 0
+    checkpoint: Optional[ScanCheckpoint] = None
 
     @property
     def clean(self) -> bool:
@@ -80,6 +136,7 @@ class ScanReport:
         return {
             "mode": self.mode, "upto": self.upto, "scanned": self.scanned,
             "verifier": self.verifier, "clean": self.clean,
+            "resumed_from": self.resumed_from,
             "findings": [{"round": f.round, "kind": f.kind,
                           "detail": f.detail} for f in self.findings],
         }
@@ -134,11 +191,17 @@ class IntegrityScanner:
     # -- scanning ------------------------------------------------------------
 
     def scan(self, mode: str = MODE_FULL, upto: Optional[int] = None,
-             progress: Optional[Callable[[int, int], None]] = None
-             ) -> ScanReport:
+             progress: Optional[Callable[[int, int], None]] = None,
+             resume: Optional[ScanCheckpoint] = None) -> ScanReport:
         """Walk rounds 1..upto (default: the store head) and report every
         integrity violation.  Emits per-chunk `progress(done, upto)` and
-        the chain_integrity_* metrics counters."""
+        the chain_integrity_* metrics counters.
+
+        `resume` skips the already-proven clean prefix: the checkpoint
+        row is re-read and its signature hash must match, else the scan
+        silently falls back to a full walk (`report.resumed_from` says
+        which happened).  Every scan emits a fresh `report.checkpoint`
+        advancing the watermark over the rounds that scanned clean."""
         from ..metrics import integrity_beacons_scanned, integrity_corrupt_found
         if mode not in (MODE_LINKAGE, MODE_FULL):
             raise ValueError(f"unknown scan mode {mode!r}")
@@ -162,6 +225,18 @@ class IntegrityScanner:
         anchor = self._anchor()                 # signature of round 0
         prev_sig: Optional[bytes] = anchor
         prev_round = 0
+        digest = _DIGEST_SEED
+        start_round = 1
+        if resume is not None and resume.covers(mode) \
+                and 1 <= resume.round <= report.upto:
+            row = self._checkpoint_row(resume, sig_len)
+            if row is not None:
+                # clean prefix re-anchored: resume right after it
+                prev_sig = row.signature
+                prev_round = resume.round
+                digest = resume.digest
+                start_round = resume.round + 1
+                report.resumed_from = resume.round
         buf: List[Beacon] = []
         buf_prevs: List[Optional[bytes]] = []
         unverified = set()      # rounds whose signature never reached verify
@@ -179,11 +254,19 @@ class IntegrityScanner:
                 integrity_beacons_scanned.labels(
                     self.beacon_id, vkind, self.trigger).inc(unflushed)
                 unflushed = 0
+            # watermark: commit only while the scan is STILL clean — the
+            # first finding freezes the checkpoint at the previous flush,
+            # so the next resume re-examines everything from there on
+            if not report.findings and prev_round >= 1 \
+                    and prev_sig is not None:
+                report.checkpoint = ScanCheckpoint(
+                    prev_round, digest,
+                    hashlib.sha256(prev_sig).hexdigest(), mode)
             if progress is not None:
                 progress(done_round, report.upto)
 
         cur = self.store.cursor()
-        b = _cursor_seek(cur, 1)
+        b = _cursor_seek(cur, start_round)
         while b is not None and b.round <= report.upto:
             r = b.round
             if r > prev_round + 1:
@@ -227,6 +310,8 @@ class IntegrityScanner:
             # a torn row can't anchor the next round's linkage
             prev_sig = sig if well_formed else None
             prev_round = r
+            if well_formed:
+                digest = _roll_digest(digest, r, sig)
             if len(buf) >= self.chunk:
                 flush(r)
             b = cur.next()
@@ -264,6 +349,21 @@ class IntegrityScanner:
                     f"failed verification against round {f.round - 1}'s "
                     "signature, which is itself corrupt/unproven — not "
                     "provably invalid; re-fetch to decide")
+
+    def _checkpoint_row(self, resume: ScanCheckpoint,
+                        sig_len: int) -> Optional[Beacon]:
+        """Re-read the checkpoint row and demand its signature hash still
+        matches; None (= full rescan) when the row vanished, changed, or
+        is malformed.  One point read buys skipping the whole prefix."""
+        try:
+            row = self.store.get(resume.round)
+        except Exception:
+            return None
+        if row is None or len(row.signature) != sig_len:
+            return None
+        if hashlib.sha256(row.signature).hexdigest() != resume.sig_sha:
+            return None
+        return row
 
     def _anchor(self) -> Optional[bytes]:
         """Round 1's previous signature: the stored genesis beacon (round

@@ -75,6 +75,10 @@ class BeaconProcess:
         self._scan_stop: Optional[threading.Event] = None
         self._scan_thread: Optional[threading.Thread] = None
         self._repair_thread: Optional[threading.Thread] = None
+        # integrity-scan resumability watermark (chain/integrity.py
+        # ScanCheckpoint): in-memory always, persisted next to the sqlite
+        # db so a restart resumes instead of rescanning from genesis
+        self._scan_ckpt = None
         self._lock = threading.Lock()
 
     # -- persistence (drand_beacon.go:110-162) ------------------------------
@@ -300,17 +304,32 @@ class BeaconProcess:
                           "catch-up sync", head=stored_head,
                           expected=expected, behind=behind)
             self._on_sync_needed(expected)
+        # Resumability (ROADMAP item 6): scheduled reruns skip the prefix
+        # a previous scan proved clean (the checkpoint re-anchors against
+        # the stored row — a mismatch falls back to a full walk).  The
+        # startup pass deliberately re-walks everything: it is the once-
+        # per-boot paranoia pass, and it refreshes the watermark.
+        resume = self._load_scan_checkpoint() if trigger == "scheduled" \
+            else None
         try:
             report = self.handler.chain.integrity_scan(
                 verifier=verifier, mode=mode, upto=stored_head or None,
-                beacon_id=self.beacon_id, trigger=trigger)
+                beacon_id=self.beacon_id, trigger=trigger,
+                **({"resume": resume} if resume is not None else {}))
         except Exception as e:
             self.log.error("integrity scan failed", trigger=trigger,
                            err=str(e))
             return
+        if trigger == "scheduled":
+            from ..metrics import integrity_scan_resumed_from
+            integrity_scan_resumed_from.labels(self.beacon_id).set(
+                report.resumed_from)
+        if report.checkpoint is not None:
+            self._save_scan_checkpoint(report.checkpoint)
         if report.clean:
             self.log.info("integrity scan clean", trigger=trigger,
-                          mode=mode, scanned=report.scanned)
+                          mode=mode, scanned=report.scanned,
+                          resumed_from=report.resumed_from)
             return
         faulty = report.faulty_rounds
         shown = ",".join(str(r) for r in faulty[:20])
@@ -364,15 +383,52 @@ class BeaconProcess:
                 name=f"integrity-repair-{self.beacon_id}")
             self._repair_thread.start()
 
+    def _scan_checkpoint_path(self) -> Optional[str]:
+        """Sidecar file for the scan watermark — sqlite only (memdb is
+        volatile by contract, postgres is a server whose client may not
+        even share a filesystem; both keep the in-memory watermark)."""
+        if self.cfg.db_engine != "sqlite":
+            return None
+        return os.path.join(self.cfg.db_folder(self.beacon_id),
+                            "scan_checkpoint.json")
+
+    def _load_scan_checkpoint(self):
+        path = self._scan_checkpoint_path()
+        if path is None:
+            return self._scan_ckpt
+        from ..chain.integrity import ScanCheckpoint
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                return ScanCheckpoint.from_json(f.read())
+        except (OSError, ValueError, KeyError, TypeError):
+            return self._scan_ckpt      # unreadable/corrupt: full rescan
+
+    def _save_scan_checkpoint(self, ckpt) -> None:
+        self._scan_ckpt = ckpt
+        path = self._scan_checkpoint_path()
+        if path is None:
+            return
+        import tempfile
+        try:
+            # temp + rename: a crash mid-write must leave the old (or no)
+            # watermark, never a torn one (worst case = full rescan)
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                       prefix=".scan_ckpt.")
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                f.write(ckpt.to_json())
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
     def _start_scheduled_scans(self) -> None:
         """Rerun the integrity pass every cfg.integrity_scan_interval
         seconds on the daemon clock (ROADMAP item 6: scans must not be a
         startup-only event — at-rest corruption happens while serving
         too).  Full-mode verification rides the verify service's
-        BACKGROUND lane, so a scan never starves live partials.  Each
-        pass re-walks the whole chain; the last-clean-round watermark
-        that would make this O(delta) is the ROADMAP "scan resumability"
-        follow-up."""
+        BACKGROUND lane, so a scan never starves live partials; each
+        scheduled pass resumes from the persisted clean-prefix watermark
+        (O(delta) instead of O(chain), see ScanCheckpoint) and defers
+        outright while the admission ladder has background work paused."""
         with self._lock:
             if self._scan_thread is not None:
                 return
@@ -387,6 +443,16 @@ class BeaconProcess:
                 if stop.is_set() or self.handler is None \
                         or self.syncm is None:
                     return      # beacon stopped under us
+                # degradation ladder (net/admission.py): while the serving
+                # plane is overloaded, background housekeeping DEFERS to
+                # the next tick — the requeue-never-fail discipline; the
+                # scan is postponed, never dropped
+                adm = getattr(self.cfg, "_admission", None)
+                if adm is not None and adm.background_paused():
+                    self.log.warn("scheduled integrity scan deferred: "
+                                  "serving plane overloaded",
+                                  level=adm.level())
+                    continue
                 try:
                     self._integrity_pass(trigger="scheduled")
                 except Exception as e:

@@ -65,6 +65,22 @@ class Config:
     verify_probe_interval: float = 0.0
     _verify_service: Optional[object] = field(default=None, init=False,
                                               repr=False, compare=False)
+    # serving-plane admission control (net/admission.py): one controller
+    # per daemon, consulted by the gRPC listener, the REST edge and the
+    # SyncChain streams.  0 = module default (env-overridable there via
+    # the DRAND_ADMISSION_* family).  capacity is the total concurrency
+    # token pool, critical_reserve the slots only partials/DKG may take;
+    # shed/recover waits + dwell tune the hysteretic degradation ladder.
+    admission_capacity: int = 0
+    admission_critical_reserve: int = 0
+    admission_max_streams_per_peer: int = 0
+    admission_shed_wait: float = 0.0
+    admission_recover_wait: float = 0.0
+    admission_dwell: float = 0.0
+    admission_pace_rate: float = 0.0
+    rest_workers: int = 16              # REST edge worker-pool bound
+    _admission: Optional[object] = field(default=None, init=False,
+                                         repr=False, compare=False)
     # startup chain-integrity pass (chain/integrity.py): "off" trusts the
     # disk, "linkage" is the structural host-only scan (gaps, torn rows,
     # prev_sig linkage), "full" adds batched signature verification —
@@ -119,7 +135,43 @@ class Config:
                 background_window=self.verify_window,
                 watchdog_factor=self.verify_watchdog_factor or None,
                 probe_interval=self.verify_probe_interval or None)
+            # a service created while the admission ladder already has
+            # background work paused must start paused, not race a level
+            # change it never saw
+            adm = self._admission
+            if adm is not None and adm.background_paused():
+                self._verify_service.set_background_paused(True)
         return self._verify_service
+
+    def admission(self):
+        """The daemon-owned serving-plane admission controller
+        (net/admission.py), created on first use and bound to the
+        daemon's injected clock.  The gRPC listener, the REST edge and
+        the SyncChain streams all consult this one controller; its
+        degradation ladder pauses the verify service's background lane
+        before any normal-class traffic is shed."""
+        if self._admission is None:
+            from ..net.admission import AdmissionController
+            self._admission = AdmissionController(
+                clock=self.clock,
+                capacity=self.admission_capacity,
+                critical_reserve=self.admission_critical_reserve,
+                max_streams_per_peer=self.admission_max_streams_per_peer,
+                shed_wait=self.admission_shed_wait,
+                recover_wait=self.admission_recover_wait,
+                dwell=self.admission_dwell,
+                pace_rate=self.admission_pace_rate,
+                background_hook=self._pause_background)
+        return self._admission
+
+    def _pause_background(self, paused: bool) -> None:
+        """Degradation-ladder hook: forward the pause to the verify
+        service when one exists (never CREATE one here — a load spike on
+        a daemon that has not needed verification yet must not spin up
+        the whole pipeline as a side effect)."""
+        svc = self._verify_service
+        if svc is not None:
+            svc.set_background_paused(paused)
 
     def stop_verify_service(self) -> None:
         """Tear the daemon-owned service down (scheduler + packer threads,

@@ -39,13 +39,20 @@ class DrandDaemon:
         self._exit = threading.Event()
 
         self.resilience = cfg.make_resilience(scope="node")
+        # one serving-plane admission controller for every inbound
+        # surface: the private gRPC gateway below, the REST edge (cli
+        # wiring passes daemon.admission into RestServer), and the
+        # SyncChain stream pacing — partials stay critical-class while
+        # public reads shed first (ROADMAP 5a overload protection)
+        self.admission = cfg.admission()
         self.gateway = PrivateGateway(
             cfg.private_listen,
             protocol_impl=ProtocolService(self),
             public_impl=PublicService(self),
             tls_cert=None if cfg.insecure else cfg.tls_cert,
             tls_key=None if cfg.insecure else cfg.tls_key,
-            resilience=self.resilience)
+            resilience=self.resilience,
+            admission=self.admission)
         self.control = ControlListener(ControlService(self),
                                        port=cfg.control_port)
         self.metrics: Optional[MetricsServer] = None

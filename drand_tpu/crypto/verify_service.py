@@ -327,6 +327,12 @@ class VerifyService:
         self._probe_thread: Optional[threading.Thread] = None
         self._packer = None
         self._stopped = False
+        # serving-plane degradation ladder (net/admission.py): while True
+        # the BACKGROUND lane does not drive dispatches — its requests
+        # queue (requeue-never-fail) and flush when the ladder steps back
+        # down.  Live work is never paused, and queued background
+        # requests still ride a live dispatch of the same chain for free.
+        self._bg_paused = False
         # stats (guarded by _cond; ints so tests need not scrape prom)
         self._submitted = 0
         self._dispatches = 0
@@ -534,7 +540,7 @@ class VerifyService:
                     return None
                 if self._queues[LANE_LIVE]:
                     lane = LANE_LIVE
-                elif self._queues[LANE_BACKGROUND]:
+                elif self._queues[LANE_BACKGROUND] and not self._bg_paused:
                     lane = LANE_BACKGROUND
                 else:
                     self._cond.wait(0.1)
@@ -1193,7 +1199,24 @@ class VerifyService:
                 "dispatch_lanes": self._dispatch_lanes,
                 "dispatch_slots": self._dispatch_slots,
                 "queue_depth": {ln: len(self._queues[ln]) for ln in LANES},
+                "background_paused": self._bg_paused,
             }
+
+    def set_background_paused(self, paused: bool) -> None:
+        """Admission-ladder hook (net/admission.py): pause/resume the
+        BACKGROUND lane's dispatching.  Queued background work waits —
+        it is never failed — and resumes flush-ready when the serving
+        plane recovers; a blocking background caller still resolves the
+        moment the pause lifts (or via stop())."""
+        with self._cond:
+            if self._bg_paused == paused:
+                return
+            self._bg_paused = paused
+            self._cond.notify_all()
+
+    def background_paused(self) -> bool:
+        with self._cond:
+            return self._bg_paused
 
     def degraded_backends(self) -> List[str]:
         """Labels of backends currently failed over to the host path
@@ -1212,6 +1235,8 @@ class VerifyService:
         if s["failovers"] or s["watchdog_trips"]:
             line += (f" failovers={s['failovers']}"
                      f" trips={s['watchdog_trips']}")
+        if s["background_paused"]:
+            line += " BG-PAUSED"
         deg = self.degraded_backends()
         if deg:
             line += " DEGRADED=" + ",".join(deg)

@@ -12,10 +12,11 @@ schedule so CDNs cache correctly.
 """
 
 import json
+import queue
 import threading
 import time
 from email.utils import formatdate
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler, HTTPServer
 from typing import Dict, List, Optional, Tuple
 
 from .beacon.clock import Clock, RealClock
@@ -24,8 +25,126 @@ from .chain.errors import ErrNoBeaconSaved, ErrNoBeaconStored
 from .chain.timing import time_of_round
 from .log import Logger
 from .metrics import api_call_counter, http_latency
+from .net.admission import CLASS_SHEDDABLE, Shed
 
 LONG_POLL_TIMEOUT = 60.0
+
+DEFAULT_REST_WORKERS = 16
+# accepted-but-not-yet-picked-up connections; beyond this the edge sheds
+DEFAULT_REST_BACKLOG = 64
+
+
+def _shed_bytes(retry_after: float) -> bytes:
+    """A complete, well-formed 429 — written raw to the socket BEFORE the
+    request line is parsed (shedding must stay cheaper than serving).
+    RFC 9110 Retry-After is integer delay-seconds; a fractional value
+    would be DISCARDED by conforming intermediaries, turning the header
+    into an immediate-retry invitation — round up, floor 1."""
+    import math
+    body = b'{"error":"overloaded"}'
+    return (b"HTTP/1.1 429 Too Many Requests\r\n"
+            b"Retry-After: " + str(max(1, math.ceil(retry_after))).encode() +
+            b"\r\nContent-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() +
+            b"\r\nConnection: close\r\n\r\n" + body)
+
+
+class _RestWorkerPool:
+    """Fixed pool of DAEMON worker threads over a BOUNDED queue: the
+    thread-per-request ThreadingHTTPServer this replaces was itself a
+    resource-exhaustion bug (unbounded non-daemon thread growth under a
+    read flood, and a wedged handler blocked interpreter exit)."""
+
+    _STOP = object()
+
+    def __init__(self, workers: int, backlog: int):
+        self.workers = max(1, workers)
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, backlog))
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"rest-worker-{i}")
+            for i in range(self.workers)]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, fn) -> bool:
+        """False when the backlog is full — the caller sheds."""
+        try:
+            self._q.put_nowait(fn)
+            return True
+        except queue.Full:
+            return False
+
+    def _run(self) -> None:
+        while True:
+            fn = self._q.get()
+            if fn is self._STOP:
+                return
+            try:
+                fn()
+            except Exception:
+                pass        # per-request errors were already reported
+
+    def stop(self, timeout: float = 2.0) -> None:
+        for _ in self._threads:
+            try:
+                self._q.put(self._STOP, timeout=timeout)
+            except queue.Full:
+                break       # daemon threads; process exit reaps them
+        for t in self._threads:
+            t.join(timeout=timeout)
+
+
+class BoundedHTTPServer(HTTPServer):
+    """HTTPServer dispatching to a `_RestWorkerPool` with serving-plane
+    admission (net/admission.py) checked BEFORE the request is parsed:
+    a shed costs one pre-serialized 429 write and a close.  Used by the
+    REST edge here and relay.HttpRelay; `admission=None` keeps the
+    bounded pool without the shedding (standalone relays)."""
+
+    allow_reuse_address = True
+
+    def __init__(self, addr, handler_cls, workers: int = DEFAULT_REST_WORKERS,
+                 backlog: int = DEFAULT_REST_BACKLOG, admission=None):
+        super().__init__(addr, handler_cls)
+        self.admission = admission
+        self.pool = _RestWorkerPool(workers, backlog)
+
+    def process_request(self, request, client_address):
+        ticket = None
+        if self.admission is not None:
+            try:
+                ticket = self.admission.admit(
+                    CLASS_SHEDDABLE, peer=str(client_address[0]))
+            except Shed as s:
+                self._shed(request, s.retry_after)
+                return
+        if not self.pool.submit(
+                lambda: self._work(request, client_address, ticket)):
+            if ticket is not None:
+                ticket.release()
+            self._shed(request, 1.0)
+
+    def _shed(self, request, retry_after: float) -> None:
+        try:
+            request.sendall(_shed_bytes(retry_after))
+        except OSError:
+            pass
+        self.shutdown_request(request)
+
+    def _work(self, request, client_address, ticket) -> None:
+        try:
+            self.finish_request(request, client_address)
+        except Exception:
+            self.handle_error(request, client_address)
+        finally:
+            self.shutdown_request(request)
+            if ticket is not None:
+                ticket.release()
+
+    def server_close(self) -> None:
+        super().server_close()
+        self.pool.stop()
 
 
 def _beacon_etag(b: Beacon) -> str:
@@ -129,7 +248,8 @@ class RestServer:
     chain is addressable by hash, the default one also without it."""
 
     def __init__(self, daemon, listen: str = "127.0.0.1:0",
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None, admission=None,
+                 workers: Optional[int] = None):
         self.daemon = daemon
         self.log = daemon.log.named("http")
         # the daemon's injected clock when it has one (health math must
@@ -137,6 +257,13 @@ class RestServer:
         self.clock = clock \
             or getattr(getattr(daemon, "cfg", None), "clock", None) \
             or RealClock()
+        # the daemon's serving-plane admission controller when it has one:
+        # REST reads are sheddable-class, first to go under load
+        self.admission = admission if admission is not None \
+            else getattr(daemon, "admission", None)
+        if workers is None:
+            workers = getattr(getattr(daemon, "cfg", None),
+                              "rest_workers", 0) or DEFAULT_REST_WORKERS
         host, _, port = listen.rpartition(":")
         self._handlers: Dict[str, _BeaconHandler] = {}
         self._hlock = threading.Lock()
@@ -163,8 +290,9 @@ class RestServer:
                 http_latency.labels(self.path.split("/")[-1] or "root") \
                     .observe(time.perf_counter() - t0)
 
-        self.httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
-                                         Handler)
+        self.httpd = BoundedHTTPServer((host or "127.0.0.1", int(port)),
+                                       Handler, workers=workers,
+                                       admission=self.admission)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
@@ -251,6 +379,16 @@ class RestServer:
         if svc is None:
             from .crypto.verify_service import current_service
             svc = current_service()
+        # serving-plane admission: the degradation-ladder level and the
+        # queue-wait p99s an operator (or loadgen) needs to see overload
+        # protection working without a metrics scrape
+        if self.admission is not None:
+            snap = self.admission.snapshot()
+            payload["admission"] = {
+                "level": snap["level"], "level_name": snap["level_name"],
+                "wait_p99": snap["wait_p99"],
+                "shed": sum(snap["shed"].values()),
+            }
         if svc is not None:
             payload["verify"] = svc.summary()
             # the failure-domain degraded line: name every backend that is

@@ -164,6 +164,39 @@ verify_probe_latency = Histogram(
     "verify_service_probe_latency_seconds",
     "Canary probe dispatch latency on a degraded device backend",
     ["chain"], registry=PRIVATE)
+# Serving-plane admission control (net/admission.py): every inbound
+# surface (gRPC listener, REST edge, SyncChain streams) consults one
+# controller.  `class` is critical|normal|sheddable, `decision` is
+# admitted|shed; `admission_level` is the degradation-ladder rung
+# (0 nominal, 1 shed-public, 2 pause-background, 3 shed-normal).
+admission_requests = Counter(
+    "admission_requests_total",
+    "Serving-plane admission decisions",
+    ["cls", "decision"], registry=PRIVATE)
+admission_wait_seconds = Histogram(
+    "admission_wait_seconds",
+    "Admission queue wait per admitted request (the ladder's p99 signal)",
+    ["cls"],
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0),
+    registry=PRIVATE)
+admission_level = Gauge(
+    "admission_level",
+    "Degradation-ladder level (0 nominal .. 3 shed-normal)",
+    registry=PRIVATE)
+admission_inflight = Gauge(
+    "admission_inflight",
+    "Requests currently holding an admission token", ["cls"],
+    registry=PRIVATE)
+admission_background_paused = Gauge(
+    "admission_background_paused",
+    "1 while the ladder has paused the verify service's background lane",
+    registry=PRIVATE)
+# Integrity-scan resumability (chain/integrity.py ScanCheckpoint): where
+# the latest scheduled scan resumed from (0 = scanned from genesis).
+integrity_scan_resumed_from = Gauge(
+    "chain_integrity_scan_resumed_from",
+    "Round the latest integrity scan resumed from (0 = full rescan)",
+    ["beacon_id"], registry=GROUP)
 
 
 def scrape(which: str = "group") -> bytes:

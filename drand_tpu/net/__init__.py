@@ -4,6 +4,8 @@ Reference: net/ (SURVEY.md §2.6).  Messages live in drand_tpu/protos;
 service specs in services.py; the generic service framework in rpc.py.
 """
 
+from .admission import (AdmissionController, AdmissionInterceptor, Shed,
+                        Ticket)
 from .client import CertManager, Peer, ProtocolClient
 from .listener import (ControlClient, ControlListener, Listener,
                        PrivateGateway)
@@ -17,4 +19,5 @@ __all__ = [
     "ControlListener", "Listener", "PrivateGateway", "CONTROL", "PROTOCOL",
     "PUBLIC", "BackoffPolicy", "BreakerOpen", "BreakerRegistry",
     "CircuitBreaker", "Deadline", "DeadlineExceeded", "ResiliencePolicy",
+    "AdmissionController", "AdmissionInterceptor", "Shed", "Ticket",
 ]

@@ -1,0 +1,557 @@
+"""Serving-plane admission control: priority classes, load shedding, and
+a graceful-degradation ladder (ROADMAP item 5a; the resource-exhaustion
+failure class of the beacon-client security review, arXiv:2109.11677).
+
+The daemon's inbound surfaces used to be unprotected: a flood of public
+reads could occupy every gRPC worker and starve partial-signature
+aggregation — costing live rounds to save a CDN a cache miss.  This
+module is ONE passive controller every inbound surface consults before
+doing work:
+
+  * **Priority classes.**  `critical` (Protocol partials/DKG RPCs) is
+    never shed and has reserved concurrency — `critical_reserve` tokens
+    no other class can take.  `normal` (SyncChain catch-up streams) gets
+    per-peer fair-share caps and chunk pacing so one hungry peer cannot
+    monopolize the pool.  `sheddable` (public gRPC/REST reads) is first
+    to go: it never waits for a token, and a shed costs one small write
+    before any parsing or routing.
+  * **Concurrency tokens + queue-wait signal.**  Admission is decided by
+    tokens (`capacity` total, `capacity - critical_reserve` for the
+    non-critical classes) plus the p99 of recent admission waits,
+    measured on the injected Clock.  When the p99 crosses `shed_wait`
+    the controller climbs the degradation ladder; it climbs back down
+    hysteretically (`recover_wait` < `shed_wait`, one step per `dwell`
+    seconds) so a load spike cannot make it flap.
+  * **Degradation ladder.**  Levels, in order:
+        0 nominal          — everything admitted
+        1 shed-public      — sheddable class rejected outright
+        2 pause-background — + the verify service's background lane is
+                             paused and scheduled integrity scans defer
+                             (requeue-never-fail: the work waits, it is
+                             not dropped)
+        3 shed-normal      — + normal class rejected; critical only
+    Background work is sacrificed BEFORE any normal-class shed: a sync
+    peer's catch-up matters more than our own housekeeping.
+  * **Cheap, well-formed rejections.**  gRPC callers get
+    `RESOURCE_EXHAUSTED` with a `retry-after` trailer (the
+    `AdmissionInterceptor` below, wired by net/listener.py); the REST
+    edge turns a `Shed` into `429` + `Retry-After` before the request
+    line is even parsed (http_server.py).
+
+The controller is deliberately PASSIVE — no threads of its own; levels
+are reassessed on every admit/release/snapshot from the injected clock —
+so it adds one lock acquisition to the serving path and nothing else.
+"""
+
+import os
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+CLASS_CRITICAL = "critical"
+CLASS_NORMAL = "normal"
+CLASS_SHEDDABLE = "sheddable"
+CLASSES = (CLASS_CRITICAL, CLASS_NORMAL, CLASS_SHEDDABLE)
+
+LEVEL_NOMINAL = 0
+LEVEL_SHED_PUBLIC = 1
+LEVEL_PAUSE_BACKGROUND = 2
+LEVEL_SHED_NORMAL = 3
+LEVEL_NAMES = {LEVEL_NOMINAL: "nominal",
+               LEVEL_SHED_PUBLIC: "shed-public",
+               LEVEL_PAUSE_BACKGROUND: "pause-background",
+               LEVEL_SHED_NORMAL: "shed-normal"}
+
+# Module defaults; Config.admission_* overrides per daemon, the env vars
+# override the module defaults (the DRAND_RETRY_* convention of
+# net/resilience.py).
+DEFAULT_CAPACITY = int(os.environ.get("DRAND_ADMISSION_CAPACITY", "64"))
+DEFAULT_CRITICAL_RESERVE = int(
+    os.environ.get("DRAND_ADMISSION_RESERVE", "8"))
+DEFAULT_MAX_STREAMS_PER_PEER = int(
+    os.environ.get("DRAND_ADMISSION_PEER_STREAMS", "2"))
+DEFAULT_SHED_WAIT = float(os.environ.get("DRAND_ADMISSION_SHED_WAIT", "0.25"))
+DEFAULT_RECOVER_WAIT = float(
+    os.environ.get("DRAND_ADMISSION_RECOVER_WAIT", "0.05"))
+DEFAULT_DWELL = float(os.environ.get("DRAND_ADMISSION_DWELL", "5"))
+DEFAULT_NORMAL_WAIT = float(
+    os.environ.get("DRAND_ADMISSION_NORMAL_WAIT", "2"))
+DEFAULT_PACE_RATE = float(os.environ.get("DRAND_ADMISSION_PACE_RATE", "4096"))
+DEFAULT_PACE_BURST = int(os.environ.get("DRAND_ADMISSION_PACE_BURST", "512"))
+DEFAULT_RETRY_AFTER = float(
+    os.environ.get("DRAND_ADMISSION_RETRY_AFTER", "1"))
+
+# why a request was shed (the Shed.reason field; tests + the ladder
+# assertion distinguish anti-monopoly sheds from pressure sheds)
+REASON_LEVEL = "level"          # the degradation ladder said no
+REASON_CAPACITY = "capacity"    # no token free (and the class won't wait)
+REASON_PEER_CAP = "peer-cap"    # per-peer fair-share stream cap
+
+
+class Shed(Exception):
+    """A well-formed rejection: carries the class, the reason, and how
+    long the caller should back off.  The transports translate this into
+    HTTP 429 + `Retry-After` or gRPC `RESOURCE_EXHAUSTED` + a
+    `retry-after` trailer."""
+
+    def __init__(self, cls: str, reason: str, retry_after: float):
+        self.cls = cls
+        self.reason = reason
+        self.retry_after = max(0.0, retry_after)
+        super().__init__(
+            f"{cls} request shed ({reason}); retry after "
+            f"{self.retry_after:g}s")
+
+
+class Ticket:
+    """One admitted request.  Release exactly once (context manager or
+    explicit `release()`); normal-class streams additionally call
+    `pace(n)` per streamed chunk for the fair-share token bucket."""
+
+    __slots__ = ("controller", "cls", "peer", "stream", "_released",
+                 "_sent", "_next_ok")
+
+    def __init__(self, controller: "AdmissionController", cls: str,
+                 peer: Optional[str], stream: bool):
+        self.controller = controller
+        self.cls = cls
+        self.peer = peer
+        self.stream = stream
+        self._released = False
+        self._sent = 0
+        self._next_ok = 0.0
+
+    def __enter__(self) -> "Ticket":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def release(self) -> None:
+        self.controller._release(self)
+
+    def pace(self, n: int = 1) -> float:
+        """Fair-share pacing for streams: past the burst allowance, each
+        item costs 1/rate seconds where rate is the shared pace budget
+        divided by the number of active normal streams.  Uncontended (one
+        active stream) pacing is off — a lone catch-up peer gets the full
+        pipe.  Returns the seconds this call waited (fake seconds under
+        an injected test clock)."""
+        return self.controller._pace(self, n)
+
+
+class AdmissionController:
+    """The shared serving-plane admission controller (see module doc).
+
+    All state lives under one condition variable; waits are cv-slices
+    bounded in REAL time (the verify-service pattern) so a frozen
+    FakeClock can never wedge a serving thread, while measured waits read
+    the injected clock so tests are deterministic."""
+
+    # real-seconds ceiling on any single admission/pace wait: the fake
+    # deadline may never arrive on a frozen test clock
+    WAIT_REAL_CAP = 2.0
+
+    def __init__(self, clock=None, capacity: int = 0,
+                 critical_reserve: int = 0,
+                 max_streams_per_peer: int = 0,
+                 shed_wait: float = 0.0, recover_wait: float = 0.0,
+                 dwell: float = 0.0, normal_wait: float = 0.0,
+                 pace_rate: float = 0.0, pace_burst: int = 0,
+                 retry_after: float = 0.0,
+                 background_hook: Optional[Callable[[bool], None]] = None):
+        if clock is None:
+            # deferred import: net must not hard-depend on beacon at
+            # module scope (same softening as net/resilience.py)
+            from ..beacon.clock import RealClock
+            clock = RealClock()
+        self.clock = clock
+        self.capacity = capacity or DEFAULT_CAPACITY
+        self.critical_reserve = min(
+            critical_reserve or DEFAULT_CRITICAL_RESERVE, self.capacity - 1)
+        self.max_streams_per_peer = (max_streams_per_peer
+                                     or DEFAULT_MAX_STREAMS_PER_PEER)
+        self.shed_wait = shed_wait or DEFAULT_SHED_WAIT
+        self.recover_wait = recover_wait or DEFAULT_RECOVER_WAIT
+        self.dwell = dwell or DEFAULT_DWELL
+        self.normal_wait = normal_wait or DEFAULT_NORMAL_WAIT
+        self.pace_rate = pace_rate or DEFAULT_PACE_RATE
+        self.pace_burst = pace_burst or DEFAULT_PACE_BURST
+        self.retry_after_s = retry_after or DEFAULT_RETRY_AFTER
+        self.background_hook = background_hook
+        self._cond = threading.Condition()
+        self._inflight: Dict[str, int] = {c: 0 for c in CLASSES}
+        self._peer_streams: Dict[str, int] = {}
+        self._normal_streams = 0
+        # (clock.monotonic() stamp, class, measured wait) rolling window
+        self._waits: deque = deque(maxlen=1024)
+        self._window = max(4 * self.dwell, 20.0)
+        self._level = LEVEL_NOMINAL
+        self._level_changed_at = self.clock.monotonic()
+        self._transitions: List[Tuple[float, int]] = []
+        self._admitted: Dict[str, int] = {c: 0 for c in CLASSES}
+        self._shed: Dict[Tuple[str, str], int] = {}
+        self._shed_log: List[Tuple[float, str, str]] = []
+        self._paced_waits = 0
+
+    # -- admission ------------------------------------------------------------
+
+    def admit(self, cls: str, peer: Optional[str] = None,
+              stream: bool = False) -> Ticket:
+        """Admit or raise `Shed`.  Critical never sheds (the reserve
+        guarantees it a token; even a reserve misconfigured to zero only
+        costs accounting, never the partial).  Normal waits up to
+        `normal_wait` for a token; sheddable never waits."""
+        if cls not in self._inflight:
+            raise ValueError(f"unknown admission class {cls!r}")
+        from ..metrics import (admission_inflight, admission_requests,
+                               admission_wait_seconds)
+        now0 = self.clock.monotonic()
+        hook = None
+        try:
+            with self._cond:
+                hook = self._reassess_locked(now0)
+                self._check_level_locked(cls, now0)
+                if cls == CLASS_NORMAL and stream and peer is not None \
+                        and self._peer_streams.get(peer, 0) \
+                        >= self.max_streams_per_peer:
+                    self._note_shed_locked(cls, REASON_PEER_CAP, now0)
+                    raise Shed(cls, REASON_PEER_CAP, self.retry_after_s)
+                waited = self._acquire_locked(cls, now0)
+                self._waits.append((self.clock.monotonic(), cls, waited))
+                self._inflight[cls] += 1
+                self._admitted[cls] += 1
+                if cls == CLASS_NORMAL and stream:
+                    self._normal_streams += 1
+                    if peer is not None:
+                        self._peer_streams[peer] = \
+                            self._peer_streams.get(peer, 0) + 1
+                hook = self._reassess_locked(self.clock.monotonic()) or hook
+        finally:
+            self._run_hook(hook)
+        admission_requests.labels(cls, "admitted").inc()
+        admission_wait_seconds.labels(cls).observe(max(0.0, waited))
+        admission_inflight.labels(cls).set(self._inflight[cls])
+        t = Ticket(self, cls, peer, stream)
+        t._next_ok = self.clock.monotonic()
+        return t
+
+    def try_admit(self, cls: str, peer: Optional[str] = None,
+                  stream: bool = False) -> Tuple[Optional[Ticket],
+                                                 Optional[Shed]]:
+        """Non-raising admit for transports that translate the rejection
+        themselves (the REST edge's pre-parse shed path)."""
+        try:
+            return self.admit(cls, peer=peer, stream=stream), None
+        except Shed as s:
+            return None, s
+
+    def _check_level_locked(self, cls: str, now: float) -> None:
+        if cls == CLASS_SHEDDABLE and self._level >= LEVEL_SHED_PUBLIC:
+            self._note_shed_locked(cls, REASON_LEVEL, now)
+            raise Shed(cls, REASON_LEVEL, self._retry_after_locked(now))
+        if cls == CLASS_NORMAL and self._level >= LEVEL_SHED_NORMAL:
+            self._note_shed_locked(cls, REASON_LEVEL, now)
+            raise Shed(cls, REASON_LEVEL, self._retry_after_locked(now))
+
+    def _acquire_locked(self, cls: str, now0: float) -> float:
+        """Take a token; returns the measured wait (injected-clock
+        seconds).  Caller holds the lock."""
+        from time import perf_counter
+        if cls == CLASS_CRITICAL:
+            return 0.0      # the reserve guarantees critical a slot
+        limit = self.capacity - self.critical_reserve
+        real0 = perf_counter()
+        while True:
+            noncrit = (self._inflight[CLASS_NORMAL]
+                       + self._inflight[CLASS_SHEDDABLE])
+            if noncrit < limit:
+                return self.clock.monotonic() - now0
+            now = self.clock.monotonic()
+            waited = now - now0
+            if cls == CLASS_SHEDDABLE:
+                # shed immediately and cheaply — public reads retry at
+                # the edge, they never queue inside the daemon
+                self._note_shed_locked(cls, REASON_CAPACITY, now)
+                raise Shed(cls, REASON_CAPACITY, self.retry_after_s)
+            if waited >= self.normal_wait \
+                    or perf_counter() - real0 >= self.WAIT_REAL_CAP:
+                # the timed-out wait IS the overload signal: record it so
+                # the p99 crosses the shed threshold and the ladder climbs.
+                # tpu-vet: disable=lock  (caller holds self._cond, docstring)
+                self._waits.append((now, cls, max(waited, self.normal_wait)))
+                self._note_shed_locked(cls, REASON_CAPACITY, now)
+                raise Shed(cls, REASON_CAPACITY, self.retry_after_s)
+            self._check_level_locked(cls, now)
+            # cv-slice bounded in real time; released tokens notify
+            self._cond.wait(0.05)
+
+    def _release(self, ticket: Ticket) -> None:
+        from ..metrics import admission_inflight
+        hook = None
+        with self._cond:
+            if ticket._released:
+                return
+            ticket._released = True
+            self._inflight[ticket.cls] = max(
+                0, self._inflight[ticket.cls] - 1)
+            if ticket.cls == CLASS_NORMAL and ticket.stream:
+                self._normal_streams = max(0, self._normal_streams - 1)
+                if ticket.peer is not None:
+                    left = self._peer_streams.get(ticket.peer, 1) - 1
+                    if left <= 0:
+                        self._peer_streams.pop(ticket.peer, None)
+                    else:
+                        self._peer_streams[ticket.peer] = left
+            hook = self._reassess_locked(self.clock.monotonic())
+            self._cond.notify_all()
+        self._run_hook(hook)
+        admission_inflight.labels(ticket.cls).set(self._inflight[ticket.cls])
+
+    def _note_shed_locked(self, cls: str, reason: str, now: float) -> None:
+        from ..metrics import admission_requests
+        self._shed[(cls, reason)] = self._shed.get((cls, reason), 0) + 1
+        self._shed_log.append((now, cls, reason))
+        if len(self._shed_log) > 4096:
+            del self._shed_log[:2048]
+        admission_requests.labels(cls, "shed").inc()
+
+    def _retry_after_locked(self, now: float) -> float:
+        """Level-based sheds back callers off until the ladder could next
+        step down (the remaining dwell), floored at the static knob."""
+        remaining = self.dwell - (now - self._level_changed_at)
+        return max(self.retry_after_s, min(remaining, self.dwell))
+
+    # -- the degradation ladder ----------------------------------------------
+
+    def _p99_locked(self, now: float, cls: Optional[str] = None) -> float:
+        """p99 of the wait samples inside the window, optionally filtered
+        to one class.  Caller holds the lock."""
+        cutoff = now - self._window
+        recent = sorted(w for t, c, w in self._waits
+                        if t >= cutoff and (cls is None or c == cls))
+        if not recent:
+            return 0.0
+        return recent[min(len(recent) - 1,
+                          int(round(0.99 * (len(recent) - 1))))]
+
+    def _reassess_locked(self, now: float) -> Optional[Callable]:
+        """One ladder step per dwell, driven by the queue-wait p99.
+        Returns the background hook invocation to run OUTSIDE the lock
+        (the verify service takes its own lock), or None."""
+        if now - self._level_changed_at < self.dwell:
+            return None
+        p99 = self._p99_locked(now)
+        new = self._level
+        if p99 > self.shed_wait and self._level < LEVEL_SHED_NORMAL:
+            new = self._level + 1
+        elif p99 < self.recover_wait and self._level > LEVEL_NOMINAL:
+            new = self._level - 1
+        if new == self._level:
+            return None
+        crossed_bg = (self._level < LEVEL_PAUSE_BACKGROUND <= new) \
+            or (new < LEVEL_PAUSE_BACKGROUND <= self._level)
+        self._level = new
+        self._level_changed_at = now
+        self._transitions.append((now, new))
+        from ..metrics import admission_level
+        admission_level.set(new)
+        if crossed_bg and self.background_hook is not None:
+            paused = new >= LEVEL_PAUSE_BACKGROUND
+            from ..metrics import admission_background_paused
+            admission_background_paused.set(1 if paused else 0)
+            hook = self.background_hook
+            return lambda: hook(paused)
+        return None
+
+    @staticmethod
+    def _run_hook(hook: Optional[Callable]) -> None:
+        if hook is not None:
+            hook()
+
+    # -- stream pacing --------------------------------------------------------
+
+    def _pace(self, ticket: Ticket, n: int) -> float:
+        from time import perf_counter
+        with self._cond:
+            streams = max(1, self._normal_streams)
+            if streams < 2:
+                # uncontended: a lone catch-up peer gets the full pipe,
+                # and the bucket forgives its history so contention later
+                # starts from the burst allowance, not from debt
+                ticket._sent = 0
+                ticket._next_ok = self.clock.monotonic()
+                return 0.0
+            rate = max(1.0, self.pace_rate / streams)
+            ticket._sent += n
+            if ticket._sent <= self.pace_burst:
+                ticket._next_ok = self.clock.monotonic()
+                return 0.0
+            ticket._next_ok = max(ticket._next_ok,
+                                  self.clock.monotonic()) + n / rate
+            until = ticket._next_ok
+            self._paced_waits += 1
+        t0 = self.clock.monotonic()
+        real0 = perf_counter()
+        with self._cond:
+            while self.clock.monotonic() < until \
+                    and perf_counter() - real0 < self.WAIT_REAL_CAP:
+                # real-bounded cv-slice: a frozen FakeClock must not wedge
+                # a serving stream (the REAL_FLUSH_CAP discipline)
+                self._cond.wait(0.02)
+        return max(0.0, self.clock.monotonic() - t0)
+
+    # -- observability --------------------------------------------------------
+
+    def level(self) -> int:
+        hook = None
+        try:
+            with self._cond:
+                hook = self._reassess_locked(self.clock.monotonic())
+                return self._level
+        finally:
+            self._run_hook(hook)
+
+    def background_paused(self) -> bool:
+        """True while the ladder says background work must yield —
+        scheduled integrity scans consult this and DEFER (the work waits;
+        it is never dropped)."""
+        return self.level() >= LEVEL_PAUSE_BACKGROUND
+
+    def wait_p99(self, cls: Optional[str] = None) -> float:
+        with self._cond:
+            return self._p99_locked(self.clock.monotonic(), cls)
+
+    def snapshot(self) -> dict:
+        lvl = self.level()      # reassess first
+        with self._cond:
+            return {
+                "level": lvl,
+                "level_name": LEVEL_NAMES[lvl],
+                "inflight": dict(self._inflight),
+                "admitted": dict(self._admitted),
+                "shed": {f"{c}/{r}": v
+                         for (c, r), v in sorted(self._shed.items())},
+                "peer_streams": dict(self._peer_streams),
+                "paced_waits": self._paced_waits,
+                "wait_p99": {c: round(self._p99_locked(
+                    self.clock.monotonic(), c), 4) for c in CLASSES},
+                "transitions": list(self._transitions),
+            }
+
+    def summary(self) -> str:
+        """One line for /health."""
+        s = self.snapshot()
+        i = s["inflight"]
+        shed = sum(v for v in self._shed.values())
+        return (f"level={s['level_name']} "
+                f"inflight={i[CLASS_CRITICAL]}/{i[CLASS_NORMAL]}/"
+                f"{i[CLASS_SHEDDABLE]} shed={shed} "
+                f"p99={s['wait_p99'][CLASS_NORMAL]:.3f}s")
+
+
+# -- gRPC wiring ---------------------------------------------------------------
+
+
+def peer_identity(peer: str) -> str:
+    """Fair-share identity for a gRPC peer string: strip the ephemeral
+    client port ('ipv4:10.0.0.1:52644' -> 'ipv4:10.0.0.1',
+    'ipv6:[::1]:52644' -> 'ipv6:[::1]') so the per-peer stream cap is
+    per REMOTE HOST — a hog must not evade `max_streams_per_peer` by
+    opening one channel per stream.  Strings without a port component
+    (test names, REST client addresses) pass through unchanged."""
+    if peer.count(":") >= 2:
+        host = peer.rsplit(":", 1)[0]
+        # ipv6 literals keep their bracketed form; a bare 'ipv6:[::1]'
+        # (no port) must not lose its tail
+        if not (peer.startswith("ipv6:") and not host.endswith("]")):
+            return host
+    return peer
+
+
+def classify_method(method: str) -> Optional[str]:
+    """Wire-path -> admission class.  SyncChain is the one normal-class
+    stream; the rest of the node-to-node Protocol plane (partials, DKG,
+    identity, status) is critical; the Public API is sheddable.  Control
+    (localhost CLI) and anything unknown are exempt (None)."""
+    if method == "/drand.Protocol/SyncChain":
+        return CLASS_NORMAL
+    if method.startswith("/drand.Protocol/"):
+        return CLASS_CRITICAL
+    if method.startswith("/drand.Public/"):
+        return CLASS_SHEDDABLE
+    return None
+
+
+def _shed_abort(context, shed: Shed):
+    import grpc
+    context.set_trailing_metadata((
+        ("retry-after", f"{shed.retry_after:g}"),))
+    context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(shed))
+
+
+class AdmissionInterceptor:
+    """grpc.ServerInterceptor applying the controller to every RPC of a
+    listener.  Unary handlers admit/release around the behavior; stream
+    handlers hold their ticket for the stream's life and pace each
+    response item (the SyncChain fair-share path).  Rejections abort with
+    RESOURCE_EXHAUSTED and a `retry-after` trailer before any service
+    logic runs."""
+
+    def __init__(self, controller: AdmissionController,
+                 classify: Callable[[str], Optional[str]] = classify_method):
+        self.controller = controller
+        self.classify = classify
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None:
+            return None
+        cls = self.classify(handler_call_details.method)
+        if cls is None:
+            return handler
+        return self._wrap(handler, cls)
+
+    def _wrap(self, handler, cls: str):
+        import grpc
+        ctrl = self.controller
+
+        if handler.unary_unary is not None:
+            inner = handler.unary_unary
+
+            def unary(request, context):
+                try:
+                    ticket = ctrl.admit(cls, peer=peer_identity(
+                        context.peer()))
+                except Shed as s:
+                    _shed_abort(context, s)
+                with ticket:
+                    return inner(request, context)
+
+            return grpc.unary_unary_rpc_method_handler(
+                unary, request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer)
+
+        if handler.unary_stream is not None:
+            inner_s = handler.unary_stream
+
+            def stream(request, context):
+                try:
+                    ticket = ctrl.admit(cls, peer=peer_identity(
+                        context.peer()), stream=True)
+                except Shed as s:
+                    _shed_abort(context, s)
+
+                def gen():
+                    with ticket:
+                        for item in inner_s(request, context):
+                            yield item
+                            ticket.pace()
+
+                return gen()
+
+            return grpc.unary_stream_rpc_method_handler(
+                stream, request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer)
+
+        return handler      # client-streaming RPCs: none in our specs

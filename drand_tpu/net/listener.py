@@ -19,13 +19,38 @@ from .client import CertManager, Peer, ProtocolClient
 
 class Listener:
     """One gRPC server bound to an address, serving given (spec, impl)
-    pairs.  TLS when cert/key paths are provided (net/listener.go:132-166)."""
+    pairs.  TLS when cert/key paths are provided (net/listener.go:132-166).
+
+    `admission` (net/admission.py AdmissionController) installs the
+    serving-plane interceptor: every RPC is classified critical / normal /
+    sheddable and admitted (or shed with RESOURCE_EXHAUSTED + a
+    retry-after trailer) BEFORE its service method runs.  The worker pool
+    stays deliberately bounded — admission control decides who gets a
+    worker; the pool size only caps parallelism."""
 
     def __init__(self, address: str, handlers, tls_cert: Optional[str] = None,
-                 tls_key: Optional[str] = None, max_workers: int = 16):
+                 tls_key: Optional[str] = None, max_workers: int = 16,
+                 admission=None):
         self.address = address
+        interceptors = ()
+        max_rpcs = None
+        if admission is not None:
+            from .admission import AdmissionInterceptor
+            interceptors = (AdmissionInterceptor(admission),)
+            # the TOKENS must be the binding constraint, not the executor:
+            # with fewer workers than tokens, a read flood would fill the
+            # worker pool and queue critical partials in the executor's
+            # unbounded queue BEFORE their interceptor (which would admit
+            # them via the reserve) ever runs.  Workers are lazy-spawned,
+            # so the headroom costs nothing while idle; maximum_concurrent_
+            # rpcs backstops the executor queue itself (gRPC answers the
+            # overflow with RESOURCE_EXHAUSTED before accepting the RPC).
+            max_workers = max(max_workers, admission.capacity + 8)
+            max_rpcs = 2 * admission.capacity
         self.server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=max_workers))
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            interceptors=interceptors,
+            maximum_concurrent_rpcs=max_rpcs)
         self.server.add_generic_rpc_handlers(
             tuple(spec.handler(impl) for spec, impl in handlers))
         if tls_cert and tls_key:
@@ -57,11 +82,11 @@ class PrivateGateway:
     def __init__(self, address: str, protocol_impl, public_impl,
                  certs: Optional[CertManager] = None,
                  tls_cert: Optional[str] = None, tls_key: Optional[str] = None,
-                 resilience=None):
+                 resilience=None, admission=None):
         self.listener = Listener(
             address,
             [(services.PROTOCOL, protocol_impl), (services.PUBLIC, public_impl)],
-            tls_cert=tls_cert, tls_key=tls_key)
+            tls_cert=tls_cert, tls_key=tls_key, admission=admission)
         self.client = ProtocolClient(certs=certs, resilience=resilience)
         host = address.rsplit(":", 1)[0]
         self.listen_addr = f"{host}:{self.listener.port}"

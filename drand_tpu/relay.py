@@ -528,7 +528,9 @@ class HttpRelay:
 
     def __init__(self, client: Client, listen: str = "127.0.0.1:0",
                  log: Optional[Logger] = None):
-        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from http.server import BaseHTTPRequestHandler
+
+        from .http_server import BoundedHTTPServer
 
         self.client = client
         self.info = client.info()
@@ -550,8 +552,10 @@ class HttpRelay:
                 self.wfile.write(body)
 
         host, _, port = listen.rpartition(":")
-        self.httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
-                                         Handler)
+        # bounded worker pool, not thread-per-request: an edge relay is
+        # the FIRST thing a read flood hits (net/admission.py doctrine)
+        self.httpd = BoundedHTTPServer((host or "127.0.0.1", int(port)),
+                                       Handler, workers=8)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 

@@ -34,6 +34,13 @@ Building blocks:
   * `DeviceFailoverSyncScenario` — kill the device backend mid-catch-up
                      sync on a 3-node network; convergence must come via
                      the host path before the round deadline.
+  * `OverloadScenario` — seeded serving-plane overload (public read
+                     flood + one sync-hog peer during live rounds)
+                     against the admission controller: partials
+                     admission p99 stays bounded, every shed is
+                     well-formed, the verify background lane pauses
+                     before any normal-class shed, the hog's drain rate
+                     is fair-share bounded, and the ladder recovers.
 """
 
 import hashlib
@@ -926,3 +933,223 @@ class DeviceFailoverSyncScenario:
         finally:
             self.device.release.set()
             self.svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# serving-plane overload (the admission-control target, net/admission.py):
+# a public read flood plus one sync-hog peer during live rounds.  Pure
+# controller-level simulation — the wire shapes (HTTP 429, gRPC
+# RESOURCE_EXHAUSTED) are covered by tests/test_admission.py against real
+# servers; this scenario proves the POLICY: reservation, fair share,
+# ladder ordering, hysteretic recovery.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class OverloadResult:
+    served_reads: int
+    shed_reads: int
+    shed_ratio: float
+    partials_admitted: int
+    partials_p99: float               # critical-class admission wait p99
+    period: float
+    sheds_well_formed: bool           # every Shed named a reason + retry
+    peer_cap_sheds: int               # the hog's over-cap streams refused
+    hog_rounds: int
+    hog_bound: float                  # fair-share ceiling on hog_rounds
+    paced: bool                       # pacing actually engaged
+    max_level: int
+    bg_pause_at: Optional[float]      # fake time the background lane paused
+    first_normal_shed_at: Optional[float]   # first LEVEL-based normal shed
+    ladder_ordered: bool              # bg paused strictly before that shed
+    bg_resumed: bool
+    final_level: int
+
+    @property
+    def ok(self) -> bool:
+        return (self.served_reads > 0
+                and self.shed_reads > 0
+                and self.sheds_well_formed
+                and self.partials_p99 < self.period
+                and self.peer_cap_sheds > 0
+                and self.paced
+                and self.hog_rounds <= self.hog_bound
+                and self.max_level >= 3
+                and self.ladder_ordered
+                and self.bg_resumed
+                and self.final_level == 0)
+
+
+class OverloadScenario:
+    """Read flood + sync-hog peer against one AdmissionController.
+
+    Timeline (fake seconds): a seeded flood of sheddable reads saturates
+    the non-critical token pool while two victim peers try to open
+    normal-class sync streams (their timed-out waits are the queue-wait
+    signal that climbs the ladder) and a hog peer drains a sync stream
+    as fast as pacing allows.  Critical partials arrive every second
+    throughout and must never wait.  After the flood the ladder must
+    step back down to nominal."""
+
+    def __init__(self, seed: int, period: float = 30.0,
+                 flood_seconds: int = 40, recover_seconds: int = 45,
+                 flood_rate: int = 30):
+        from drand_tpu.net.admission import AdmissionController
+
+        self.seed = seed
+        self.period = period
+        self.flood_seconds = flood_seconds
+        self.recover_seconds = recover_seconds
+        self.flood_rate = flood_rate
+        self.clock = AutoClock(start=1_000.0)
+        self.bg_events: List[tuple] = []      # (fake time, paused)
+        self.ctrl = AdmissionController(
+            clock=self.clock, capacity=16, critical_reserve=4,
+            max_streams_per_peer=2, shed_wait=0.5, recover_wait=0.05,
+            dwell=4.0, normal_wait=2.0, pace_rate=64.0, pace_burst=16,
+            background_hook=lambda paused: self.bg_events.append(
+                (self.clock.monotonic(), paused)))
+
+    def run(self) -> OverloadResult:
+        from drand_tpu.net.admission import (CLASS_CRITICAL, CLASS_NORMAL,
+                                             CLASS_SHEDDABLE, REASON_LEVEL,
+                                             REASON_PEER_CAP, Shed)
+
+        ctrl, clock = self.ctrl, self.clock
+        rng = random.Random(stable_seed(self.seed, "overload"))
+        stop = threading.Event()
+        state = {"served": 0, "shed": 0, "malformed": 0, "peer_cap": 0,
+                 "partials": 0, "hog_rounds": 0, "paced": False}
+        state_lock = threading.Lock()
+        holds: List[tuple] = []               # (release_at, ticket) heap-ish
+
+        def well_formed(s: Shed) -> bool:
+            return (s.retry_after > 0 and s.cls in str(s)
+                    and s.reason in (REASON_LEVEL, "capacity",
+                                     REASON_PEER_CAP))
+
+        def note_shed(s: Shed, peer_cap: bool = False) -> None:
+            with state_lock:
+                state["shed"] += 1
+                if peer_cap:
+                    state["peer_cap"] += 1
+                if not well_formed(s):
+                    state["malformed"] += 1
+
+        # -- the hog: 2 granted streams + 1 refused, then drain flat out
+        def hog():
+            tickets = []
+            for _ in range(2):
+                try:
+                    tickets.append(ctrl.admit(CLASS_NORMAL, peer="hog",
+                                              stream=True))
+                except Shed as s:
+                    note_shed(s)
+            try:
+                ctrl.admit(CLASS_NORMAL, peer="hog", stream=True)
+            except Shed as s:           # over the per-peer fair-share cap
+                note_shed(s, peer_cap=isinstance(s, Shed)
+                          and s.reason == REASON_PEER_CAP)
+            t = tickets[0] if tickets else None
+            while t is not None and not stop.is_set():
+                waited = t.pace(8)
+                with state_lock:
+                    state["hog_rounds"] += 8
+                    if waited > 0:
+                        state["paced"] = True
+            for t in tickets:
+                t.release()
+
+        # -- victims: keep trying to open sync streams; their timed-out
+        #    waits feed the ladder's p99 signal
+        def victim(name):
+            while not stop.is_set():
+                try:
+                    t = ctrl.admit(CLASS_NORMAL, peer=name, stream=True)
+                    t.release()
+                except Shed as s:
+                    note_shed(s)
+                threading.Event().wait(0.01)
+
+        threads = [threading.Thread(target=hog, daemon=True, name="ov-hog")]
+        threads += [threading.Thread(target=victim, args=(f"victim{i}",),
+                                     daemon=True, name=f"ov-victim{i}")
+                    for i in range(2)]
+        # a third normal stream so pacing sees >1 distinct peers even
+        # while the victims are being shed
+        base_stream = ctrl.admit(CLASS_NORMAL, peer="steady", stream=True)
+        for th in threads:
+            th.start()
+
+        def step(flood: bool) -> None:
+            now = clock.monotonic()
+            holds[:] = [(at, t) for at, t in holds
+                        if at > now or (t.release() and False)]
+            arrivals = rng.randrange(self.flood_rate // 2,
+                                     self.flood_rate * 2) if flood else 1
+            for i in range(arrivals):
+                ticket, s = ctrl.try_admit(CLASS_SHEDDABLE,
+                                           peer=f"edge{i % 8}")
+                if ticket is not None:
+                    with state_lock:
+                        state["served"] += 1
+                    holds.append((now + rng.uniform(2.0, 5.0), ticket))
+                else:
+                    note_shed(s)
+            # one partial per second: the thing overload must never cost
+            pt = ctrl.admit(CLASS_CRITICAL, peer="signer")
+            with state_lock:
+                state["partials"] += 1
+            pt.release()
+            clock.jump(1.0)
+            # give the waiter threads a real-time slice to observe it
+            threading.Event().wait(0.012)
+
+        for _ in range(self.flood_seconds):
+            step(flood=True)
+        flood_end = clock.monotonic()
+        stop.set()
+        for th in threads:
+            th.join(timeout=10)
+        for _, t in holds:
+            t.release()
+        holds.clear()
+        base_stream.release()
+        for _ in range(self.recover_seconds):
+            step(flood=False)
+
+        snap = ctrl.snapshot()
+        partials_p99 = ctrl.wait_p99(CLASS_CRITICAL)
+        max_level = max((lvl for _, lvl in snap["transitions"]), default=0)
+        bg_pause_at = next((t for t, paused in self.bg_events if paused),
+                           None)
+        first_normal_level_shed = next(
+            (t for t, cls, reason in ctrl._shed_log
+             if cls == CLASS_NORMAL and reason == REASON_LEVEL), None)
+        ladder_ordered = (first_normal_level_shed is None
+                          or (bg_pause_at is not None
+                              and bg_pause_at < first_normal_level_shed))
+        # fair-share ceiling: two burst allowances plus the SHARED pace
+        # budget for the whole flood window (generous: the hog only ever
+        # gets a fraction of pace_rate while others stream)
+        elapsed = flood_end - 1_000.0
+        hog_bound = (2 * self.ctrl.pace_burst
+                     + self.ctrl.pace_rate * elapsed + 8)
+        with state_lock:
+            served, shed = state["served"], state["shed"]
+            return OverloadResult(
+                served_reads=served, shed_reads=shed,
+                shed_ratio=shed / max(1, served + shed),
+                partials_admitted=state["partials"],
+                partials_p99=partials_p99, period=self.period,
+                sheds_well_formed=state["malformed"] == 0 and shed > 0,
+                peer_cap_sheds=state["peer_cap"],
+                hog_rounds=state["hog_rounds"], hog_bound=hog_bound,
+                paced=state["paced"],
+                max_level=max_level, bg_pause_at=bg_pause_at,
+                first_normal_shed_at=first_normal_level_shed,
+                ladder_ordered=ladder_ordered
+                and first_normal_level_shed is not None,
+                bg_resumed=bool(self.bg_events)
+                and self.bg_events[-1][1] is False,
+                final_level=snap["level"])

@@ -19,12 +19,38 @@ from drand_tpu.key import DistPublic, Share, new_group, new_keypair
 SERVICE_THREAD_PREFIXES = ("verify-scheduler", "verify-packer",
                            "verify-watchdog", "verify-probe")
 
+# the REST edge's threads (http_server.py): ONE acceptor + a FIXED worker
+# pool — request traffic must never grow this set (the unbounded
+# ThreadingHTTPServer thread-per-request bug this replaces)
+REST_THREAD_PREFIXES = ("rest-edge", "rest-worker", "http-relay")
+
 
 def service_threads():
     """Alive verify-service threads, for before/after leak accounting."""
     return [t for t in threading.enumerate()
             if t.is_alive()
             and any(t.name.startswith(p) for p in SERVICE_THREAD_PREFIXES)]
+
+
+def rest_threads():
+    """Alive REST-edge threads (acceptor + bounded worker pool)."""
+    return [t for t in threading.enumerate()
+            if t.is_alive()
+            and any(t.name.startswith(p) for p in REST_THREAD_PREFIXES)]
+
+
+def assert_no_leaked_rest_threads(before=(), timeout: float = 5.0):
+    """Fail if any REST-edge thread outlives its server's stop().  Same
+    snapshot-before contract as `assert_no_leaked_service_threads`."""
+    exempt = set(id(t) for t in before)
+    deadline = time.monotonic() + timeout
+    leaked = [t for t in rest_threads() if id(t) not in exempt]
+    while leaked and time.monotonic() < deadline:
+        time.sleep(0.05)
+        leaked = [t for t in rest_threads() if id(t) not in exempt]
+    assert not leaked, (
+        "leaked REST-edge threads after server stop: "
+        + ", ".join(t.name for t in leaked))
 
 
 def assert_no_leaked_service_threads(before=(), timeout: float = 5.0):

@@ -1,0 +1,47 @@
+"""Seeded bounds-checker violations (scope: rel path starts with net/).
+
+Each BAD line must be caught; each OK line must stay silent."""
+
+import queue
+from concurrent.futures import ThreadPoolExecutor
+from http.server import HTTPServer, ThreadingHTTPServer
+from queue import Queue
+
+
+def unbounded_queues():
+    a = queue.Queue()                      # BAD: no maxsize
+    b = Queue()                            # BAD: from-import alias
+    c = queue.Queue(maxsize=0)             # BAD: 0 spells unbounded
+    d = queue.LifoQueue()                  # BAD: sibling class
+    e = queue.SimpleQueue()                # BAD: cannot be bounded
+    return a, b, c, d, e
+
+
+def bounded_queues():
+    a = queue.Queue(maxsize=64)            # OK: kw bound
+    b = queue.Queue(8)                     # OK: positional bound
+    n = 16
+    c = Queue(maxsize=n)                   # OK: computed bound exists
+    return a, b, c
+
+
+def executors():
+    bad = ThreadPoolExecutor()             # BAD: machine-sized pool
+    good = ThreadPoolExecutor(max_workers=4)   # OK
+    also = ThreadPoolExecutor(4)           # OK: positional
+    return bad, good, also
+
+
+def servers():
+    bad = ThreadingHTTPServer(("", 0), None)   # BAD: thread per request
+    good = HTTPServer(("", 0), None)           # OK: no thread growth
+    return bad, good
+
+
+class BadServer(ThreadingHTTPServer):      # BAD: subclass inherits the bug
+    pass
+
+
+def justified():
+    # tpu-vet: disable=bounds  (drained by a fixed reaper; depth metered)
+    return queue.Queue()

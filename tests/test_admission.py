@@ -1,0 +1,499 @@
+"""Serving-plane admission control (net/admission.py + its wiring):
+priority-class reservation, per-peer fair share, hysteresis, wire shapes
+(HTTP 429 / gRPC RESOURCE_EXHAUSTED), the degradation ladder, and the
+bounded REST edge."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from drand_tpu.beacon.clock import FakeClock, RealClock
+from drand_tpu.net.admission import (CLASS_CRITICAL, CLASS_NORMAL,
+                                     CLASS_SHEDDABLE, LEVEL_NOMINAL,
+                                     LEVEL_PAUSE_BACKGROUND,
+                                     LEVEL_SHED_NORMAL, LEVEL_SHED_PUBLIC,
+                                     REASON_PEER_CAP, AdmissionController,
+                                     Shed, classify_method)
+
+from harness import assert_no_leaked_rest_threads, rest_threads
+
+
+def _controller(**kw):
+    kw.setdefault("clock", FakeClock(1_000.0))
+    kw.setdefault("capacity", 6)
+    kw.setdefault("critical_reserve", 2)
+    kw.setdefault("shed_wait", 0.5)
+    kw.setdefault("recover_wait", 0.05)
+    kw.setdefault("dwell", 2.0)
+    kw.setdefault("normal_wait", 1.0)
+    return AdmissionController(**kw)
+
+
+# -- priority classes ---------------------------------------------------------
+
+
+def test_critical_reserved_while_sheddable_sheds():
+    """The reserve: with every non-critical token taken, sheddable sheds
+    immediately and critical keeps being admitted — partials must never
+    wait behind public reads."""
+    ctrl = _controller()                    # 6 total, 4 non-critical
+    held = [ctrl.admit(CLASS_SHEDDABLE) for _ in range(4)]
+    with pytest.raises(Shed) as e:
+        ctrl.admit(CLASS_SHEDDABLE)
+    assert e.value.cls == CLASS_SHEDDABLE
+    assert e.value.retry_after > 0
+    crit = [ctrl.admit(CLASS_CRITICAL) for _ in range(8)]
+    assert ctrl.wait_p99(CLASS_CRITICAL) == 0.0
+    for t in crit + held:
+        t.release()
+
+
+def test_normal_times_out_and_the_wait_is_recorded():
+    """A normal request that cannot get a token within `normal_wait`
+    sheds, and its timed-out wait lands in the p99 window — the overload
+    signal the ladder climbs on."""
+    clock = FakeClock(1_000.0)
+    ctrl = _controller(clock=clock)
+    held = [ctrl.admit(CLASS_SHEDDABLE) for _ in range(4)]
+    out = {}
+
+    def attempt():
+        try:
+            out["t"] = ctrl.admit(CLASS_NORMAL, peer="peer1")
+        except Shed as s:
+            out["s"] = s
+
+    th = threading.Thread(target=attempt, daemon=True)
+    th.start()
+    deadline = time.monotonic() + 5
+    while th.is_alive() and time.monotonic() < deadline:
+        clock.advance(0.25)
+        time.sleep(0.02)
+    th.join(2)
+    assert "s" in out, "normal admit should have timed out"
+    assert ctrl.wait_p99(CLASS_NORMAL) >= ctrl.normal_wait
+    for t in held:
+        t.release()
+
+
+def test_per_peer_fair_share_stream_cap():
+    ctrl = _controller(max_streams_per_peer=2)
+    a1 = ctrl.admit(CLASS_NORMAL, peer="hog", stream=True)
+    a2 = ctrl.admit(CLASS_NORMAL, peer="hog", stream=True)
+    with pytest.raises(Shed) as e:
+        ctrl.admit(CLASS_NORMAL, peer="hog", stream=True)
+    assert e.value.reason == REASON_PEER_CAP
+    # a DIFFERENT peer is not punished for the hog's appetite
+    b1 = ctrl.admit(CLASS_NORMAL, peer="polite", stream=True)
+    for t in (a1, a2, b1):
+        t.release()
+    # the cap is per-CONCURRENT-streams: after release the peer is fine
+    again = ctrl.admit(CLASS_NORMAL, peer="hog", stream=True)
+    again.release()
+
+
+def test_pacing_bucket_math():
+    """Past the burst allowance, each streamed item costs 1/rate seconds
+    of bucket time at the fair-share rate; uncontended streams are never
+    paced (and their history is forgiven)."""
+    clock = FakeClock(1_000.0)
+    ctrl = _controller(clock=clock, pace_rate=100.0, pace_burst=10)
+    ctrl.WAIT_REAL_CAP = 0.05       # the fake deadline never arrives here
+    solo = ctrl.admit(CLASS_NORMAL, peer="a", stream=True)
+    assert solo.pace(1_000) == 0.0              # uncontended: full pipe
+    other = ctrl.admit(CLASS_NORMAL, peer="b", stream=True)
+    t0 = clock.monotonic()
+    for _ in range(2):
+        solo.pace(10)                           # 20 items, burst is 10
+    # 2 streams -> 50 items/s fair share; 10 past-burst items owe 0.2s
+    assert solo._next_ok - t0 >= 10 / 50 - 1e-9
+    solo.release()
+    other.release()
+
+
+# -- hysteresis ---------------------------------------------------------------
+
+
+def _drive_timeout(ctrl, clock, peer="p"):
+    """One normal-class admission timeout with the clock stepped from the
+    main thread (deterministic fake-time wait)."""
+    out = {}
+
+    def attempt():
+        try:
+            out["t"] = ctrl.admit(CLASS_NORMAL, peer=peer)
+        except Shed as s:
+            out["s"] = s
+
+    th = threading.Thread(target=attempt, daemon=True)
+    th.start()
+    deadline = time.monotonic() + 5
+    while th.is_alive() and time.monotonic() < deadline:
+        clock.advance(0.25)
+        time.sleep(0.015)
+    th.join(2)
+    if "t" in out:
+        out["t"].release()
+    return out
+
+
+def test_ladder_hysteresis_no_flapping_on_fakeclock():
+    """The ladder climbs one rung per dwell under pressure, never
+    oscillates while the p99 sits between the recover and shed
+    thresholds, and steps back down one rung per dwell once the window
+    drains — strictly up, then strictly down, no flapping."""
+    clock = FakeClock(1_000.0)
+    ctrl = _controller(clock=clock, dwell=2.0)
+    held = [ctrl.admit(CLASS_SHEDDABLE) for _ in range(4)]
+
+    # sustained pressure: timed-out normal waits while the pool is full
+    levels = [ctrl.level()]
+    for _ in range(8):
+        _drive_timeout(ctrl, clock)
+        clock.advance(ctrl.dwell)
+        levels.append(ctrl.level())
+    assert max(levels) == LEVEL_SHED_NORMAL
+    ups = [lv for lv in levels if lv != 0]
+    assert ups == sorted(ups), f"ladder flapped on the way up: {levels}"
+
+    # pressure stops: tokens free, the wait window drains, and the
+    # ladder walks down one rung per dwell without ever bouncing back
+    for t in held:
+        t.release()
+    clock.advance(ctrl._window + 1)
+    down = []
+    for _ in range(8):
+        clock.advance(ctrl.dwell)
+        down.append(ctrl.level())
+    assert down[-1] == LEVEL_NOMINAL
+    assert down == sorted(down, reverse=True), f"flapped down: {down}"
+    # transition log shows single-step moves only
+    steps = [lvl for _, lvl in ctrl.snapshot()["transitions"]]
+    assert all(abs(b - a) == 1 for a, b in zip(steps, steps[1:]))
+
+
+def test_ladder_orders_background_pause_before_normal_shed():
+    """Level 2 (pause background) is strictly below level 3 (shed
+    normal): the hook fires before any normal-class level shed, and
+    resumes on the way down."""
+    clock = FakeClock(1_000.0)
+    events = []
+    ctrl = _controller(clock=clock, dwell=2.0,
+                       background_hook=lambda p: events.append(
+                           (clock.monotonic(), p)))
+    held = [ctrl.admit(CLASS_SHEDDABLE) for _ in range(4)]
+    first_normal_level_shed = None
+    for _ in range(6):
+        _drive_timeout(ctrl, clock)
+        clock.advance(ctrl.dwell)
+        lvl = ctrl.level()
+        if lvl >= LEVEL_SHED_NORMAL and first_normal_level_shed is None:
+            with pytest.raises(Shed):
+                ctrl.admit(CLASS_NORMAL, peer="x")
+            first_normal_level_shed = clock.monotonic()
+    assert first_normal_level_shed is not None
+    assert events and events[0][1] is True
+    assert events[0][0] < first_normal_level_shed
+    assert ctrl.background_paused()
+    for t in held:
+        t.release()
+    clock.advance(ctrl._window + 1)
+    for _ in range(6):
+        clock.advance(ctrl.dwell)
+        ctrl.level()
+    assert events[-1][1] is False and not ctrl.background_paused()
+
+
+def test_background_pause_reaches_verify_service():
+    """Config glue: the ladder's hook pauses the verify service's
+    BACKGROUND lane — queued work waits (never fails) and flushes on
+    resume while LIVE work keeps flowing."""
+    import numpy as np
+
+    from drand_tpu.core.config import Config
+    from drand_tpu.crypto.schemes import scheme_from_name
+
+    class _Echo:            # instant fake backend
+        kind = "host"
+
+        def verify_batch(self, rounds, sigs, prevs=None):
+            return np.ones(len(rounds), dtype=bool)
+
+    cfg = Config(clock=RealClock(), verify_window=0.0)
+    svc = cfg.verify_service()
+    try:
+        scheme = scheme_from_name("pedersen-bls-chained")
+        # distinct chains: a queued background request of the SAME chain
+        # would legitimately ride the live dispatch for free
+        h_bg = svc.handle(scheme, b"\x01" * 96, backend=_Echo())
+        h_live = svc.handle(scheme, b"\x02" * 96, backend=_Echo())
+        cfg._pause_background(True)
+        assert svc.background_paused()
+        bg = h_bg.submit([1], [b"x"], lane="background", flush_now=True)
+        live = h_live.submit([2], [b"y"], lane="live", flush_now=True)
+        assert live.result(5).all()         # live unaffected
+        time.sleep(0.2)
+        assert not bg.done()                # background parked, not failed
+        cfg._pause_background(False)
+        assert bg.result(5).all()           # resumes flush-ready
+    finally:
+        cfg.stop_verify_service()
+
+
+# -- wire shapes --------------------------------------------------------------
+
+
+def test_rest_429_shape_and_recovery():
+    """The REST edge sheds BEFORE parsing with a complete 429: status,
+    Retry-After, JSON body, connection close — and serves again the
+    moment a token frees."""
+    from types import SimpleNamespace
+
+    from drand_tpu.http_server import RestServer
+    from drand_tpu.log import Logger
+
+    ctrl = _controller(capacity=3, critical_reserve=2)  # 1 sheddable token
+    daemon = SimpleNamespace(processes={}, chain_hashes={},
+                             log=Logger("t"))
+    server = RestServer(daemon, "127.0.0.1:0", admission=ctrl,
+                        clock=RealClock(), workers=2)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        with urllib.request.urlopen(base + "/chains", timeout=5) as r:
+            assert r.status == 200
+        # the serving worker releases its token asynchronously after the
+        # response body: retry-grab the one sheddable token briefly
+        deadline = time.monotonic() + 3
+        while True:
+            try:
+                held = ctrl.admit(CLASS_SHEDDABLE)
+                break
+            except Shed:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "/chains", timeout=5)
+        assert e.value.code == 429
+        assert float(e.value.headers["Retry-After"]) > 0
+        assert e.value.headers["Connection"] == "close"
+        assert json.loads(e.value.read())["error"] == "overloaded"
+        held.release()
+        with urllib.request.urlopen(base + "/chains", timeout=5) as r:
+            assert r.status == 200
+    finally:
+        server.stop()
+
+
+@pytest.fixture()
+def admitted_loopback():
+    import grpc  # noqa: F401
+
+    from drand_tpu.net import Listener, Peer, ProtocolClient, services
+    from drand_tpu.protos import drand_pb2 as pb
+
+    release = threading.Event()
+
+    class _Protocol:
+        def partial_beacon(self, req, ctx):
+            return pb.Empty()
+
+        def sync_chain(self, req, ctx):
+            yield pb.BeaconPacket(round=req.from_round,
+                                  signature=b"\x01" * 4)
+            release.wait(10)    # hold the stream open for the cap test
+
+        def __getattr__(self, name):
+            def f(req, ctx):
+                return pb.Empty()
+            return f
+
+    class _Public:
+        def public_rand(self, req, ctx):
+            return pb.PublicRandResponse(round=req.round or 7,
+                                         signature=b"sig")
+
+        def __getattr__(self, name):
+            def f(req, ctx):
+                return pb.Empty()
+            return f
+
+    ctrl = _controller(capacity=8, critical_reserve=2,
+                       max_streams_per_peer=2)
+    lis = Listener("127.0.0.1:0",
+                   [(services.PROTOCOL, _Protocol()),
+                    (services.PUBLIC, _Public())], admission=ctrl)
+    lis.start()
+    client = ProtocolClient()
+    yield client, Peer(f"127.0.0.1:{lis.port}"), ctrl, release, pb
+    release.set()
+    client.close()
+    lis.stop()
+
+
+def test_grpc_resource_exhausted_shape(admitted_loopback):
+    import grpc
+
+    client, peer, ctrl, release, pb = admitted_loopback
+    assert client.public_rand(peer).round == 7
+    held = [ctrl.admit(CLASS_SHEDDABLE) for _ in range(6)]  # pool full
+    with pytest.raises(grpc.RpcError) as e:
+        client.public_rand(peer)
+    assert e.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+    md = dict(e.value.trailing_metadata() or ())
+    assert float(md["retry-after"]) > 0
+    assert "sheddable" in e.value.details()
+    # critical (partials) rides the reserve straight through
+    client.partial_beacon(peer, pb.PartialBeaconPacket(
+        round=1, partial_sig=b"x"))
+    for t in held:
+        t.release()
+
+
+def test_sync_chain_per_peer_cap_over_grpc(admitted_loopback):
+    import grpc
+
+    client, peer, ctrl, release, pb = admitted_loopback
+    s1 = client.sync_chain(peer, 1)
+    s2 = client.sync_chain(peer, 1)
+    assert next(iter(s1)).round == 1        # both streams admitted
+    assert next(iter(s2)).round == 1
+    s3 = client.sync_chain(peer, 1)
+    with pytest.raises(grpc.RpcError) as e:
+        next(iter(s3))
+    assert e.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+    release.set()                           # drain + release the streams
+    list(s1), list(s2)
+
+
+def test_peer_identity_strips_ephemeral_port():
+    """The fair-share key is the remote HOST: a hog must not evade the
+    stream cap by opening one channel (one ephemeral port) per stream."""
+    from drand_tpu.net.admission import peer_identity
+
+    assert peer_identity("ipv4:10.0.0.1:52644") == "ipv4:10.0.0.1"
+    assert peer_identity("ipv4:10.0.0.1:9") == \
+        peer_identity("ipv4:10.0.0.1:52645")
+    assert peer_identity("ipv6:[::1]:52644") == "ipv6:[::1]"
+    assert peer_identity("ipv6:[::1]") == "ipv6:[::1]"
+    assert peer_identity("hog") == "hog"            # scenario names
+    assert peer_identity("127.0.0.1") == "127.0.0.1"  # REST client addr
+
+
+def test_grpc_worker_pool_sized_past_the_token_pool(admitted_loopback):
+    """Tokens must be the binding constraint: a Listener built with an
+    admission controller sizes its executor past `capacity` so the
+    interceptor always runs before any queueing."""
+    from concurrent import futures as _f
+
+    from drand_tpu.net import Listener, services
+    from drand_tpu.protos import drand_pb2 as pb  # noqa: F401
+
+    _, _, ctrl, _, _ = admitted_loopback
+    captured = {}
+    orig = _f.ThreadPoolExecutor
+
+    class Spy(orig):
+        def __init__(self, max_workers=None, **kw):
+            captured["max_workers"] = max_workers
+            super().__init__(max_workers=max_workers, **kw)
+
+    _f.ThreadPoolExecutor = Spy
+    try:
+        lis = Listener("127.0.0.1:0", [], admission=ctrl)
+    finally:
+        _f.ThreadPoolExecutor = orig
+    try:
+        assert captured["max_workers"] >= ctrl.capacity + 8
+    finally:
+        lis.stop()
+
+
+def test_classify_method_map():
+    assert classify_method("/drand.Protocol/PartialBeacon") == CLASS_CRITICAL
+    assert classify_method("/drand.Protocol/BroadcastDKG") == CLASS_CRITICAL
+    assert classify_method("/drand.Protocol/SyncChain") == CLASS_NORMAL
+    assert classify_method("/drand.Public/PublicRand") == CLASS_SHEDDABLE
+    assert classify_method("/drand.Public/ChainInfo") == CLASS_SHEDDABLE
+    assert classify_method("/drand.Control/Shutdown") is None
+
+
+# -- the bounded REST edge ----------------------------------------------------
+
+
+def test_rest_worker_pool_is_bounded_and_reaped():
+    """Satellite: request traffic must never grow the thread set (the
+    old ThreadingHTTPServer spawned one non-daemon thread per request),
+    and stop() reaps acceptor + workers (harness leak check)."""
+    from types import SimpleNamespace
+
+    from drand_tpu.http_server import RestServer
+    from drand_tpu.log import Logger
+
+    before = rest_threads()
+    daemon = SimpleNamespace(processes={}, chain_hashes={},
+                             log=Logger("t"))
+    server = RestServer(daemon, "127.0.0.1:0", clock=RealClock(),
+                        workers=4)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+
+    def hit():
+        try:
+            with urllib.request.urlopen(base + "/chains", timeout=5) as r:
+                r.read()
+        except Exception:
+            pass
+
+    for _ in range(30):                     # sequential
+        hit()
+    burst = [threading.Thread(target=hit, daemon=True) for _ in range(12)]
+    for t in burst:
+        t.start()
+    mid = [t for t in rest_threads() if t not in before]
+    for t in burst:
+        t.join(5)
+    # acceptor + exactly `workers` pool threads, regardless of traffic
+    assert len(mid) <= 1 + 4, [t.name for t in mid]
+    assert all(t.daemon for t in mid)
+    server.stop()
+    assert_no_leaked_rest_threads(before=before)
+
+
+# -- the full overload scenario ----------------------------------------------
+
+
+def test_overload_scenario_acceptance():
+    """The ISSUE acceptance: seeded read flood + sync-hog peer during
+    live rounds — partials p99 under one round period, well-formed
+    sheds, background paused before any normal shed, fair-share-bounded
+    hog, hysteretic recovery."""
+    from chaos import OverloadScenario
+
+    r = OverloadScenario(seed=42).run()
+    assert r.partials_p99 < r.period
+    assert r.sheds_well_formed and r.shed_reads > 0
+    assert r.peer_cap_sheds > 0
+    assert r.paced and r.hog_rounds <= r.hog_bound
+    assert r.max_level == LEVEL_SHED_NORMAL
+    assert r.ladder_ordered, (r.bg_pause_at, r.first_normal_shed_at)
+    assert r.bg_resumed and r.final_level == LEVEL_NOMINAL
+    assert r.ok
+
+
+def test_overload_scenario_deterministic_verdict():
+    """Two runs, same seed: the structural verdict is stable (thread
+    interleaving may wiggle counts, never the pass/fail shape)."""
+    from chaos import OverloadScenario
+
+    a = OverloadScenario(seed=9, flood_seconds=20,
+                         recover_seconds=30).run()
+    b = OverloadScenario(seed=9, flood_seconds=20,
+                         recover_seconds=30).run()
+    assert a.ok and b.ok
+    assert a.max_level == b.max_level
+    assert a.ladder_ordered and b.ladder_ordered

@@ -342,8 +342,8 @@ def test_startup_integrity_pass_glue(chain):
 
         def integrity_scan(self, verifier=None, mode="full", upto=None,
                            progress=None, beacon_id="default", chunk=512,
-                           trigger="startup"):
-            return scanner.scan(mode=mode, upto=upto or N)
+                           trigger="startup", resume=None):
+            return scanner.scan(mode=mode, upto=upto or N, resume=resume)
 
     import threading as _threading
     bp = SimpleNamespace(
@@ -354,7 +354,10 @@ def test_startup_integrity_pass_glue(chain):
         # clock-derived expected head (the head-truncation follow-up):
         # the real method needs group timing; the stub pins it to N
         _expected_head_round=lambda: N,
-        _on_sync_needed=lambda target: None)
+        _on_sync_needed=lambda target: None,
+        # resumability plumbing (the stub keeps no watermark)
+        _load_scan_checkpoint=lambda: None,
+        _save_scan_checkpoint=lambda ck: None)
     BeaconProcess._integrity_pass(bp)
     deadline = time.monotonic() + 20
     while time.monotonic() < deadline:
@@ -404,8 +407,8 @@ def test_startup_scan_catches_head_truncation(chain):
 
         def integrity_scan(self, verifier=None, mode="full", upto=None,
                            progress=None, beacon_id="default", chunk=512,
-                           trigger="startup"):
-            return scanner.scan(mode=mode, upto=upto)
+                           trigger="startup", resume=None):
+            return scanner.scan(mode=mode, upto=upto, resume=resume)
 
     import threading as _threading
     bp_pass = SimpleNamespace(
@@ -416,7 +419,9 @@ def test_startup_scan_catches_head_truncation(chain):
         log=Logger(), beacon_id="truncation-test",
         _peers=lambda: [], clock=bp.clock, group=bp.group,
         _expected_head_round=lambda: expected,
-        _on_sync_needed=sync_requests.append)
+        _on_sync_needed=sync_requests.append,
+        _load_scan_checkpoint=lambda: None,
+        _save_scan_checkpoint=lambda ck: None)
     BeaconProcess._integrity_pass(bp_pass)
     assert sync_requests == [expected]   # truncated tail -> catch-up sync
 
@@ -467,3 +472,128 @@ def test_heal_with_scan_report_quarantines_and_repairs(chain):
     assert integrity_repaired.labels("test-heal")._value.get() \
         == r_before + len(report.faulty_rounds)
     assert scanner.scan(mode="full", upto=N).clean
+
+
+# ---------------------------------------------------------------------------
+# scan resumability (ScanCheckpoint): scheduled scans resume at the clean
+# prefix instead of rescanning from genesis
+# ---------------------------------------------------------------------------
+
+
+def test_scan_emits_and_honors_checkpoint(chain):
+    """A clean scan yields a watermark; resuming from it scans only the
+    delta, keeps the chained linkage anchor intact, and reports where it
+    resumed."""
+    store = _seeded_store(chain, upto=16)
+    scanner = _scanner(chain, store)
+    first = scanner.scan(mode="full", upto=16)
+    assert first.clean and first.resumed_from == 0
+    ck = first.checkpoint
+    assert ck is not None and ck.round == 16 and ck.mode == "full"
+
+    # idle chain (head == checkpoint): the resume must still be honored —
+    # a zero delta is the cheapest scan of all, not a full-rescan trigger
+    idle = scanner.scan(mode="full", upto=16, resume=ck)
+    assert idle.clean and idle.resumed_from == 16 and idle.scanned == 0
+    assert idle.checkpoint.round == 16
+
+    for r in range(17, N + 1):          # the chain grows
+        store.put(chain.beacons[r])
+    second = scanner.scan(mode="full", upto=N, resume=ck)
+    assert second.clean
+    assert second.resumed_from == 16
+    assert second.scanned == N - 16     # O(delta), not O(chain)
+    assert second.checkpoint.round == N
+
+
+def test_checkpoint_rejected_when_row_tampered(chain):
+    """The watermark re-anchors against the stored row: a store rewritten
+    beneath the checkpoint fails the signature-hash match and the scan
+    falls back to a full walk (which then finds the tampering)."""
+    store = _seeded_store(chain)
+    scanner = _scanner(chain, store)
+    ck = scanner.scan(mode="full", upto=16).checkpoint
+    b = store.get(16)
+    store.delete(16)
+    store.put(Beacon(round=16, signature=b"\x00" * len(b.signature),
+                     previous_sig=b.previous_sig))
+    report = scanner.scan(mode="full", upto=N, resume=ck)
+    assert report.resumed_from == 0     # full rescan, nothing vouched for
+    assert report.scanned == N
+    assert 16 in report.faulty_rounds
+
+
+def test_checkpoint_freezes_at_first_finding(chain):
+    """Corruption freezes the watermark at the last clean flush: the
+    next resume re-examines the corrupt region instead of skipping it."""
+    store = _seeded_store(chain)
+    sig = store.get(18).signature
+    store.delete(18)
+    store.put(Beacon(round=18, signature=sig[: len(sig) // 2],
+                     previous_sig=store.get(17).signature))
+    scanner = _scanner(chain, store)    # chunk=8: flushes at 8, 16, 24
+    report = scanner.scan(mode="full", upto=N)
+    assert 18 in report.faulty_rounds
+    assert report.checkpoint is not None
+    assert report.checkpoint.round == 16   # last CLEAN flush boundary
+    again = scanner.scan(mode="full", upto=N, resume=report.checkpoint)
+    assert again.resumed_from == 16
+    assert 18 in again.faulty_rounds    # the corruption is re-found
+
+
+def test_linkage_checkpoint_not_honored_by_full_scan(chain):
+    """A linkage-only watermark never proved any signature: a full-crypto
+    scan must not skip its prefix (full checkpoints cover both modes)."""
+    store = _seeded_store(chain)
+    scanner = _scanner(chain, store)
+    ck_link = scanner.scan(mode="linkage", upto=16).checkpoint
+    assert ck_link.mode == "linkage"
+    full = scanner.scan(mode="full", upto=N, resume=ck_link)
+    assert full.resumed_from == 0 and full.scanned == N
+    link = scanner.scan(mode="linkage", upto=N, resume=ck_link)
+    assert link.resumed_from == 16      # linkage may resume from linkage
+
+
+def test_scheduled_scan_resumes_and_reports_metric(chain):
+    """BeaconProcess glue: trigger=scheduled loads the persisted
+    watermark, passes it to the scan, records the new one, and sets the
+    chain_integrity_scan_resumed_from gauge."""
+    from types import SimpleNamespace
+
+    from drand_tpu.core.beacon_process import BeaconProcess
+    from drand_tpu.log import Logger
+    from drand_tpu.metrics import integrity_scan_resumed_from
+
+    store = _seeded_store(chain)
+    scanner = _scanner(chain, store)
+    prior = scanner.scan(mode="full", upto=16).checkpoint
+    saved = {}
+    scans = {}
+
+    class FakeChain:
+        def last(self):
+            return store.last()
+
+        def integrity_scan(self, verifier=None, mode="full", upto=None,
+                           progress=None, beacon_id="default", chunk=512,
+                           trigger="startup", resume=None):
+            scans["resume"] = resume
+            return scanner.scan(mode=mode, upto=upto or N, resume=resume)
+
+    import threading as _threading
+    bp = SimpleNamespace(
+        cfg=SimpleNamespace(startup_integrity="full"),
+        syncm=SimpleNamespace(verifier=None),
+        handler=SimpleNamespace(chain=FakeChain()),
+        _lock=_threading.Lock(), _repair_thread=None,
+        log=Logger(), beacon_id="resume-test",
+        _peers=lambda: [],
+        _expected_head_round=lambda: 0,
+        _on_sync_needed=lambda target: None,
+        _load_scan_checkpoint=lambda: prior,
+        _save_scan_checkpoint=lambda ck: saved.update(ck=ck))
+    BeaconProcess._integrity_pass(bp, trigger="scheduled")
+    assert scans["resume"] is prior
+    assert saved["ck"].round == N       # watermark advanced
+    gauge = integrity_scan_resumed_from.labels("resume-test")
+    assert gauge._value.get() == 16

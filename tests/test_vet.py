@@ -200,6 +200,36 @@ def test_wait_checker_catches_fixture():
                 if f.path == "wait_bad.py"]) == 1
 
 
+def test_bounds_checker_catches_fixture():
+    report = _fixture_report("bounds")
+    codes = _codes(report, "net/bounds_bad.py")
+    assert ("net/bounds_bad.py", "bounds-unbounded-queue") in codes
+    assert ("net/bounds_bad.py", "bounds-unbounded-executor") in codes
+    assert ("net/bounds_bad.py", "bounds-thread-per-request") in codes
+    lines = {f.line for f in report.findings
+             if f.path == "net/bounds_bad.py"}
+    # bare Queue, from-import alias, maxsize=0, LifoQueue, SimpleQueue,
+    # bare executor, ThreadingHTTPServer call + subclass — all caught
+    assert len(lines) == 8, sorted(lines)
+    msgs = [f.message for f in report.findings]
+    # bounded constructs and the plain HTTPServer stay silent
+    assert not any("max_workers=4" in m for m in msgs)
+    assert not any(f.line in (21, 22, 24, 31, 32, 38)
+                   for f in report.findings
+                   if f.path == "net/bounds_bad.py")
+    assert len([f for f in report.suppressed
+                if f.path == "net/bounds_bad.py"]) == 1
+
+
+def test_bounds_checker_scoped_to_serving_paths(tmp_path):
+    """An unbounded queue OUTSIDE net//http_server.py/relay.py is not
+    this checker's business (internal planes are bounded upstream)."""
+    src = tmp_path / "beacon_thing.py"
+    src.write_text("import queue\nQ = queue.Queue()\n")
+    report = run_vet([str(src)], checkers=by_names(["bounds"]))
+    assert report.findings == []
+
+
 def test_wait_checker_exempts_test_code(tmp_path):
     """The discipline targets production code: tests wait on work they
     control, bounded by pytest's own timeout machinery."""
@@ -359,7 +389,7 @@ def test_cli_write_baseline_then_clean(tmp_path, capsys):
 
 def test_checker_registry_names_are_suppression_tokens():
     assert checker_names() == ["clock", "lock", "secret", "trace", "store",
-                               "verifier", "wait"]
-    assert len(ALL_CHECKERS) == 7
+                               "verifier", "wait", "bounds"]
+    assert len(ALL_CHECKERS) == 8
     with pytest.raises(KeyError):
         by_names(["not-a-checker"])

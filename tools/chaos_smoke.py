@@ -96,6 +96,44 @@ def device_main(args) -> int:
     return 0 if result.ok and sync.ok else 1
 
 
+def overload_main(args) -> int:
+    """--overload mode: the serving-plane overload scenario — a seeded
+    public read flood plus one sync-hog peer during live rounds.  The
+    partials admission p99 must stay under a round period, every shed
+    must be well-formed, the verify background lane must pause before
+    any normal-class shed, and the ladder must recover to nominal."""
+    from chaos import OverloadScenario
+
+    result = OverloadScenario(seed=args.seed).run()
+    print(f"seed            : {args.seed}")
+    print(f"reads served    : {result.served_reads}")
+    print(f"reads shed      : {result.shed_reads} "
+          f"(ratio {result.shed_ratio:.2f})")
+    print(f"sheds well-formed: {result.sheds_well_formed}")
+    print(f"partials        : {result.partials_admitted} admitted, "
+          f"p99 wait {result.partials_p99:.3f}s "
+          f"(period {result.period:.0f}s)")
+    print(f"peer-cap sheds  : {result.peer_cap_sheds}")
+    print(f"hog rounds      : {result.hog_rounds} "
+          f"(fair-share bound {result.hog_bound:.0f}, "
+          f"paced={result.paced})")
+    print(f"max level       : {result.max_level}")
+    print(f"bg paused at    : {result.bg_pause_at} "
+          f"(first normal shed {result.first_normal_shed_at})")
+    print(f"ladder ordered  : {result.ladder_ordered}")
+    print(f"recovered       : level {result.final_level}, "
+          f"bg resumed {result.bg_resumed}")
+
+    from drand_tpu.metrics import scrape
+    lines = [l for l in scrape("private").decode().splitlines()
+             if l.startswith(("admission_requests", "admission_level",
+                              "admission_background_paused"))]
+    print("admission series:")
+    for line in lines:
+        print(f"  {line}")
+    return 0 if result.ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=42)
@@ -111,12 +149,19 @@ def main() -> int:
                          "(watchdog + host failover + canary "
                          "re-promotion) instead of the network chaos "
                          "scenario")
+    ap.add_argument("--overload", action="store_true",
+                    help="run the serving-plane overload scenario "
+                         "(read flood + sync-hog peer; admission "
+                         "control + degradation ladder) instead of the "
+                         "network chaos scenario")
     args = ap.parse_args()
 
     if args.storage:
         return storage_main(args)
     if args.device:
         return device_main(args)
+    if args.overload:
+        return overload_main(args)
 
     from chaos import ChaosScenario
 

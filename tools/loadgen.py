@@ -1,0 +1,336 @@
+#!/usr/bin/env python
+"""Serving-plane load generator (ROADMAP item 5a: "add a load-generation
+harness and measure rounds served/sec").
+
+Drives the REST edge and/or the public gRPC plane with either a CLOSED
+loop (N clients back-to-back — measures capacity) or an OPEN loop
+(Poisson-ish arrivals at --rate req/s — measures behavior under a fixed
+offered load, the regime where shedding matters), and reports:
+
+    rounds_served_per_s   successful reads per second of wall time
+    shed_ratio            429/RESOURCE_EXHAUSTED responses / attempts
+    shed_well_formed      every 429 carried Retry-After (and every gRPC
+                          shed was RESOURCE_EXHAUSTED, not a mystery)
+    latency_p50/p99       client-observed seconds
+    admission             the daemon's /health admission block (level +
+                          per-class queue-wait p99, incl. the partials/
+                          critical p99 the acceptance criterion names)
+
+Usage:
+    python tools/loadgen.py --rest http://127.0.0.1:8080 --mode closed \
+        --clients 16 --duration 10
+    python tools/loadgen.py --grpc 127.0.0.1:4444 --mode open --rate 500
+    python tools/loadgen.py --selftest [--json]
+
+--selftest needs no running daemon: it spins an in-process REST edge over
+a real-crypto chain with a deliberately tiny admission pool, floods it,
+and exits 0 iff reads were served, sheds happened, and every shed was
+well-formed — the CI hook bench.py records (loadgen_* keys)."""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+
+@dataclass
+class LoadReport:
+    target: str
+    mode: str
+    duration: float
+    attempted: int = 0
+    ok: int = 0
+    shed: int = 0
+    errors: int = 0
+    malformed_sheds: int = 0
+    latencies: List[float] = field(default_factory=list)
+    admission: Optional[dict] = None
+
+    @property
+    def rounds_served_per_s(self) -> float:
+        return self.ok / self.duration if self.duration > 0 else 0.0
+
+    @property
+    def shed_ratio(self) -> float:
+        return self.shed / self.attempted if self.attempted else 0.0
+
+    @property
+    def shed_well_formed(self) -> bool:
+        return self.malformed_sheds == 0
+
+    def _pct(self, q: float) -> float:
+        if not self.latencies:
+            return 0.0
+        s = sorted(self.latencies)
+        return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
+
+    def to_dict(self) -> dict:
+        return {
+            "target": self.target, "mode": self.mode,
+            "duration_s": round(self.duration, 2),
+            "attempted": self.attempted, "ok": self.ok,
+            "shed": self.shed, "errors": self.errors,
+            "rounds_served_per_s": round(self.rounds_served_per_s, 1),
+            "shed_ratio": round(self.shed_ratio, 4),
+            "shed_well_formed": self.shed_well_formed,
+            "latency_p50_s": round(self._pct(0.50), 4),
+            "latency_p99_s": round(self._pct(0.99), 4),
+            "admission": self.admission,
+        }
+
+    def render(self) -> str:
+        d = self.to_dict()
+        lines = [f"{k:22}: {v}" for k, v in d.items() if k != "admission"]
+        if d["admission"]:
+            lines.append(f"{'admission':22}: {json.dumps(d['admission'])}")
+        return "\n".join(lines)
+
+
+# -- REST driver ---------------------------------------------------------------
+
+
+def _rest_once(base: str, path: str, report: LoadReport,
+               lock: threading.Lock) -> None:
+    t0 = time.perf_counter()
+    status, retry_after = 0, None
+    try:
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            status = r.status
+            r.read()
+    except urllib.error.HTTPError as e:
+        status = e.code
+        retry_after = e.headers.get("Retry-After")
+        e.read()
+    except Exception:
+        status = -1
+    dt = time.perf_counter() - t0
+    with lock:
+        report.attempted += 1
+        if status in (200, 304):
+            report.ok += 1
+            report.latencies.append(dt)
+        elif status == 429:
+            report.shed += 1
+            if retry_after is None:
+                report.malformed_sheds += 1
+        else:
+            report.errors += 1
+
+
+def _grpc_once(client, peer, report: LoadReport,
+               lock: threading.Lock) -> None:
+    import grpc
+    t0 = time.perf_counter()
+    ok = shed = err = malformed = 0
+    try:
+        client.public_rand(peer, round_=0)
+        ok = 1
+    except grpc.RpcError as e:
+        if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+            shed = 1
+            md = dict(e.trailing_metadata() or ())
+            if "retry-after" not in md:
+                malformed = 1
+        else:
+            err = 1
+    except Exception:
+        err = 1
+    dt = time.perf_counter() - t0
+    with lock:
+        report.attempted += 1
+        report.ok += ok
+        report.shed += shed
+        report.errors += err
+        report.malformed_sheds += malformed
+        if ok:
+            report.latencies.append(dt)
+
+
+def run_load(fire, target: str, mode: str, clients: int, rate: float,
+             duration: float) -> LoadReport:
+    """`fire(report, lock)` performs ONE request and records it."""
+    report = LoadReport(target=target, mode=mode, duration=duration)
+    lock = threading.Lock()
+    stop = threading.Event()
+    threads: List[threading.Thread] = []
+    t0 = time.perf_counter()
+
+    if mode == "closed":
+        def worker():
+            while not stop.is_set():
+                fire(report, lock)
+        threads = [threading.Thread(target=worker, daemon=True,
+                                    name=f"loadgen-{i}")
+                   for i in range(clients)]
+        for t in threads:
+            t.start()
+        stop.wait(duration)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+    else:                               # open loop: fixed offered rate
+        gap = 1.0 / max(1.0, rate)
+        next_at = time.perf_counter()
+        while time.perf_counter() - t0 < duration:
+            now = time.perf_counter()
+            if now < next_at:
+                stop.wait(min(gap, next_at - now))
+                continue
+            next_at += gap
+            th = threading.Thread(target=fire, args=(report, lock),
+                                  daemon=True)
+            th.start()
+            threads.append(th)
+            if len(threads) > 4096:     # reap finished arrivals
+                threads = [t for t in threads if t.is_alive()]
+        deadline = time.perf_counter() + 10
+        for t in threads:
+            t.join(timeout=max(0.1, deadline - time.perf_counter()))
+    report.duration = time.perf_counter() - t0
+    return report
+
+
+def _fetch_admission(base: str) -> Optional[dict]:
+    try:
+        with urllib.request.urlopen(base + "/health", timeout=5) as r:
+            return json.loads(r.read()).get("admission")
+    except urllib.error.HTTPError as e:
+        try:        # /health 503s while the chain lags; body still parses
+            return json.loads(e.read()).get("admission")
+        except Exception:
+            return None
+    except Exception:
+        return None
+
+
+# -- selftest: in-process REST edge over a real-crypto chain ------------------
+
+
+def _shim_daemon(chain, head: int):
+    """The daemon slice RestServer consumes, over a TrueChain."""
+    from types import SimpleNamespace
+
+    from drand_tpu.chain.errors import ErrNoBeaconStored
+    from drand_tpu.chain.info import Info
+    from drand_tpu.log import Logger
+
+    info = Info(public_key=chain.public, period=30,
+                genesis_time=1_000, genesis_seed=chain.genesis_seed,
+                scheme=chain.scheme.id, beacon_id="default")
+
+    def get_beacon(round_):
+        r = head if round_ == 0 else round_
+        b = chain.beacons.get(r)
+        if b is None:
+            raise ErrNoBeaconStored(f"round {r}")
+        return b
+
+    cb = SimpleNamespace(add_callback=lambda *a, **k: None,
+                         remove_callback=lambda *a, **k: None)
+    bp = SimpleNamespace(
+        handler=SimpleNamespace(chain=SimpleNamespace(cbstore=cb)),
+        beacon_id="default", chain_info=lambda: info,
+        get_beacon=get_beacon)
+    return SimpleNamespace(processes={"default": bp},
+                           chain_hashes={info.hash_string(): "default"},
+                           log=Logger("loadgen"))
+
+
+def selftest(duration: float, clients: int, emit_json: bool) -> int:
+    from chaos import TrueChain
+
+    from drand_tpu.http_server import RestServer
+    from drand_tpu.net.admission import AdmissionController
+
+    chain = TrueChain(n=64)
+    daemon = _shim_daemon(chain, head=64)
+    # a deliberately tiny pool so the closed-loop flood sheds: capacity 6
+    # minus 2 reserved = 4 sheddable tokens against `clients` workers
+    ctrl = AdmissionController(capacity=6, critical_reserve=2,
+                               shed_wait=0.05, recover_wait=0.01,
+                               dwell=3600.0)
+    server = RestServer(daemon, "127.0.0.1:0", admission=ctrl, workers=4)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        report = run_load(
+            lambda rep, lock: _rest_once(base, "/public/latest", rep, lock),
+            target=base, mode="closed", clients=clients, rate=0.0,
+            duration=duration)
+        report.admission = {
+            "level": ctrl.level(),
+            "wait_p99": ctrl.snapshot()["wait_p99"],
+        }
+    finally:
+        server.stop()
+    print(json.dumps(report.to_dict()) if emit_json else report.render(),
+          flush=True)
+    ok = (report.ok > 0 and report.shed > 0 and report.shed_well_formed
+          and report.errors == 0)
+    return 0 if ok else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rest", help="REST base URL (http://host:port)")
+    ap.add_argument("--grpc", help="gRPC address (host:port)")
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--clients", type=int, default=8,
+                    help="closed-loop worker count")
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="open-loop offered req/s")
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--selftest", action="store_true",
+                    help="in-process flood against a tiny admission pool "
+                         "(no daemon needed); exit 0 iff served+shed+"
+                         "well-formed")
+    args = ap.parse_args()
+
+    if args.selftest:
+        return selftest(args.duration, max(args.clients, 16), args.json)
+    if not args.rest and not args.grpc:
+        ap.error("need --rest and/or --grpc (or --selftest)")
+
+    rc = 0
+    if args.rest:
+        base = args.rest.rstrip("/")
+        report = run_load(
+            lambda rep, lock: _rest_once(base, "/public/latest", rep, lock),
+            target=base, mode=args.mode, clients=args.clients,
+            rate=args.rate, duration=args.duration)
+        report.admission = _fetch_admission(base)
+        print(json.dumps(report.to_dict()) if args.json
+              else report.render(), flush=True)
+        rc |= 0 if report.shed_well_formed and report.ok else 1
+    if args.grpc:
+        from drand_tpu.net import Peer, ProtocolClient
+        client = ProtocolClient()
+        peer = Peer(args.grpc)
+        try:
+            report = run_load(
+                lambda rep, lock: _grpc_once(client, peer, rep, lock),
+                target=args.grpc, mode=args.mode, clients=args.clients,
+                rate=args.rate, duration=args.duration)
+        finally:
+            client.close()
+        print(json.dumps(report.to_dict()) if args.json
+              else report.render(), flush=True)
+        rc |= 0 if report.shed_well_formed and report.ok else 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
