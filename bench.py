@@ -38,11 +38,14 @@ Configs (BASELINE.json north_star):
                        buffering via the service's pipelined executor
 
 Compiled-program economy: every verifier pads to PAD=8192 (pad_to), so
-the whole bench needs exactly four on-chip programs — G1-RLC@8192,
-G2-RLC@8192, partials-verify@(2048x7), recover@(256,7,2048) — plus the
-fixture signing pipelines.  All configs run inside ONE child process so
-each program compiles (or cache-loads) at most once; the parent restarts
-the child for the remaining configs if it hangs or dies.
+the whole bench needs exactly five on-chip programs — G1-RLC@8192 in
+its donating (streamed dispatch_packed, configs 5/6) and non-donating
+(resident re-verify, config 2) flavors, G2-RLC@8192,
+partials-verify@(2048x7), and the fused decompress+recover GLV program
+— plus the fixture signing pipelines.  All configs run inside ONE
+child process so each program compiles (or cache-loads) at most once;
+the parent restarts the child for the remaining configs if it hangs
+or dies.
 
 Fixture chains are generated once and cached under /tmp/drand_tpu_bench
 (generation is setup, not measurement).  DRAND_TPU_BENCH_CONFIGS=1,5
@@ -330,6 +333,9 @@ def bench_streamed_store(stats):
         schemes.SHORT_SIG_SCHEME_ID, N_STREAM, b"drand-tpu-bench-stream",
         "g1stream")
     ver = _verifier(sch, pub)
+    # effective dispatch-pipeline depth (DRAND_VERIFY_PIPELINE_DEPTH,
+    # clamped by the per-chunk footprint budget)
+    stats["streamed_depth"] = ver.pipeline_depth(None, CHUNK)
 
     def replay():
         def it():
@@ -404,6 +410,14 @@ def bench_coalesced_service(stats):
         stats["coalesced_submissions"] = submissions
         stats["coalesced_dispatches"] = st["dispatches"] - \
             before["dispatches"]
+        # occupancy observability (ISSUE 10): effective in-flight depth
+        # and the queue-time vs device-time split over the warm replay
+        stats["coalesced_inflight_depth"] = st["inflight_depth_max"]
+        stats["coalesced_queue_s"] = round(
+            st["queue_time_s"] - before["queue_time_s"], 2)
+        stats["coalesced_device_s"] = round(
+            st["device_time_s"] - before["device_time_s"], 2)
+        stats["coalesced_tuning"] = st["tuning"]
         # delta'd over the WARM replay only (cumulative stats would blend
         # the cold run's interleaving in)
         slots = st["dispatch_slots"] - before["dispatch_slots"]
@@ -507,6 +521,12 @@ def _emit(configs, stats):
             for k in ("loadgen_rounds_served_per_s", "loadgen_shed_ratio",
                       "loadgen_shed_well_formed", "loadgen_error")
             if k in stats} or None,
+        # the pad x depth occupancy sweep (tools/autotune.py; ISSUE 10)
+        "tuning": {
+            k.replace("tuning_", ""): stats[k]
+            for k in ("tuning_platform", "tuning_winner", "tuning_sweep",
+                      "tuning_file_entries", "tuning_error")
+            if k in stats} or None,
         "backends": backends,
         "configs": configs,
         "n": {"streamed_store": N_STREAM, "unchained_resident": N_RESIDENT,
@@ -518,6 +538,47 @@ def _emit(configs, stats):
     }
     print(json.dumps(out), flush=True)
     return headline
+
+
+def _sweep_numbers(stats):
+    """Record the pad x depth occupancy sweep (ISSUE 10): the autotune
+    selftest — a tiny CPU-safe sweep that also proves the service
+    consults its TUNING.json — runs on every bench round so the BENCH
+    artifact carries the depth/width numbers next to the verify numbers.
+    DRAND_TPU_BENCH_SWEEP=0 skips it (it costs a couple of tiny-pad
+    compiles); failure is recorded, never fatal."""
+    import subprocess
+    if os.environ.get("DRAND_TPU_BENCH_SWEEP", "1") == "0":
+        return
+    at = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "autotune.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)          # cache-key hygiene (r3 postmortem)
+    plat = os.environ.get("DRAND_TPU_BENCH_PLATFORM")
+    if plat:
+        env["JAX_PLATFORMS"] = plat
+    try:
+        proc = subprocess.run(
+            [sys.executable, at, "--selftest"],
+            capture_output=True, text=True, timeout=900, env=env)
+        line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+        rep = json.loads(line)
+        stats["tuning_platform"] = rep.get("platform")
+        stats["tuning_winner"] = rep.get("winner")
+        stats["tuning_sweep"] = rep.get("sweep")
+        if proc.returncode != 0 or not rep.get("ok"):
+            stats["tuning_error"] = (
+                f"selftest exit {proc.returncode}: consulted="
+                f"{rep.get('consulted')}")
+    except Exception as e:
+        stats["tuning_error"] = f"{type(e).__name__}: {e}"[:200]
+    # the round's committed TUNING.json (if any): what the service would
+    # actually consult on this host — recorded so a chip round's sweep
+    # results are part of its BENCH artifact
+    from drand_tpu.crypto import tuning
+    path = tuning.tuning_path()
+    if path:
+        stats["tuning_file_entries"] = tuning.load_entries(path)
 
 
 def _loadgen_numbers(stats):
@@ -558,6 +619,7 @@ def main():
     configs = {_RUNNERS[i]: None for i in order}
     stats = {}
     _loadgen_numbers(stats)
+    _sweep_numbers(stats)
     # per-config ceiling (a hung compile RPC blocks in native code and can
     # only be killed from outside) and a whole-bench budget
     cfg_budget = int(os.environ.get("DRAND_TPU_BENCH_CONFIG_TIMEOUT", "2400"))

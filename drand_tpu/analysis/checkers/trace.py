@@ -12,6 +12,16 @@ PR 1) — which is exactly the class this checker pins down.
 Scope: files under ops/ and crypto/batch.py (SCOPES) — the rest of the
 codebase is host code where Python control flow is the point.
 
+A second, host-level pass (`sync-in-loop`, ISSUE 10) covers crypto/
+orchestration code: synchronous device readback (np.asarray / bool() /
+int() / float() / .item() / .block_until_ready() / jax.device_get) on a
+device-produced value INSIDE a per-chunk for/while loop serializes the
+whole stream — every iteration pays a full interconnect round trip (the
+r5 finding: ~1 RPC latency of pure stall per chunk).  Hot-path loops
+must stay async (pack/dispatch/resolve with a depth-k window) and sync
+once per stream.  Device taint: values from `dispatch_packed`/
+`_rlc_dispatch` calls or from invoking a compiled `*_pipeline` object.
+
 Taint: parameters of a jitted function are traced; values derived from
 them are traced; `.shape/.ndim/.dtype/.size`, `len()`, and parameters
 named in `static_argnums`/`static_argnames` are static and break the
@@ -26,6 +36,8 @@ from ..core import Finding
 from ..symbols import ModuleInfo, dotted
 
 SCOPES = ("ops/", "crypto/batch.py")
+# the sync-in-loop pass covers the crypto/ hot-path orchestration code
+SYNC_SCOPES = ("crypto/",)
 
 STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
 STATIC_CALLS = {"len", "isinstance", "type", "range"}  # range(static) common
@@ -33,10 +45,23 @@ CONCRETIZERS = {"int", "float", "bool", "complex"}
 CONCRETIZE_METHODS = {"item", "tolist"}
 JIT_NAMES = {"jit", "jax.jit", "pjit", "jax.pjit"}
 
+# sync-in-loop: producers whose results are device values (async until
+# read), sync sinks that force the readback, and the unambiguous
+# blocking calls that are findings on their own
+SYNC_PRODUCER_METHODS = {"dispatch_packed", "_rlc_dispatch"}
+SYNC_SINKS = {"bool", "int", "float"}
+SYNC_SINK_METHODS = {"item", "tolist"}
+SYNC_BLOCKERS = {"jax.block_until_ready", "jax.device_get"}
+
 
 def _in_scope(rel: str) -> bool:
     return any(rel.startswith(s) or f"/{s}" in f"/{rel}" for s in SCOPES) \
         or rel.endswith("batch.py") and "crypto" in rel
+
+
+def _in_sync_scope(rel: str) -> bool:
+    return any(rel.startswith(s) or f"/{s}" in f"/{rel}"
+               for s in SYNC_SCOPES)
 
 
 class TraceChecker:
@@ -45,10 +70,126 @@ class TraceChecker:
                    "jit, mutated captured state")
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if _in_sync_scope(module.rel):
+            yield from self._check_sync_loops(module)
         if not _in_scope(module.rel):
             return
         for fn, static in self._jitted_functions(module):
             yield from self._check_jitted(module, fn, static)
+
+    # -- sync-in-loop (host orchestration pass) ------------------------------
+
+    @staticmethod
+    def _walk_scope(fn: ast.AST):
+        """Walk a function's OWN body without descending into nested
+        function definitions — each nested function is its own scope and
+        gets its own standalone visit (a jitted nested `run` is traced
+        device code and must not be judged by host-loop rules through
+        its enclosing factory)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _check_sync_loops(self, module: ModuleInfo) -> Iterator[Finding]:
+        jitted = {fn for fn, _ in self._jitted_functions(module)}
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node not in jitted:
+                yield from self._sync_loops_in(module, node)
+
+    def _device_tainted(self, module: ModuleInfo, fn: ast.AST) -> Set[str]:
+        """Names in `fn` bound to device values (async until read):
+        results of dispatch_packed/_rlc_dispatch, or of calling a name
+        that was itself bound from a `*_pipeline*` factory call."""
+        device_fns: Set[str] = set()
+        tainted: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in self._walk_scope(fn):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                callee = dotted(node.value.func) or ""
+                leaf = callee.rsplit(".", 1)[-1]
+                is_dev = (leaf in SYNC_PRODUCER_METHODS
+                          or leaf in device_fns
+                          or (isinstance(node.value.func, ast.Name)
+                              and node.value.func.id in device_fns))
+                is_factory = "_pipeline" in leaf
+                for t in node.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if is_dev and t.id not in tainted:
+                        tainted.add(t.id)
+                        changed = True
+                    if is_factory and t.id not in device_fns:
+                        device_fns.add(t.id)
+                        changed = True
+        self._device_fns = device_fns
+        return tainted
+
+    def _is_device_expr(self, module: ModuleInfo, e: ast.AST,
+                        tainted: Set[str]) -> bool:
+        for sub in ast.walk(e):
+            if isinstance(sub, ast.Name) and sub.id in tainted:
+                return True
+            if isinstance(sub, ast.Call):
+                callee = dotted(sub.func) or ""
+                leaf = callee.rsplit(".", 1)[-1]
+                if leaf in SYNC_PRODUCER_METHODS \
+                        or leaf in getattr(self, "_device_fns", set()):
+                    return True
+        return False
+
+    def _sync_loops_in(self, module: ModuleInfo,
+                       fn: ast.AST) -> Iterator[Finding]:
+        tainted = self._device_tainted(module, fn)
+        for loop in self._walk_scope(fn):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in self._walk_scope(loop):
+                if node is loop or not isinstance(node, ast.Call):
+                    continue
+                d = module.resolve(dotted(node.func) or "")
+                if d in SYNC_BLOCKERS:
+                    yield self._sync_finding(module, fn, node,
+                                             d.rsplit(".", 1)[-1] + "()")
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "block_until_ready":
+                    yield self._sync_finding(module, fn, node,
+                                             ".block_until_ready()")
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in SYNC_SINK_METHODS \
+                        and self._is_device_expr(module, node.func.value,
+                                                 tainted):
+                    yield self._sync_finding(module, fn, node,
+                                             f".{node.func.attr}()")
+                elif ((isinstance(node.func, ast.Name)
+                       and node.func.id in SYNC_SINKS)
+                      or d == "numpy.asarray") and node.args \
+                        and self._is_device_expr(module, node.args[0],
+                                                 tainted):
+                    label = d.rsplit(".", 1)[-1] if d == "numpy.asarray" \
+                        else node.func.id
+                    yield self._sync_finding(module, fn, node,
+                                             f"{label}()")
+
+    def _sync_finding(self, module: ModuleInfo, fn: ast.AST,
+                      node: ast.AST, what: str) -> Finding:
+        return Finding(
+            checker=self.name, code="trace-sync-in-loop",
+            message=(f"synchronous device readback {what} inside a "
+                     f"per-chunk loop in {fn.name}() serializes the "
+                     "stream (one interconnect round trip per "
+                     "iteration); keep the loop async "
+                     "(pack/dispatch/resolve, depth-k window) and sync "
+                     "once per stream"),
+            path=module.rel, line=node.lineno, col=node.col_offset)
 
     # -- jit discovery -------------------------------------------------------
 

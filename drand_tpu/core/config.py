@@ -50,10 +50,16 @@ class Config:
     sync_chunk: int = 512
     # resident verify service (crypto/verify_service.py): ONE daemon-owned
     # pipeline that every verify consumer submits to.  verify_pad is the
-    # canonical coalesced batch width (bench.py's 8192 standard);
-    # verify_window is how long an under-filled BACKGROUND batch may wait
-    # for co-riders before flushing; live work always flushes immediately.
-    verify_pad: int = 8192
+    # canonical coalesced batch width and verify_pipeline_depth how many
+    # dispatches stay enqueued ahead of the resolve point; 0 = AUTO —
+    # resolved per handle via crypto/tuning.py (DRAND_VERIFY_PAD /
+    # DRAND_VERIFY_PIPELINE_DEPTH env > TUNING.json for the current
+    # platform > the 8192x1 defaults, so a no-chip container is
+    # unchanged).  verify_window is how long an under-filled BACKGROUND
+    # batch may wait for co-riders before flushing; live work always
+    # flushes immediately.
+    verify_pad: int = 0
+    verify_pipeline_depth: int = 0
     verify_window: float = 0.02
     # device failure domain (verify_service watchdog/failover/probe):
     # watchdog deadline = max(floor, factor * observed p99 dispatch
@@ -134,7 +140,8 @@ class Config:
                 clock=self.clock, pad=self.verify_pad,
                 background_window=self.verify_window,
                 watchdog_factor=self.verify_watchdog_factor or None,
-                probe_interval=self.verify_probe_interval or None)
+                probe_interval=self.verify_probe_interval or None,
+                pipeline_depth=self.verify_pipeline_depth)
             # a service created while the admission ladder already has
             # background work paused must start paused, not race a level
             # change it never saw

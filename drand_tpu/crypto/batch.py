@@ -23,6 +23,7 @@ curve/pairing algebra is device-side.  Batch sizes are padded to powers of
 two to bound recompiles.
 """
 
+import os
 import secrets
 from functools import lru_cache
 
@@ -41,6 +42,53 @@ from ..ops import pairing as DP
 
 SECURITY_BITS = 128  # RLC randomizer width
 _MIN_BATCH = 8
+
+# -- occupancy knobs (ISSUE 10) ---------------------------------------------
+# Depth of the dispatch pipeline: how many chunks are kept enqueued on the
+# device AHEAD of the resolve point, so the ~74 ms/dispatch RPC latency
+# amortizes across k dispatches instead of being paid serially per chunk.
+# 1 == the r5 double buffer (pack k+1 overlaps device k, one dispatch deep).
+DEFAULT_PIPELINE_DEPTH = max(1, int(os.environ.get(
+    "DRAND_VERIFY_PIPELINE_DEPTH", "1")))
+# Hard cap on in-flight bytes so depth x chunk footprint cannot blow device
+# memory: the depth is clamped to INFLIGHT_BUDGET // chunk_footprint_bytes.
+INFLIGHT_BUDGET_BYTES = int(float(os.environ.get(
+    "DRAND_VERIFY_INFLIGHT_BUDGET_MB", "64")) * (1 << 20))
+# Donate the packed input buffers to the dispatched program (XLA reuses
+# them in place — no second copy of the chunk encoding lives across the
+# in-flight window).  "auto"/1 donates; 0 keeps the buffers (debugging).
+_DONATE = os.environ.get("DRAND_VERIFY_DONATE", "auto") != "0"
+
+
+def chunk_footprint_bytes(pad: int, g2sig: bool) -> int:
+    """Device bytes of ONE packed chunk encoding (sig x limbs + sign flags
+    + two hash-to-field elements), the unit the in-flight cap divides."""
+    limb_bytes = 24 * 4
+    per_lane = (2 * limb_bytes + 4 + 4 * limb_bytes) if g2sig \
+        else (limb_bytes + 4 + 2 * limb_bytes)
+    return pad * per_lane
+
+
+def max_pipeline_depth(pad: int, g2sig: bool) -> int:
+    """Depth ceiling derived from the per-chunk footprint: depth beyond
+    this would hold more than INFLIGHT_BUDGET_BYTES of packed chunk
+    encodings in flight."""
+    return max(1, INFLIGHT_BUDGET_BYTES // max(1, chunk_footprint_bytes(
+        pad, g2sig)))
+
+
+_DISPATCHES = {"n": 0}
+
+
+def _count_dispatch(k: int = 1) -> None:
+    _DISPATCHES["n"] += k
+
+
+def dispatch_count() -> int:
+    """Process-wide count of jitted device-pipeline invocations issued by
+    this module (and crypto/partials.py) — the CPU-backend observability
+    hook the one-dispatch-recover acceptance test and bench assert on."""
+    return _DISPATCHES["n"]
 
 _NEG_G1 = C.G1.neg(G1_GEN)
 _NEG_G2 = C.G2.neg(G2_GEN)
@@ -344,13 +392,21 @@ def _exact_g1sig_core(sig_jac, hm, pk_aff, neg_g2_aff):
 
 
 @lru_cache(maxsize=None)
-def _rlc_pipeline_g2sig():
-    return jax.jit(_rlc_run_g2sig)
+def _rlc_pipeline_g2sig(donate: bool = False):
+    # donate_argnums hands the packed chunk encoding (sig_x, sign, u0, u1)
+    # back to XLA for in-place reuse — with a depth-k in-flight window the
+    # alternative is k live copies of every input buffer.  The donating
+    # variant is a SEPARATE compiled program; only the streaming
+    # dispatch_packed path uses it (resolve_packed re-encodes from the
+    # retained host arrays on the rare RLC-failure path).
+    return jax.jit(_rlc_run_g2sig,
+                   donate_argnums=(0, 1, 2, 3) if donate else ())
 
 
 @lru_cache(maxsize=None)
-def _rlc_pipeline_g1sig():
-    return jax.jit(_rlc_run_g1sig)
+def _rlc_pipeline_g1sig(donate: bool = False):
+    return jax.jit(_rlc_run_g1sig,
+                   donate_argnums=(0, 1, 2, 3) if donate else ())
 
 
 @lru_cache(maxsize=None)
@@ -490,15 +546,19 @@ class BatchBeaconVerifier:
     def _leaf_len(enc):
         return jax.tree.leaves(enc)[0].shape[0]
 
-    def _rlc_dispatch(self, enc, n):
+    def _rlc_dispatch(self, enc, n, donate: bool = False):
         """Dispatch one RLC check (no sync): returns the device-side fused
         verdict scalar.  The randomizer bits are sampled on device from a
         fresh 128-bit key; n rides as a 0-d operand so every chunk shares
-        one compiled program."""
+        one compiled program.  `donate=True` hands the enc buffers to XLA
+        (they are dead to the caller afterwards — dispatch_packed's
+        streaming path, which retains the host arrays for re-encode)."""
         import jax.numpy as jnp
         enc = self._shard_round_axis(enc)
         sig_x, sign, u0, u1 = enc
-        pipe = _rlc_pipeline_g2sig() if self.g2sig else _rlc_pipeline_g1sig()
+        pipe = _rlc_pipeline_g2sig(donate) if self.g2sig \
+            else _rlc_pipeline_g1sig(donate)
+        _count_dispatch()
         _, all_ok = pipe(sig_x, sign, u0, u1, jnp.asarray(_rlc_keys()),
                          jnp.uint32(n), self.pk_aff, self.fixed_aff)
         return all_ok
@@ -511,6 +571,7 @@ class BatchBeaconVerifier:
         """Per-round exact pairing checks over an encoded range."""
         sig_x, sign, u0, u1 = enc
         pipe = _exact_pipeline_g2sig() if self.g2sig else _exact_pipeline_g1sig()
+        _count_dispatch()
         return np.asarray(pipe(sig_x, sign, u0, u1,
                                self.pk_aff, self.fixed_aff))[:n]
 
@@ -560,40 +621,75 @@ class BatchBeaconVerifier:
 
     def pack_chunk(self, rounds, sigs, prev_sigs=None):
         """Stage 1, host side: numpy wire parse + batched hash-to-field.
-        Returns an opaque packed tuple for dispatch/resolve."""
+        Returns an opaque packed tuple for dispatch/resolve.  The host-side
+        (sigs, msgs) ride along so the rare RLC-failure path can re-encode
+        after dispatch_packed DONATED the enc buffers to the device."""
         n = len(rounds)
         if prev_sigs is None:
             prev_sigs = [None] * n
         msgs = self._messages(rounds, prev_sigs)
         enc, bad = self._encode(sigs, msgs,
                                 max(_pad_len(n), self.pad_to or 0))
-        return n, enc, bad
+        return [n, enc, bad, (list(sigs), msgs)]
 
     def dispatch_packed(self, packed):
         """Stage 2: enqueue one RLC pass on device (no sync).  Returns the
         device-side fused verdict, or None when malformed slots force the
-        exact fallback."""
-        n, enc, bad = packed
+        exact fallback.  Input buffers are donated (DRAND_VERIFY_DONATE):
+        a depth-k in-flight window must not hold k live copies of every
+        chunk encoding on top of the programs' own working set."""
+        n, enc, bad, repack = packed
         if bad.any():
             return None                   # rare: straight to fallback
+        if enc is None:
+            # a RETRY after a faulted donating dispatch (the verify
+            # service's failover ladder re-invokes dispatch_packed once):
+            # the first attempt consumed the encoding — rebuild it from
+            # the retained host arrays, same as the resolve failure path
+            sigs, msgs = repack
+            enc, _ = self._encode(sigs, msgs,
+                                  max(_pad_len(n), self.pad_to or 0))
+        if _DONATE:
+            packed[1] = None              # enc is dead after the dispatch
+            return self._rlc_dispatch(enc, n, donate=True)
         return self._rlc_dispatch(enc, n)
 
     def resolve_packed(self, packed, verdict) -> np.ndarray:
         """Stage 3: block on the verdict scalar; bisect to the culprits on
         failure.  Returns the per-round validity array."""
-        n, enc, bad = packed
+        n, enc, bad, repack = packed
         if verdict is not None and bool(verdict):
             return np.ones(n, dtype=bool)
+        if enc is None:
+            # the fast path donated the encoding; rebuild it for bisection
+            sigs, msgs = repack
+            enc, bad = self._encode(sigs, msgs,
+                                    max(_pad_len(n), self.pad_to or 0))
         # slow path: bisection + exact checks locate the bad rounds
         return self._verify_range(enc, 0, n, bad, top=True)
 
-    def verify_stream(self, beacons, chunk_size: int = 8192):
+    def pipeline_depth(self, depth=None, chunk_size: int = 8192) -> int:
+        """Effective dispatch-pipeline depth: the requested depth (arg >
+        DRAND_VERIFY_PIPELINE_DEPTH default), clamped by the per-chunk
+        footprint so depth x chunk bytes stays under the in-flight budget
+        (depth cannot blow device memory no matter what the knob says)."""
+        want = depth if depth is not None else DEFAULT_PIPELINE_DEPTH
+        pad = max(_pad_len(chunk_size), self.pad_to or 0)
+        return max(1, min(int(want), max_pipeline_depth(pad, self.g2sig)))
+
+    def verify_stream(self, beacons, chunk_size: int = 8192, depth=None):
         """Streamed verification of an iterable of beacons (BASELINE
         config 5: replay from a populated store).  Host packing of chunk
         i+1 (numpy wire parse + native hash-to-field + transfer) overlaps
         the device pass over chunk i via double buffering — the honest
         end-to-end path for fresh data, unlike re-verifying one resident
-        batch.  Yields (rounds, ok ndarray) per chunk."""
+        batch.  Yields (rounds, ok ndarray) per chunk.
+
+        `depth` generalizes the r5 double buffer to a depth-k in-flight
+        window: up to k chunks stay ENQUEUED ahead of the resolve point,
+        so the per-dispatch RPC latency amortizes across k dispatches
+        instead of being paid serially (ISSUE 10; clamped by the
+        per-chunk footprint via pipeline_depth so VMEM is safe)."""
         from concurrent.futures import ThreadPoolExecutor
 
         def pack(chunk):
@@ -628,6 +724,7 @@ class BatchBeaconVerifier:
         # ~1 RPC latency + readback per chunk of pure serial stall).
         from collections import deque
         inflight = deque()
+        k = self.pipeline_depth(depth, chunk_size)
         # pack is in-process numpy + native hash-to-field — minutes of
         # silence means the process is wedged, not slow; bound the wait
         pack_timeout = 600.0
@@ -637,7 +734,7 @@ class BatchBeaconVerifier:
                 nxt = ex.submit(pack, chunk)
                 if pending is not None:
                     inflight.append(dispatch(pending.result(pack_timeout)))
-                    if len(inflight) > 1:
+                    while len(inflight) > k:
                         yield resolve(inflight.popleft())
                 pending = nxt
             if pending is not None:
@@ -694,6 +791,7 @@ def sign_batch(scheme: Scheme, secret: int, msgs) -> list:
     else:
         u0, u1 = DH.hash_msgs_to_field_g1(pmsgs, scheme.dst)
     bits = DC.scalars_to_bits([secret] * pad, nbits=256)
+    _count_dispatch()
     x, y, _ = _sign_pipeline(g2sig)(u0, u1, bits)
     if g2sig:
         pts = _affine_g2_to_host(x, y)
@@ -722,44 +820,50 @@ def _affine_g2_to_host(x, y):
 # (replaces kyber tbls.Recover at chainstore.go:202 for bulk aggregation)
 # ---------------------------------------------------------------------------
 
-def _decompress_grid(sig_grid, t: int, nr: int, g2sig: bool):
-    """(rounds, t) wire sigs -> stacked (t, nr) Jacobian device point.
-
-    One native C batch call when available (Montgomery limbs in the device
-    layout, no Python bigints); falls back to the per-point host decoder."""
-    from .host import native
+def _parse_grid(sig_grid, t: int, nr: int, g2sig: bool):
+    """(rounds, t) wire sigs -> (x limb array (t*nr, ...), sign bits,
+    bad mask), all pure numpy — the y recovery happens ON DEVICE inside
+    the fused recover pipeline (the r4 single-scan sqrt_ratio front end,
+    ported here).  Replaces the native-C/host decompression that used to
+    run per point before the device ever saw the batch."""
     flat = [bytes(sig_grid[r][j]) for j in range(t) for r in range(nr)]
-    if native.available():
-        import jax.numpy as jnp
-        dec = native.g2_decompress_limbs_batch if g2sig \
-            else native.g1_decompress_limbs_batch
-        limbs, ok = dec(flat)
-        if not ok.all():
-            raise ValueError("invalid partial signature encoding")
-        nc = 4 if g2sig else 2
-        coords = [jnp.asarray(limbs[:, c].reshape(t, nr, L.NLIMB))
-                  for c in range(nc)]
-        one = jnp.asarray(np.broadcast_to(_mont_limbs(1), (t, nr, L.NLIMB)))
-        if g2sig:
-            zero = jnp.zeros((t, nr, L.NLIMB), jnp.uint32)
-            return ((coords[0], coords[1]), (coords[2], coords[3]),
-                    (one, zero))
-        return (coords[0], coords[1], one)
-    from_bytes = S.g2_from_bytes if g2sig else S.g1_from_bytes
-    enc = DC.encode_g2_points if g2sig else DC.encode_g1_points
-    rows = [[from_bytes(flat[j * nr + r], check_subgroup=False)
-             for r in range(nr)] for j in range(t)]
-    return jax.tree.map(lambda *rs: jax.numpy.stack(rs),
-                        *[enc(row) for row in rows])
+    return _wire_parse(flat, g2sig)
 
 
 @lru_cache(maxsize=None)
 def _recover_pipeline(g2sig: bool):
-    def run(part_jac, bits):
+    """Fused decompress + Lagrange recovery: the wire x coordinates are
+    decompressed on device (ONE shared E2/(p-3)/4 pow scan over all t*nr
+    lanes), the Lagrange MSM runs as a signed-digit GLV ladder over the
+    psi/phi lanes (66 steps on G2, 130 on G1, vs the old 256-step
+    ladder), and the per-round sums + affine conversion ride the same
+    program — ONE dispatch per recover batch instead of decompress +
+    recover as separate stages."""
+    def run(sig_x, sign, bits, neg):
+        # sig_x leaves (t, nr, NLIMB); sign (t*nr,);
+        # bits (nbits, L*t, nr); neg (L*t, nr) with L = the GLV lane count
+        jnp = jax.numpy
         curve = DC.G2_DEV if g2sig else DC.G1_DEV
-        mult = curve.scalar_mul_bits(part_jac, bits)     # (t, rounds) points
-        acc = curve.sum_points(mult)                      # reduce axis 0 -> (rounds,)
-        return curve.to_affine(acc)
+        if g2sig:
+            t, nr = sig_x[0].shape[:2]
+            flat2 = lambda a: a.reshape((t * nr,) + a.shape[2:])
+            sig_jac, ok = DH.g2_recover_y(flat2(sig_x[0]), flat2(sig_x[1]),
+                                          sign)
+            lanes = DC.g2_psi_lanes(sig_jac)
+        else:
+            t, nr = sig_x.shape[:2]
+            sig_jac, ok = DH.g1_recover_y(
+                sig_x.reshape((t * nr,) + sig_x.shape[2:]), sign)
+            lanes = DC.g1_phi_lanes(sig_jac)
+        nlanes = bits.shape[1]                # L*t (static)
+        base = curve._select(neg.reshape(-1) == 1,
+                             curve.neg(lanes), lanes)
+        base = jax.tree.map(
+            lambda a: a.reshape((nlanes, nr) + a.shape[1:]), base)
+        mult = curve.scalar_mul_bits(base, bits)   # (L*t, nr) points
+        acc = curve.sum_points(mult)               # reduce axis 0 -> (nr,)
+        x, y, _ = curve.to_affine(acc)
+        return x, y, jnp.all(ok)
 
     return jax.jit(run)
 
@@ -771,19 +875,35 @@ def recover_batch(scheme: Scheme, indices, partial_sigs) -> list:
     bytes (WITHOUT the 2-byte index prefix).  Assumes partials pre-verified
     (the aggregator feeds only validated partials, chainstore.go:241).
     Returns list of full signature bytes."""
+    import jax.numpy as jnp
     nr = len(indices)
     t = len(indices[0])
     g2sig = scheme.sig_group is GroupG2
-    # host: Lagrange coefficients (Python ints mod r, t*nr of them)
-    lams = np.zeros((t, nr), dtype=object)
-    for r in range(nr):
-        idxs = indices[r]
-        for j in range(t):
-            lams[j][r] = HT._lagrange_coeff(idxs, idxs[j])
-    part_jac = _decompress_grid(partial_sigs, t, nr, g2sig)
-    flat = [int(lams[j][r]) for j in range(t) for r in range(nr)]
-    bits = DC.scalars_to_bits(flat, nbits=256).reshape(256, t, nr)
-    x, y, _ = _recover_pipeline(g2sig)(part_jac, bits)
+    # host: Lagrange coefficients (Python ints mod r, t*nr of them), then
+    # signed GLV digits so the device ladder is 66/130 steps, not 256
+    lams = [HT._lagrange_coeff(indices[r], indices[r][j])
+            for j in range(t) for r in range(nr)]
+    decompose = DC.glv_decompose_g2 if g2sig else DC.glv_decompose_g1
+    nlanes = DC.GLV_G2_LANES if g2sig else DC.GLV_G1_LANES
+    nbits = DC.GLV_G2_NBITS if g2sig else DC.GLV_G1_NBITS
+    bits, neg = decompose(lams)              # (nbits, L, t*nr), (L, t*nr)
+    bits = bits.reshape(nbits, nlanes * t, nr)
+    neg = neg.reshape(nlanes * t, nr)
+    xw, sgn, bad = _parse_grid(partial_sigs, t, nr, g2sig)
+    if bad.any():
+        raise ValueError("invalid partial signature encoding")
+    if g2sig:
+        sig_x = (jnp.asarray(xw[:, 0].reshape(t, nr, L.NLIMB)),
+                 jnp.asarray(xw[:, 1].reshape(t, nr, L.NLIMB)))
+    else:
+        sig_x = jnp.asarray(xw.reshape(t, nr, L.NLIMB))
+    _count_dispatch()
+    x, y, dec_ok = _recover_pipeline(g2sig)(sig_x, jnp.asarray(sgn),
+                                            bits, neg)
+    if not bool(dec_ok):
+        # a wire x with no y on the curve — the host decoder's ValueError,
+        # detected on device by the shared sqrt scan instead
+        raise ValueError("invalid partial signature encoding")
     if g2sig:
         host_pts = _affine_g2_to_host(x, y)
         return [S.g2_to_bytes(pt) for pt in host_pts]

@@ -14,6 +14,19 @@ evaluated once per group on the host (the polynomial is tiny); the Miller
 product has (#distinct signers + 1) pairs.  On RLC failure, exact per-slot
 pairing checks locate invalid partials.
 
+Occupancy fast path (ISSUE 10, ported from the r4 G1/G2 verify machinery):
+
+  * the host no longer decompresses partials point by point — wire bytes
+    are split into x-limb arrays with pure numpy (`batch._wire_parse`) and
+    the y recovery rides the SAME single sqrt_ratio pow scan as the two
+    SSWU hash maps (`ops/h2c.g2_decompress_and_hash`; scans cost per
+    step, not per lane — the G1/G2 free lunch, now on partials);
+  * the RLC MSM uses the split-sampled GLV coefficients: ψ-split 4-way on
+    G2 (32-step joint ladder) and φ-split 2-way on G1 (64-step), exactly
+    like crypto/batch.py's verify pipelines, instead of a 128-step
+    per-bit ladder.  Soundness is unchanged: coefficients are sampled
+    directly in split form (injective; see batch._rlc_scalars).
+
 Slot layout: callers pass ragged per-round partial lists (wire format:
 be16(index) || sig); rows are padded to the widest row and masked.
 """
@@ -25,8 +38,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import tbls as HT
-from .batch import _NEG_G1, _NEG_G2, _device_rlc_bits, _rlc_keys
-from .host.params import G1_GEN, G2_GEN
+from .batch import (_NEG_G1, _NEG_G2, _count_dispatch, _device_rlc_bits,
+                    _gen_sub, _rlc_keys, _wire_parse, _GEN_JAC_G1,
+                    _GEN_JAC_G2, _GEN_SIGN_G1, _GEN_SIGN_G2, _GEN_X_G1,
+                    _GEN_X_G2)
 from .schemes import Scheme, GroupG2
 from ..ops import curve as DC
 from ..ops import h2c as DH
@@ -66,35 +81,46 @@ def _prepend_point(single, stacked):
                         single, stacked)
 
 
-def _partials_bits(keys, valid):
-    """(SB, 2rk) randomizer planes on device: one coefficient per slot
-    (zero where invalid), duplicated for the tiled-hm half (the same c_rj
-    multiplies S_rj and H_r — the RLC identity needs equal coefficients)."""
-    b, = _device_rlc_bits(keys, valid, split=1)
-    return jnp.concatenate([b, b], axis=1)
-
-
 def _partials_verdict(sub_ok, ok, valid):
-    """Fused device scalar: RLC ok AND every valid slot's subgroup check."""
+    """Fused device scalar: RLC ok AND every valid slot's decompression +
+    subgroup check ok (a slot that failed device decompression has a
+    generator substitute and a live coefficient, so the RLC itself also
+    fails — the fallback then localizes it)."""
     return ok & jnp.all(sub_ok | ~valid.astype(bool))
 
 
-def _rlc_partials_run_g2sig(sig_jac, u0, u1, keys, valid, onehot, pk_sel,
-                            neg_g1_aff):
-    """sigs on G2, pks on G1.  sig_jac: (rk,) G2 jac; u0/u1: (r,) fp2;
-    keys: (2, 2) threefry keys; valid: (rk,) slot mask; onehot: (p, rk);
-    pk_sel: ((p,24),(p,24)) G1 affine."""
+# lane concatenation shares ops/curve's helper (the psi-lane layout there
+# is exactly this operation)
+_cat = DC._cat_lanes
+
+
+def _rlc_partials_run_g2sig(sig_x, sign, u0, u1, keys, valid, onehot,
+                            pk_sel, neg_g1_aff):
+    """sigs on G2, pks on G1.  sig_x: ((rk,24),(rk,24)) wire x limbs;
+    sign: (rk,) flags; u0/u1: (r,) fp2; keys: (2, 2) threefry keys;
+    valid: (rk,) slot mask; onehot: (p, rk); pk_sel: ((p,24),(p,24)) G1
+    affine.  Front end: ONE Fp2 sqrt_ratio scan fuses slot decompression
+    + both SSWU maps; MSM: ψ-split 4-way GLV over [S, ψS, H, ψH] lanes
+    (32-step joint ladder, coefficients sampled as base-x quarters)."""
     rk = onehot.shape[1]
     r = u0[0].shape[0]
     k = rk // r
-    bits = _partials_bits(keys, valid)
-    sub_ok = DC.g2_in_subgroup(sig_jac)
-    hm = _tile_rounds(DH.hash_to_g2_jac(u0, u1), k)
-    both = jax.tree.map(lambda a, b: jnp.concatenate([a, b], 0), sig_jac, hm)
-    mult = DC.G2_DEV.scalar_mul_bits(both, bits)
-    s_sum = DC.G2_DEV.sum_points(jax.tree.map(lambda t: t[:rk], mult))
-    ch = jax.tree.map(lambda t: t[rk:], mult)
-    ts = _masked_sums(DC.G2_DEV, ch, onehot)
+    sig_jac, parse_ok, hm_r = DH.g2_decompress_and_hash(
+        sig_x[0], sig_x[1], sign, u0, u1)
+    sig_jac = _gen_sub(DC.G2_DEV, _GEN_JAC_G2, sig_jac, parse_ok)
+    sub_ok = DC.g2_in_subgroup(sig_jac) & parse_ok
+    hm = _tile_rounds(hm_r, k)
+    b0, b1, b2, b3 = _device_rlc_bits(keys, valid, split=4)
+    # lane order [S, ψS, H, ψH]: the same coefficient c_rj multiplies
+    # S_rj and H_r (the RLC identity), so both halves share the quarters
+    base = _cat(sig_jac, DC.g2_psi(sig_jac), hm, DC.g2_psi(hm))
+    bl = jnp.concatenate([b0, b1, b0, b1], axis=1)
+    bh = jnp.concatenate([b2, b3, b2, b3], axis=1)
+    mult = DC.g2_glv_msm_terms(base, bl, bh)
+    s_sum = DC.G2_DEV.sum_points(jax.tree.map(lambda t: t[:2 * rk], mult))
+    ch = jax.tree.map(lambda t: t[2 * rk:], mult)
+    onehot2 = jnp.concatenate([onehot, onehot], axis=1)
+    ts = _masked_sums(DC.G2_DEV, ch, onehot2)
     qx_all, qy_all, _ = DC.G2_DEV.to_affine(_prepend_point(s_sum, ts))
     px = jnp.concatenate([neg_g1_aff[0][None], pk_sel[0]], axis=0)
     py = jnp.concatenate([neg_g1_aff[1][None], pk_sel[1]], axis=0)
@@ -103,17 +129,22 @@ def _rlc_partials_run_g2sig(sig_jac, u0, u1, keys, valid, onehot, pk_sel,
     return sub_ok, _partials_verdict(sub_ok, ok, valid)
 
 
-def _rlc_partials_run_g1sig(sig_jac, u0, u1, keys, valid, onehot, pk_sel,
-                            neg_g2_aff):
-    """sigs on G1, pks on G2 (short-sig scheme)."""
+def _rlc_partials_run_g1sig(sig_x, sign, u0, u1, keys, valid, onehot,
+                            pk_sel, neg_g2_aff):
+    """sigs on G1, pks on G2 (short-sig scheme): fused decompression via
+    the shared (p-3)/4 scan + φ-split 2-way GLV (64-step joint ladder)."""
     rk = onehot.shape[1]
     r = u0.shape[0]
     k = rk // r
-    bits = _partials_bits(keys, valid)
-    sub_ok = DC.g1_in_subgroup(sig_jac)
-    hm = _tile_rounds(DH.hash_to_g1_jac(u0, u1), k)
-    both = jax.tree.map(lambda a, b: jnp.concatenate([a, b], 0), sig_jac, hm)
-    mult = DC.G1_DEV.scalar_mul_bits(both, bits)
+    sig_jac, parse_ok, hm_r = DH.g1_decompress_and_hash(sig_x, sign, u0, u1)
+    sig_jac = _gen_sub(DC.G1_DEV, _GEN_JAC_G1, sig_jac, parse_ok)
+    sub_ok = DC.g1_in_subgroup(sig_jac) & parse_ok
+    hm = _tile_rounds(hm_r, k)
+    b0, b1 = _device_rlc_bits(keys, valid, split=2)
+    both = _cat(sig_jac, hm)
+    bits0 = jnp.concatenate([b0, b0], axis=1)
+    bits1 = jnp.concatenate([b1, b1], axis=1)
+    mult = DC.g1_glv_msm_terms(both, bits0, bits1)
     s_sum = DC.G1_DEV.sum_points(jax.tree.map(lambda t: t[:rk], mult))
     ch = jax.tree.map(lambda t: t[rk:], mult)
     ts = _masked_sums(DC.G1_DEV, ch, onehot)
@@ -127,13 +158,19 @@ def _rlc_partials_run_g1sig(sig_jac, u0, u1, keys, valid, onehot, pk_sel,
     return sub_ok, _partials_verdict(sub_ok, ok, valid)
 
 
-def _exact_partials_run_g2sig(sig_jac, u0, u1, k, pk_slot, neg_g1_aff):
-    """Per-slot exact checks with per-slot pubkeys (fallback path)."""
-    sub_ok = DC.g2_in_subgroup(sig_jac)
-    hm = _tile_rounds(DH.hash_to_g2_jac(u0, u1), k)
+def _exact_partials_run_g2sig(sig_x, sign, u0, u1, pk_slot, neg_g1_aff):
+    """Per-slot exact checks with per-slot pubkeys (fallback path); the
+    decompression rides the same fused front end as the RLC pass."""
+    rk = sig_x[0].shape[0]
+    r = u0[0].shape[0]
+    k = rk // r
+    sig_jac, parse_ok, hm_r = DH.g2_decompress_and_hash(
+        sig_x[0], sig_x[1], sign, u0, u1)
+    sig_jac = _gen_sub(DC.G2_DEV, _GEN_JAC_G2, sig_jac, parse_ok)
+    sub_ok = DC.g2_in_subgroup(sig_jac) & parse_ok
+    hm = _tile_rounds(hm_r, k)
     sx, sy, s_inf = DC.G2_DEV.to_affine(sig_jac)
     hx, hy, _ = DC.G2_DEV.to_affine(hm)
-    rk = pk_slot[0].shape[0]
     px = jnp.stack([jnp.broadcast_to(neg_g1_aff[0], (rk, L.NLIMB)), pk_slot[0]])
     py = jnp.stack([jnp.broadcast_to(neg_g1_aff[1], (rk, L.NLIMB)), pk_slot[1]])
     qx = jax.tree.map(lambda a, b: jnp.stack([a, b]), sx, hx)
@@ -142,12 +179,16 @@ def _exact_partials_run_g2sig(sig_jac, u0, u1, k, pk_slot, neg_g1_aff):
     return sub_ok & ~s_inf & ok
 
 
-def _exact_partials_run_g1sig(sig_jac, u0, u1, k, pk_slot, neg_g2_aff):
-    sub_ok = DC.g1_in_subgroup(sig_jac)
-    hm = _tile_rounds(DH.hash_to_g1_jac(u0, u1), k)
+def _exact_partials_run_g1sig(sig_x, sign, u0, u1, pk_slot, neg_g2_aff):
+    rk = sig_x.shape[0]
+    r = u0.shape[0]
+    k = rk // r
+    sig_jac, parse_ok, hm_r = DH.g1_decompress_and_hash(sig_x, sign, u0, u1)
+    sig_jac = _gen_sub(DC.G1_DEV, _GEN_JAC_G1, sig_jac, parse_ok)
+    sub_ok = DC.g1_in_subgroup(sig_jac) & parse_ok
+    hm = _tile_rounds(hm_r, k)
     sx, sy, s_inf = DC.G1_DEV.to_affine(sig_jac)
     hx, hy, _ = DC.G1_DEV.to_affine(hm)
-    rk = sx.shape[0]
     px = jnp.stack([sx, hx])
     py = jnp.stack([sy, hy])
     bc = lambda c: jnp.broadcast_to(c, (rk, L.NLIMB))
@@ -164,8 +205,7 @@ def _rlc_pipeline(g2sig: bool):
 
 @lru_cache(maxsize=None)
 def _exact_pipeline(g2sig: bool):
-    return jax.jit(_exact_partials_run_g2sig if g2sig else _exact_partials_run_g1sig,
-                   static_argnums=(3,))
+    return jax.jit(_exact_partials_run_g2sig if g2sig else _exact_partials_run_g1sig)
 
 
 class BatchPartialVerifier:
@@ -193,38 +233,50 @@ class BatchPartialVerifier:
     # -- host-side packing ---------------------------------------------------
 
     def _parse(self, rows, k):
-        """-> (slot points, slot indices (r,k), valid mask (r,k))."""
-        gen = G2_GEN if self.g2sig else G1_GEN
-        from_bytes = (self.scheme.sig_group.from_bytes)
-        pts, idxs, valid = [], [], []
+        """-> (x limb array, sign flags, slot indices (r,k), valid (r,k)),
+        all pure numpy — NO per-point host decompression (the y recovery
+        runs on device inside the fused pipelines).  Host-detectable
+        badness (missing slot, wrong length, bad flags, x >= p, signer
+        index out of range) lands in the valid mask; slots whose x has no
+        y on the curve are caught by the device parse_ok and localized by
+        the exact fallback."""
+        nb = 96 if self.g2sig else 48
+        sig_bytes, idxs, idx_ok = [], [], []
         for row in rows:
             for j in range(k):
-                if j >= len(row) or row[j] is None:
-                    pts.append(gen); idxs.append(0); valid.append(False)
+                p = bytes(row[j]) if j < len(row) and row[j] is not None \
+                    else b""
+                idx = HT.index_of(p) if len(p) >= 2 else 0
+                if len(p) != nb + 2 or not (0 <= idx < self.n_nodes):
+                    sig_bytes.append(b"")       # wrong length -> wire bad
+                    idxs.append(0)
+                    idx_ok.append(False)
                     continue
-                p = bytes(row[j])
-                idx = HT.index_of(p)
-                try:
-                    if not (0 <= idx < self.n_nodes):
-                        raise ValueError("bad signer index")
-                    pt = from_bytes(p[2:], check_subgroup=False)
-                    if pt is None:
-                        raise ValueError("infinity partial")
-                except (ValueError, AssertionError):
-                    pts.append(gen); idxs.append(0); valid.append(False)
-                    continue
-                pts.append(pt); idxs.append(idx); valid.append(True)
+                sig_bytes.append(p[2:])
+                idxs.append(idx)
+                idx_ok.append(True)
+        xw, sign, bad = _wire_parse(sig_bytes, self.g2sig)
+        bad |= ~np.asarray(idx_ok)
+        # substitute the generator encoding into bad slots: inert (zero
+        # RLC coefficient, verdict carried by the valid mask)
+        gx = _GEN_X_G2 if self.g2sig else _GEN_X_G1
+        gsign = _GEN_SIGN_G2 if self.g2sig else _GEN_SIGN_G1
+        xw[bad] = gx
+        sign[bad] = gsign
+        idxa = np.array(idxs)
+        idxa[bad] = 0
         shape = (len(rows), k)
-        return pts, np.array(idxs).reshape(shape), np.array(valid).reshape(shape)
+        return xw, sign, idxa.reshape(shape), (~bad).reshape(shape)
 
-    def _encode_slots(self, pts, msgs):
+    def _sig_x(self, xw):
         if self.g2sig:
-            sig_jac = DC.encode_g2_points(pts)
-            u0, u1 = DH.hash_msgs_to_field_g2(msgs, self.scheme.dst)
-        else:
-            sig_jac = DC.encode_g1_points(pts)
-            u0, u1 = DH.hash_msgs_to_field_g1(msgs, self.scheme.dst)
-        return sig_jac, u0, u1
+            return (jnp.asarray(xw[:, 0]), jnp.asarray(xw[:, 1]))
+        return jnp.asarray(xw)
+
+    def _hash_msgs(self, msgs):
+        if self.g2sig:
+            return DH.hash_msgs_to_field_g2(msgs, self.scheme.dst)
+        return DH.hash_msgs_to_field_g1(msgs, self.scheme.dst)
 
     def _pk_sel(self, signer_list):
         ix = np.asarray(signer_list)
@@ -245,22 +297,24 @@ class BatchPartialVerifier:
         k = max((len(row) for row in partial_rows), default=0)
         if k == 0:
             return np.zeros((r, 0), dtype=bool)
-        pts, idxs, valid = self._parse(partial_rows, k)
+        xw, sign, idxs, valid = self._parse(partial_rows, k)
         if not valid.any():
             return valid  # nothing parsed — no device work to do
-        sig_jac, u0, u1 = self._encode_slots(pts, msgs)
-        rk = r * k
+        sig_x = self._sig_x(xw)
+        sign_d = jnp.asarray(sign)
+        u0, u1 = self._hash_msgs(msgs)
 
         flat_valid = valid.reshape(-1)
         flat_idx = idxs.reshape(-1)
         signers = sorted(set(flat_idx[flat_valid]))
-        onehot = np.zeros((len(signers), rk), dtype=np.uint32)
+        onehot = np.zeros((len(signers), r * k), dtype=np.uint32)
         for i, s in enumerate(signers):
             onehot[i] = (flat_idx == s) & flat_valid
         # per-slot randomizers are sampled on device from a fresh 128-bit
         # key (batch._device_rlc_bits); invalid slots get zero coefficients
+        _count_dispatch()
         _, all_ok = _rlc_pipeline(self.g2sig)(
-            sig_jac, u0, u1, jnp.asarray(_rlc_keys()),
+            sig_x, sign_d, u0, u1, jnp.asarray(_rlc_keys()),
             jnp.asarray(flat_valid.astype(np.uint32)), jnp.asarray(onehot),
             self._pk_sel(signers), self.fixed_aff)
         if bool(all_ok):
@@ -268,6 +322,7 @@ class BatchPartialVerifier:
 
         # exact fallback: per-slot pairings with per-slot public shares
         pk_slot = self._pk_sel(idxs.reshape(-1))
+        _count_dispatch()
         got = np.asarray(_exact_pipeline(self.g2sig)(
-            sig_jac, u0, u1, k, pk_slot, self.fixed_aff))
+            sig_x, sign_d, u0, u1, pk_slot, self.fixed_aff))
         return got.reshape(r, k) & valid

@@ -74,6 +74,13 @@ DEFAULT_PAD = 8192          # the canonical batch width bench.py standardized
 DEFAULT_BG_WINDOW = 0.02    # seconds a background batch may wait to fill
 DEFAULT_LIVE_WINDOW = 0.0   # live work flushes immediately
 
+# Occupancy knobs (ISSUE 10).  pad=0 / pipeline_depth=0 on the ctor mean
+# AUTO: each handle resolves its (pad, depth) through crypto/tuning.py —
+# env override (DRAND_VERIFY_PAD / DRAND_VERIFY_PIPELINE_DEPTH) wins over
+# a TUNING.json entry for the current backend platform, which wins over
+# the 8192x1 defaults (a container with no chip and no tuning file
+# behaves exactly as before).
+
 # Failure-domain knobs (Config.verify_watchdog_factor / verify_probe_interval
 # override per daemon; the env vars override the module defaults the same way
 # net/resilience.py's DRAND_RETRY_* family does).  The deadline for a device
@@ -163,17 +170,24 @@ class _Batch:
 
 
 class _Ticket:
-    """One in-flight dispatch under watchdog supervision."""
+    """One in-flight dispatch under watchdog supervision.  Tickets of the
+    same slot form one shared-device window: only the OLDEST is eligible
+    to trip, and when it retires (success or trip) the survivors'
+    deadlines are re-based from `budget` — they were queued behind it,
+    not hung."""
 
     __slots__ = ("slot", "batch", "kind", "started", "deadline_at",
-                 "cancelled")
+                 "budget", "cancelled")
 
-    def __init__(self, slot, batch, kind, started, deadline_at):
+    def __init__(self, slot, batch, kind, started, deadline_at,
+                 budget=None):
         self.slot = slot
         self.batch = batch
         self.kind = kind            # "chunk" | "call" | "probe"
         self.started = started
         self.deadline_at = deadline_at
+        self.budget = budget if budget is not None \
+            else max(0.0, deadline_at - started)
         self.cancelled = False
 
 
@@ -184,9 +198,10 @@ class _BackendSlot:
 
     __slots__ = ("key", "label", "primary", "fallback_factory", "fallback",
                  "state", "latencies", "sample", "failovers", "degraded_at",
-                 "first_fault_at")
+                 "first_fault_at", "pad", "depth")
 
-    def __init__(self, key, label, primary, fallback_factory=None):
+    def __init__(self, key, label, primary, fallback_factory=None,
+                 pad=DEFAULT_PAD, depth=1):
         self.key = key
         self.label = label
         self.primary = primary
@@ -194,6 +209,8 @@ class _BackendSlot:
         self.fallback = None
         self.state = STATE_HEALTHY
         self.latencies: deque = deque(maxlen=64)
+        self.pad = pad          # coalesced batch width for this handle
+        self.depth = depth      # dispatch-pipeline depth for this handle
         # (rounds, sigs, prevs, verdict) of a known-good 1-lane dispatch:
         # the canary probe replays it and requires the same verdict, so a
         # poisoned device (answers, but wrongly) cannot re-promote itself
@@ -298,19 +315,24 @@ class VerifyService:
     their deadline, and `verify-probe` canaries degraded backends back
     to health."""
 
-    def __init__(self, clock=None, pad: int = DEFAULT_PAD,
+    def __init__(self, clock=None, pad: int = 0,
                  live_window: float = DEFAULT_LIVE_WINDOW,
                  background_window: float = DEFAULT_BG_WINDOW,
                  watchdog_factor: Optional[float] = None,
                  watchdog_floor: Optional[float] = None,
-                 probe_interval: Optional[float] = None):
+                 probe_interval: Optional[float] = None,
+                 pipeline_depth: int = 0):
         if clock is None:
             # deferred import: crypto must not hard-depend on beacon at
             # module scope (same layering softening as net/resilience.py)
             from ..beacon.clock import RealClock
             clock = RealClock()
         self.clock = clock
-        self.pad = max(1, pad)
+        # pad/pipeline_depth 0 = AUTO: resolved per handle via
+        # crypto/tuning.py (env > TUNING.json > 8192x1); non-zero pins.
+        self.pad_override = max(0, int(pad or 0))
+        self.depth_override = max(0, int(pipeline_depth or 0))
+        self.pad = self.pad_override or DEFAULT_PAD
         self.windows = {LANE_LIVE: live_window,
                         LANE_BACKGROUND: background_window}
         self.watchdog_factor = watchdog_factor or DEFAULT_WATCHDOG_FACTOR
@@ -338,6 +360,9 @@ class VerifyService:
         self._dispatches = 0
         self._dispatch_lanes = 0    # sum of real lanes over all dispatches
         self._dispatch_slots = 0    # sum of padded widths over all dispatches
+        self._queue_time = 0.0      # sum of per-batch queue waits (oldest rider)
+        self._device_time = 0.0     # sum of per-chunk dispatch->verdict time
+        self._inflight_max = 0      # deepest in-flight window observed
         self._preemptions = 0
         self._failovers = 0
         self._promotions = 0
@@ -351,7 +376,11 @@ class VerifyService:
         unavailable) selects the `HostBatchVerifier` fallback behind the
         same API; `backend=` injects a custom verifier (tests/chaos) and
         `fallback=` its failover target.  Device handles get a lazy
-        `HostBatchVerifier` failover target automatically."""
+        `HostBatchVerifier` failover target automatically.
+
+        The handle's coalescing pad and dispatch-pipeline depth are
+        resolved HERE through crypto/tuning.py (explicit ctor values pin;
+        env overrides beat TUNING.json; no file + no env = 8192x1)."""
         pk = bytes(public_key_bytes)
         kind = "custom" if backend is not None else \
             ("device" if device and self._device_available() else "host")
@@ -360,8 +389,9 @@ class VerifyService:
             h = self._handles.get(key)
         if h is not None:
             return h
+        pad, depth = self._tuned(scheme)
         if backend is None:
-            backend = self._make_backend(scheme, pk, kind)
+            backend = self._make_backend(scheme, pk, kind, pad)
         h = VerifyHandle(self, key, scheme, backend)
         if fallback is not None:
             fallback_factory = lambda fb=fallback: fb  # noqa: E731
@@ -372,7 +402,7 @@ class VerifyService:
         else:
             fallback_factory = None     # host handles have nowhere to go
         slot = _BackendSlot(key, f"{scheme.id}:{pk[:4].hex()}", backend,
-                            fallback_factory)
+                            fallback_factory, pad=pad, depth=depth)
         with self._cond:
             # two racing builders: first insert wins, both see one handle
             h = self._handles.setdefault(key, h)
@@ -405,10 +435,46 @@ class VerifyService:
         except Exception:
             return False
 
-    def _make_backend(self, scheme, pk: bytes, kind: str):
+    @staticmethod
+    def _platform() -> str:
+        try:
+            import jax
+            return jax.default_backend()
+        except Exception:
+            return "cpu"
+
+    def _tuned(self, scheme):
+        """(pad, depth) for a new handle: explicit ctor overrides pin;
+        otherwise env > TUNING.json (current platform + scheme kind) >
+        the 8192x1 defaults.  Platform detection (a jax touch) is skipped
+        when nothing could override anyway."""
+        from . import tuning
+        if self.pad_override and self.depth_override:
+            return self.pad_override, self.depth_override
+        sig_group = getattr(scheme, "sig_group", None)
+        kind = "g2" if getattr(sig_group, "__name__", "") == "GroupG2" \
+            else "g1"
+        consult = tuning.tuning_path() is not None \
+            or os.environ.get("DRAND_VERIFY_PAD") \
+            or os.environ.get("DRAND_VERIFY_PIPELINE_DEPTH")
+        platform = self._platform() if consult else "cpu"
+        pad, depth, _src = tuning.resolve(
+            kind, platform, pad=self.pad_override or None,
+            depth=self.depth_override or None)
+        return pad, depth
+
+    def _pad_of(self, key) -> int:
+        """Coalescing width for a handle key (caller holds the lock or
+        accepts a benign race on an immutable slot field)."""
+        slot = self._slots.get(key)
+        if slot is not None:
+            return slot.pad
+        return self.pad_override or DEFAULT_PAD
+
+    def _make_backend(self, scheme, pk: bytes, kind: str, pad: int):
         if kind == "device":
             from .batch import BatchBeaconVerifier
-            return BatchBeaconVerifier(scheme, pk, pad_to=self.pad,
+            return BatchBeaconVerifier(scheme, pk, pad_to=pad,
                                        sharding=self._device_sharding())
         from .hostverify import HostBatchVerifier
         return HostBatchVerifier(scheme, pk)
@@ -575,7 +641,7 @@ class VerifyService:
         next_flush = None
         for r in self._queues[lane]:
             if r.kind == "call" or r.flush or window <= 0 \
-                    or fills[r.key] >= self.pad \
+                    or fills[r.key] >= self._pad_of(r.key) \
                     or now >= r.enqueued + window \
                     or waited >= self.REAL_FLUSH_CAP:
                 return r, None
@@ -627,6 +693,14 @@ class VerifyService:
         if batch.call is not None:
             self._execute_call(batch)
             return
+        # queue-time half of the dispatch_latency split: how long the
+        # OLDEST rider waited between submit and the device seeing work
+        # (coalescing window + lane contention; the device half is
+        # observed per chunk in _account)
+        queued = min((r.enqueued for r in batch.requests),
+                     default=self.clock.monotonic())
+        self._account_queue(batch.lane,
+                            self.clock.monotonic() - queued)
         try:
             results, errors = self._run_chunks(batch)
         except _Abandoned:
@@ -686,7 +760,8 @@ class VerifyService:
             sigs.extend(r.sigs)
             prevs.extend(r.prevs)
         n = len(rounds)
-        spans = [(lo, min(lo + self.pad, n)) for lo in range(0, n, self.pad)]
+        pad = self._pad_of(batch.key)
+        spans = [(lo, min(lo + pad, n)) for lo in range(0, n, pad)]
         results = np.zeros(n, dtype=bool)
         errors: List[Tuple[int, int, BaseException]] = []
         backend = batch.backend
@@ -721,11 +796,23 @@ class VerifyService:
     def _run_pipelined(self, batch, slot, backend, rounds, sigs, prevs,
                        spans, results, errors) -> None:
         """Device path: host packing of chunk k+1 overlaps device compute
-        of chunk k (the verify_stream double buffer, generalized to every
-        caller), with the preemption check at each chunk boundary and
-        per-chunk error containment."""
+        of chunk k, generalized to a DEPTH-K in-flight window (ISSUE 10):
+        up to `depth` dispatches stay enqueued ahead of the resolve point
+        so the per-dispatch RPC latency amortizes across the window
+        instead of being paid serially per chunk.  Preemption checks stay
+        at chunk boundaries; per-chunk errors stay contained.  The
+        watchdog deadline of each resolve is scaled by the number of
+        dispatches sharing the device (deadline on the oldest in-flight
+        work, not each dispatch independently)."""
+        from ..metrics import verify_inflight
         packer = self._ensure_packer()
-        pad_width = max(self.pad, getattr(backend, "pad_to", 0) or 0)
+        pad_width = max(self._pad_of(batch.key),
+                        getattr(backend, "pad_to", 0) or 0)
+        depth = max(1, slot.depth if slot is not None else 1)
+        if hasattr(backend, "pipeline_depth"):
+            # the backend clamps by per-chunk footprint: depth x chunk
+            # bytes must stay under the in-flight budget (VMEM safety)
+            depth = backend.pipeline_depth(depth, pad_width)
 
         def pack(lo, hi):
             return lo, hi, backend.pack_chunk(
@@ -738,50 +825,77 @@ class VerifyService:
                                  lambda: backend.dispatch_packed(packed))
             return lo, hi, packed, d, t0
 
-        def resolve(item):
+        # Per-chunk device time must be the NON-OVERLAPPED interval: under
+        # depth-k a chunk's dispatch->verdict wall time includes the k-1
+        # predecessors it queued behind, which would inflate the p99 the
+        # watchdog scales by the window (k^2 deadlines) and make
+        # device_time_s exceed wall clock.  Attribute to each resolve only
+        # the time since the later of its own dispatch and the previous
+        # resolve — the samples sum to wall time and approximate true
+        # per-chunk device time once the pipeline is full.
+        last_resolved = [None]
+
+        def resolve(item, window):
             lo, hi, packed, verdict, t0 = item
             results[lo:hi] = self._chunk_call(
                 slot, batch, lambda: self._validated(
-                    backend.resolve_packed(packed, verdict), hi - lo))
-            self._account(batch.lane, hi - lo, pad_width,
-                          self.clock.monotonic() - t0, slot=slot)
+                    backend.resolve_packed(packed, verdict), hi - lo),
+                scale=window)
+            end = self.clock.monotonic()
+            start = t0 if last_resolved[0] is None \
+                else max(t0, last_resolved[0])
+            last_resolved[0] = end
+            self._account(batch.lane, hi - lo, pad_width, end - start,
+                          slot=slot)
             self._stash_sample(slot, rounds, sigs, prevs, results, lo)
 
         inflight: deque = deque()
+
+        def note_depth():
+            d = len(inflight)
+            verify_inflight.set(d)
+            with self._cond:
+                if d > self._inflight_max:
+                    self._inflight_max = d
 
         def advance(p):
             fut, lo, hi = p
             try:
                 inflight.append(dispatch(fut.result(self.PACK_TIMEOUT)))
+                note_depth()
             except (_Abandoned, _Requeued):
                 raise
             except BaseException as e:
                 errors.append((lo, hi, e))
 
         def drain_one():
+            window = len(inflight)
             item = inflight.popleft()
             lo, hi = item[0], item[1]
             try:
-                resolve(item)
+                resolve(item, window)
             except (_Abandoned, _Requeued):
                 raise
             except BaseException as e:
                 errors.append((lo, hi, e))
 
-        pending = None
-        for lo, hi in spans:
-            self._maybe_preempt(batch)
-            nxt = (packer.submit(pack, lo, hi), lo, hi)
+        try:
+            pending = None
+            for lo, hi in spans:
+                self._maybe_preempt(batch)
+                nxt = (packer.submit(pack, lo, hi), lo, hi)
+                if pending is not None:
+                    advance(pending)
+                    while len(inflight) > depth:
+                        drain_one()
+                pending = nxt
             if pending is not None:
+                self._maybe_preempt(batch)
                 advance(pending)
-                if len(inflight) > 1:
-                    drain_one()
-            pending = nxt
-        if pending is not None:
-            self._maybe_preempt(batch)
-            advance(pending)
-        while inflight:
-            drain_one()
+            while inflight:
+                drain_one()
+        finally:
+            verify_inflight.set(0)
 
     @staticmethod
     def _call_verify(backend, rounds, sigs, prevs) -> np.ndarray:
@@ -802,24 +916,29 @@ class VerifyService:
 
     # -- the failure domain ---------------------------------------------------
 
-    def _deadline_for(self, slot: Optional[_BackendSlot]) -> float:
+    def _deadline_for(self, slot: Optional[_BackendSlot],
+                      scale: int = 1) -> float:
         """Watchdog deadline: a generous multiple of this slot's observed
         p99 dispatch latency, floored for cold compiles; opaque calls
-        (no slot) get the floor."""
+        (no slot) get the floor.  `scale` is the number of in-flight
+        dispatches sharing the device under depth-k pipelining: the
+        deadline budget covers the whole window on its OLDEST ticket
+        (scaling the p99 term, never the cold-compile floor)."""
         with self._cond:
             lat = sorted(slot.latencies) if slot is not None else []
         if lat:
             p99 = lat[min(len(lat) - 1, int(round(0.99 * (len(lat) - 1))))]
-            return max(self.watchdog_floor, self.watchdog_factor * p99)
+            return max(self.watchdog_floor,
+                       self.watchdog_factor * p99 * max(1, scale))
         return self.watchdog_floor
 
     def _guarded(self, slot: Optional[_BackendSlot], batch: _Batch, fn,
-                 kind: str = "chunk"):
+                 kind: str = "chunk", scale: int = 1):
         """Run one backend call under watchdog supervision.  The dispatch
         path only registers/deregisters a ticket (O(1) under the lock the
         scheduler already takes); deadline enforcement lives entirely on
         the watchdog thread."""
-        deadline = self._deadline_for(slot)
+        deadline = self._deadline_for(slot, scale)
         with self._cond:
             started = self.clock.monotonic()
             ticket = _Ticket(slot, batch, kind, started, started + deadline)
@@ -834,6 +953,7 @@ class VerifyService:
         cleared = None
         with self._cond:
             self._tickets.pop(id(ticket), None)
+            self._rebase_slot_tickets_locked(slot, self.clock.monotonic())
             cancelled = ticket.cancelled
             if err is None and not cancelled and kind == "chunk" \
                     and slot is not None and slot.state == STATE_SUSPECT:
@@ -848,7 +968,19 @@ class VerifyService:
             raise err
         return out
 
-    def _chunk_call(self, slot: Optional[_BackendSlot], batch: _Batch, fn):
+    def _rebase_slot_tickets_locked(self, slot, now: float) -> None:
+        """A slot ticket retired (success or trip): the survivors were
+        queued BEHIND it on the shared device, so their deadlines restart
+        from their own budget now that they can make progress.  Caller
+        holds the lock."""
+        if slot is None:
+            return
+        for t in self._tickets.values():
+            if t.slot is slot and not t.cancelled:
+                t.deadline_at = max(t.deadline_at, now + t.budget)
+
+    def _chunk_call(self, slot: Optional[_BackendSlot], batch: _Batch, fn,
+                    scale: int = 1):
         """One chunk dispatch with the failover ladder: first failure on
         the primary backend marks it suspect and retries ONCE; a second
         failure degrades the slot (atomic swap to the fallback) and
@@ -856,7 +988,7 @@ class VerifyService:
         backends (host, custom-without-fallback, or already-degraded)
         raise through — the caller contains the error to that chunk."""
         try:
-            return self._guarded(slot, batch, fn)
+            return self._guarded(slot, batch, fn, scale=scale)
         except _Abandoned:
             raise
         except BaseException:
@@ -866,7 +998,7 @@ class VerifyService:
             self._note_fault(slot)
             self._note_suspect(slot)
             try:
-                return self._guarded(slot, batch, fn)
+                return self._guarded(slot, batch, fn, scale=scale)
             except _Abandoned:
                 raise
             except BaseException as e2:
@@ -938,11 +1070,26 @@ class VerifyService:
                 if self._stopped and not self._tickets:
                     return
                 now = self.clock.monotonic()
+                # depth-k pipelining: tickets of the SAME slot share the
+                # device, so only the oldest ticket per slot is eligible
+                # to trip — its (scaled) deadline covers the whole
+                # in-flight window; younger tickets are re-judged once
+                # they become oldest.
+                oldest: Dict[int, _Ticket] = {}
+                for t in self._tickets.values():
+                    if t.slot is None:
+                        continue
+                    cur = oldest.get(id(t.slot))
+                    if cur is None or t.started < cur.started:
+                        oldest[id(t.slot)] = t
                 for tid, t in list(self._tickets.items()):
+                    if t.slot is not None and oldest.get(id(t.slot)) is not t:
+                        continue
                     if not t.cancelled and now >= t.deadline_at:
                         t.cancelled = True
                         del self._tickets[tid]
                         tripped.append(t)
+                        self._rebase_slot_tickets_locked(t.slot, now)
                 if not tripped:
                     # real-bounded poll so FakeClock advances are observed;
                     # idle (no tickets) polls more lazily
@@ -1159,14 +1306,27 @@ class VerifyService:
                                verify_fill_ratio)
         verify_dispatches.labels(lane).inc()
         verify_fill_ratio.observe(lanes / max(1, slots))
-        verify_dispatch_latency.labels(lane).observe(max(0.0, elapsed))
+        verify_dispatch_latency.labels(lane, "device").observe(
+            max(0.0, elapsed))
         with self._cond:
             self._dispatches += 1
             self._dispatch_lanes += lanes
             self._dispatch_slots += slots
+            self._device_time += max(0.0, elapsed)
             if slot is not None:
                 # the latency history the watchdog deadline derives from
                 slot.latencies.append(max(0.0, elapsed))
+
+    def _account_queue(self, lane: str, waited: float) -> None:
+        """The queue half of the dispatch-latency split: submit-to-gather
+        wait of a batch's oldest rider (coalescing window + lane
+        contention), distinct from device time so an occupancy regression
+        is observable, not inferred."""
+        from ..metrics import verify_dispatch_latency
+        verify_dispatch_latency.labels(lane, "queue").observe(
+            max(0.0, waited))
+        with self._cond:
+            self._queue_time += max(0.0, waited)
 
     def _stash_sample(self, slot: Optional[_BackendSlot], rounds, sigs,
                       prevs, results, lo: int) -> None:
@@ -1198,6 +1358,13 @@ class VerifyService:
                 # (bench config 6) instead of blending cold+warm runs
                 "dispatch_lanes": self._dispatch_lanes,
                 "dispatch_slots": self._dispatch_slots,
+                # occupancy observability (ISSUE 10): queue vs device time
+                # split and the deepest in-flight dispatch window seen
+                "queue_time_s": self._queue_time,
+                "device_time_s": self._device_time,
+                "inflight_depth_max": self._inflight_max,
+                "tuning": {s.label: {"pad": s.pad, "depth": s.depth}
+                           for s in self._slots.values()},
                 "queue_depth": {ln: len(self._queues[ln]) for ln in LANES},
                 "background_paused": self._bg_paused,
             }
@@ -1231,7 +1398,9 @@ class VerifyService:
         q = s["queue_depth"]
         line = (f"dispatches={s['dispatches']} requests={s['submitted']} "
                 f"fill={s['fill_ratio']:.2f} preempt={s['preemptions']} "
-                f"queue={q[LANE_LIVE]}/{q[LANE_BACKGROUND]}")
+                f"queue={q[LANE_LIVE]}/{q[LANE_BACKGROUND]} "
+                f"inflight<={s['inflight_depth_max']} "
+                f"qt/dt={s['queue_time_s']:.1f}/{s['device_time_s']:.1f}s")
         if s["failovers"] or s["watchdog_trips"]:
             line += (f" failovers={s['failovers']}"
                      f" trips={s['watchdog_trips']}")

@@ -391,6 +391,14 @@ class RestServer:
             }
         if svc is not None:
             payload["verify"] = svc.summary()
+            # occupancy observability (ISSUE 10): deepest in-flight
+            # dispatch window seen and the queue-vs-device latency split,
+            # so an occupancy regression is observable, not inferred
+            st = svc.stats()
+            payload["verify_inflight_depth"] = st["inflight_depth_max"]
+            payload["verify_latency_split"] = {
+                "queue_s": round(st["queue_time_s"], 3),
+                "device_s": round(st["device_time_s"], 3)}
             # the failure-domain degraded line: name every backend that is
             # currently failed over to the host path (or mid-probe) so an
             # operator scraping /health sees accelerator loss immediately

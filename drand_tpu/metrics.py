@@ -137,7 +137,15 @@ verify_fill_ratio = Histogram(
     registry=PRIVATE)
 verify_dispatch_latency = Histogram(
     "verify_service_dispatch_latency_seconds",
-    "Dispatch-to-verdict wall time per coalesced chunk", ["lane"],
+    "Verify-service latency split: phase=queue is submit-to-gather wait "
+    "(coalescing window + lane contention, per batch), phase=device is "
+    "dispatch-to-verdict wall time (per coalesced chunk) — occupancy "
+    "regressions show up as device-time growth, overload as queue growth",
+    ["lane", "phase"], registry=PRIVATE)
+verify_inflight = Gauge(
+    "verify_service_inflight_depth",
+    "Dispatches currently enqueued ahead of the resolve point in the "
+    "depth-k pipelined executor (0 when idle)",
     registry=PRIVATE)
 verify_preemptions = Counter(
     "verify_service_preemptions_total",

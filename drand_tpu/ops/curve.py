@@ -403,6 +403,84 @@ def scalars_to_bits(ks, nbits: int = 256) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Signed-digit GLV decompositions (host) for STRUCTURED scalars — the
+# Lagrange coefficients of batched tBLS recovery.  The RLC randomizers are
+# SAMPLED directly in split form (crypto/batch._device_rlc_bits), but a
+# Lagrange coefficient arrives as a full 255-bit value and must be
+# decomposed.  Digits are signed: the device side negates the base lane
+# where the sign mask is set, then runs one short joint ladder over all
+# lanes — sequential scan steps are what a pow/ladder costs (PERF.md), so
+# 4x shorter ladders are the whole point.
+# ---------------------------------------------------------------------------
+
+# G2: psi acts as [x] on G2 (g2_in_subgroup), so k = sum d_j x^j gives
+# [k]Q = sum [d_j] psi^j(Q).  Centered base-x digits of k in [0, r):
+# |d_j| <= |x|/2 for j<3 and |d_3| <= 2.5|x| after the residual fold
+# (|x| < 2^64), so 66 bits always suffice.  256 -> 66 sequential steps.
+GLV_G2_LANES = 4
+GLV_G2_NBITS = 66
+# G1: phi has eigenvalue lambda = -x^2 mod r; lattice basis v1 = (x^2, 1),
+# v2 = (x^2 - 1, x^2) with det = x^4 - x^2 + 1 = r, so Babai rounding gives
+# k = k0 + lambda*k1 with |ki| < ~x^2 ~= 2^127.6.  256 -> 130 steps.
+GLV_G1_LANES = 2
+GLV_G1_NBITS = 130
+
+
+def _signed_digit_bits(digs: np.ndarray, nbits: int):
+    """(lanes, n) object array of signed ints -> (bits (nbits, lanes, n)
+    MSB-first uint32, neg mask (lanes, n) uint32)."""
+    shape = digs.shape
+    flat = digs.reshape(-1)
+    nbytes = (nbits + 7) // 8
+    buf = np.empty((flat.size, nbytes), np.uint8)
+    neg = np.zeros(flat.size, np.uint32)
+    for i, d in enumerate(flat):
+        d = int(d)
+        if d < 0:
+            neg[i] = 1
+            d = -d
+        assert d < (1 << nbits), f"GLV digit overflows {nbits} bits"
+        buf[i] = np.frombuffer(d.to_bytes(nbytes, "big"), np.uint8)
+    bits = np.unpackbits(buf, axis=1)[:, -nbits:]
+    bits = np.ascontiguousarray(bits.T.astype(np.uint32))
+    return (jnp.asarray(bits.reshape((nbits,) + shape)),
+            jnp.asarray(neg.reshape(shape)))
+
+
+def glv_decompose_g2(ks):
+    """Host: scalars -> (bits (66, 4, n), neg (4, n)) with
+    k ≡ d0 + x·d1 + x²·d2 + x³·d3 (an EXACT integer identity after
+    reduction mod r, so [k]Q = Σ [d_j] ψ^j(Q) for Q in G2)."""
+    m = -BLS_X
+    n = len(ks)
+    digs = np.zeros((GLV_G2_LANES, n), dtype=object)
+    for i, k in enumerate(ks):
+        t = int(k) % ORDER_R
+        for j in range(GLV_G2_LANES):
+            q = -((2 * t + m) // (2 * m))     # nearest integer to t/x
+            digs[j][i] = t - BLS_X * q
+            t = q
+        digs[GLV_G2_LANES - 1][i] += BLS_X * t  # fold the residual
+    return _signed_digit_bits(digs, GLV_G2_NBITS)
+
+
+def glv_decompose_g1(ks):
+    """Host: scalars -> (bits (130, 2, n), neg (2, n)) with
+    k ≡ k0 + λ·k1 (mod r), λ = -x² the phi eigenvalue, so
+    [k]P = [k0]P + [k1]φ(P)."""
+    x2 = BLS_X * BLS_X
+    n = len(ks)
+    digs = np.zeros((GLV_G1_LANES, n), dtype=object)
+    for i, k in enumerate(ks):
+        k = int(k) % ORDER_R
+        c1 = (2 * k * x2 + ORDER_R) // (2 * ORDER_R)
+        c2 = -((2 * k + ORDER_R) // (2 * ORDER_R))
+        digs[0][i] = k - c1 * x2 - c2 * (x2 - 1)
+        digs[1][i] = -c1 - c2 * x2
+    return _signed_digit_bits(digs, GLV_G1_NBITS)
+
+
+# ---------------------------------------------------------------------------
 # Endomorphisms + fast subgroup checks (identities pinned in tests vs host)
 # ---------------------------------------------------------------------------
 
@@ -446,6 +524,24 @@ def g2_psi2(p):
 def g1_phi(p):
     X1, Y1, Z1 = p
     return (L.mont_mul(_BETA_DEV, X1), Y1, Z1)
+
+
+def _cat_lanes(*trees):
+    return jax.tree.map(lambda *ts: jnp.concatenate(ts, 0), *trees)
+
+
+def g2_psi_lanes(p):
+    """[P, ψP, ψ²P, ψ³P] concatenated along the leading batch axis — the
+    base-lane layout glv_decompose_g2's digit rows index (shared entry
+    point for the fused recover pipeline and any future ψ-split MSM)."""
+    p2 = g2_psi2(p)
+    return _cat_lanes(p, g2_psi(p), p2, g2_psi(p2))
+
+
+def g1_phi_lanes(p):
+    """[P, φP] concatenated along the leading batch axis (the
+    glv_decompose_g1 lane layout)."""
+    return _cat_lanes(p, g1_phi(p))
 
 
 def g1_glv_msm_terms(p, bits0, bits1):

@@ -1,0 +1,78 @@
+"""Seeded violations for the trace checker's sync-in-loop rule: device
+readback inside a per-chunk loop serializes the stream (one interconnect
+round trip per iteration).  Every BAD line must be caught; the negatives
+must stay silent."""
+
+import jax
+import numpy as np
+
+
+def _rlc_pipeline():
+    return lambda chunk: chunk
+
+
+def per_chunk_sync_loop(chunks, backend):
+    pipe = _rlc_pipeline()
+    out = []
+    for c in chunks:
+        verdict = pipe(c)
+        if bool(verdict):                       # BAD: sync per chunk
+            out.append(np.asarray(verdict))     # BAD: readback per chunk
+        jax.block_until_ready(verdict)          # BAD: explicit sync
+    return out
+
+
+def per_chunk_dispatch_loop(chunks, backend):
+    totals = []
+    while chunks:
+        d = backend.dispatch_packed(chunks.pop())
+        totals.append(float(d))                 # BAD: concretize per chunk
+        d.block_until_ready()                   # BAD: method sync per chunk
+    return totals
+
+
+def sync_once_after_stream(chunks, backend):
+    """Negative: ONE sync point after the loop is the async pattern."""
+    inflight = []
+    for c in chunks:
+        inflight.append(backend.dispatch_packed(c))
+    last = inflight[-1]
+    return bool(last)                           # outside the loop: fine
+
+
+def host_work_in_loop(chunks):
+    """Negative: host-side numpy in a loop is not a device sync."""
+    metas = []
+    for c in chunks:
+        n = len(c)
+        metas.append(np.asarray([n]))           # host data: fine
+    return metas
+
+
+def jitted_inner_is_not_host_code(chunks):
+    """Negative: a loop inside a nested JITTED function is traced device
+    code (compile-time), not a per-chunk host loop."""
+    def run(xs):
+        for x in xs:
+            jax.block_until_ready(x)
+        return xs
+    return jax.jit(run)
+
+
+def outer_with_nested_host_loop(backend, chunks):
+    """A nested HOST function's loop is flagged exactly once, attributed
+    to the inner function."""
+    def inner():
+        while chunks:
+            d = backend.dispatch_packed(chunks.pop())
+            jax.block_until_ready(d)            # BAD: once, in inner()
+    return inner
+
+
+def justified_bisection(chunks, backend):
+    """A justified per-chunk readback (failure localization) suppresses."""
+    for c in chunks:
+        v = backend.dispatch_packed(c)
+        if bool(v):  # tpu-vet: disable=trace  (bisection localizes per chunk)
+            return c
+    return None

@@ -5,6 +5,8 @@ Batch sizes stay at the minimum pad (8) so every test shares one compiled
 shape per pipeline kind.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -156,6 +158,136 @@ def test_verify_stream_chunks_and_localizes():
     assert got_rounds == list(range(1, n + 1))
     assert oks[13] is False or oks[13] == False  # noqa: E712
     assert sum(1 for o in oks if not o) == 1
+
+
+def test_verify_stream_depth_parity():
+    """ISSUE 10 acceptance (CPU-scale): depth-k pipelined streams produce
+    bit-identical verdicts to the depth-1 double buffer on the same
+    inputs.  Pad/chunk stay at 8 so this reuses the file's compiled G1
+    programs; DRAND_TPU_PARITY_PAD widens it for a warm-cache nightly
+    (the property is pad-independent — one compiled program per pad,
+    inert padding slots)."""
+    sch, sec, ver = _keyed_verifier("bls-unchained-on-g1")
+    pad = int(os.environ.get("DRAND_TPU_PARITY_PAD", "8"))
+    n = 3 * pad
+    msgs = [sch.digest_beacon(r, None) for r in range(1, n + 1)]
+    sigs = batch.sign_batch(sch, sec, msgs)
+    beacons = [Beacon(round=r, signature=s)
+               for r, s in zip(range(1, n + 1), sigs)]
+    beacons[pad + 1] = Beacon(round=pad + 2, signature=sigs[0])  # corrupt
+
+    def run(depth):
+        out = []
+        for _, ok in ver.verify_stream(iter(beacons), chunk_size=pad,
+                                       depth=depth):
+            out.extend(ok.tolist())
+        return out
+
+    base = run(1)
+    assert sum(1 for o in base if not o) == 1 and not base[pad + 1]
+    for depth in (2, 3):
+        assert run(depth) == base, f"depth {depth} diverged from depth 1"
+
+
+def test_pad_width_parity():
+    """Wider pads produce bit-identical verdicts: the same inputs through
+    pad_to=8 and pad_to=16 verifiers (the CPU-scale analogue of the
+    8192-vs-16384 sweep points; padding slots are inert by construction)."""
+    sch, sec, _ = _keyed_verifier("bls-unchained-on-g1")
+    beacons = _signed_chain(sch, sec, 12)
+    sigs = [b.signature for b in beacons]
+    sigs[7] = sigs[1]                       # valid point, wrong round
+    rounds = [b.round for b in beacons]
+    pub = sch.public_bytes(sch.keypair(seed=b"batch-test")[1])
+    narrow = batch.BatchBeaconVerifier(sch, pub, pad_to=8)
+    wide = batch.BatchBeaconVerifier(sch, pub, pad_to=16)
+    got_n = narrow.verify_batch(rounds, sigs)
+    got_w = wide.verify_batch(rounds, sigs)
+    assert (got_n == got_w).all()
+    assert not got_n[7] and got_n.sum() == 11
+
+
+def test_recover_batch_is_one_dispatch():
+    """ISSUE 10 acceptance: decompress + Lagrange recovery run as ONE
+    device dispatch per batch, asserted on the module dispatch counter
+    (CPU backend)."""
+    sch = scheme_from_name("bls-unchained-on-g1")
+    t, n = 3, 5
+    poly = tbls.PriPoly.random(t, secret=77)
+    shares = poly.shares(n)
+    msg = sch.digest_beacon(5, None)
+    partials = [[sch.sign(shares[i].value, msg) for i in (0, 1, 3)]]
+    batch.recover_batch(sch, [[0, 1, 3]], partials)     # warm/compile
+    before = batch.dispatch_count()
+    out = batch.recover_batch(sch, [[0, 1, 3]], partials)
+    assert batch.dispatch_count() - before == 1
+    # and the recovered signature is the collective one
+    pub_poly = poly.commit(sch.key_group)
+    host = tbls.recover(sch, pub_poly, msg,
+                        [tbls.sign_partial(sch, shares[i], msg)
+                         for i in (0, 1, 3)], t, n)
+    assert out == [host]
+
+
+def test_dispatch_packed_retry_after_donation():
+    """Review regression (PR 9): the verify service's failover ladder
+    re-invokes dispatch_packed ONCE after a transient fault — the retry
+    must rebuild the donated encoding from the retained host arrays, not
+    crash on the consumed buffer (which would turn every transient fault
+    into a premature host failover)."""
+    sch, sec, ver8 = _keyed_verifier("bls-unchained-on-g1")
+    pub = sch.public_bytes(sch.keypair(seed=b"batch-test")[1])
+    ver = batch.BatchBeaconVerifier(sch, pub, pad_to=8)
+    msgs = [sch.digest_beacon(r, None) for r in range(1, 4)]
+    sigs = batch.sign_batch(sch, sec, msgs)
+    packed = ver.pack_chunk([1, 2, 3], sigs)
+    orig = ver._rlc_dispatch
+    calls = {"n": 0}
+
+    def flaky(enc, n, donate=False):
+        calls["n"] += 1
+        assert enc is not None, "retry saw a consumed encoding"
+        if calls["n"] == 1:
+            raise ConnectionError("transient dispatch fault")
+        return orig(enc, n, donate=donate)
+
+    ver._rlc_dispatch = flaky
+    with pytest.raises(ConnectionError):
+        ver.dispatch_packed(packed)
+    verdict = ver.dispatch_packed(packed)      # the ladder's one retry
+    ok = ver.resolve_packed(packed, verdict)
+    assert ok.tolist() == [True, True, True]
+    assert calls["n"] == 2
+
+
+def test_recover_batch_rejects_bad_encodings():
+    """Host-detectable garbage raises before any device work; an x with
+    no y on the curve raises via the fused pipeline's device parse_ok."""
+    sch = scheme_from_name("bls-unchained-on-g1")
+    t, n = 2, 3
+    poly = tbls.PriPoly.random(t, secret=99)
+    shares = poly.shares(n)
+    msg = sch.digest_beacon(9, None)
+    good = [sch.sign(shares[i].value, msg) for i in (0, 1)]
+    # wrong length -> host wire parse
+    with pytest.raises(ValueError):
+        batch.recover_batch(sch, [[0, 1]], [[good[0], good[1][:-1]]])
+    # flip low x bits until the host decoder rejects (no y on curve),
+    # then the fused device path must reject the same bytes
+    from drand_tpu.crypto.host import serialize as HS
+    found = False
+    for tweak in range(1, 64):
+        cand = bytearray(good[1])
+        cand[-1] ^= tweak
+        try:
+            HS.g1_from_bytes(bytes(cand), check_subgroup=False)
+        except (ValueError, AssertionError):
+            found = True
+            with pytest.raises(ValueError):
+                batch.recover_batch(sch, [[0, 1]],
+                                    [[good[0], bytes(cand)]])
+            break
+    assert found, "no non-decompressable tweak found in 64 tries"
 
 
 def test_verify_service_device_end_to_end():

@@ -141,6 +141,10 @@ def test_health_includes_verify_service_summary(served):
             body = json.loads(e.read())
         assert "verify" in body
         assert "dispatches=" in body["verify"]
+        # occupancy observability (ISSUE 10): inflight depth + the
+        # queue-vs-device latency split ride along
+        assert body["verify_inflight_depth"] == 0
+        assert set(body["verify_latency_split"]) == {"queue_s", "device_s"}
     finally:
         set_service(old)
         svc.stop()

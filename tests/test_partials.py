@@ -54,3 +54,34 @@ def test_verify_partials_empty():
     sch, shares, pp, bv = _setup("bls-unchained-on-g1")
     assert bv.verify_partials([], []).shape == (0, 0)
     assert bv.verify_partials([b"x"], [[]]).shape == (1, 0)
+
+
+@pytest.mark.parametrize("scheme_id", ["bls-unchained-on-g1",
+                                       "pedersen-bls-unchained"])
+def test_verify_partials_non_decompressable_slot_localized(scheme_id):
+    """ISSUE 10: the fast path decompresses ON DEVICE (the fused
+    sqrt_ratio front end), so an x with no y on the curve is caught by
+    the device parse_ok and localized by the exact fallback — matching
+    the host golden decoder slot for slot."""
+    sch, shares, pp, bv = _setup(scheme_id)
+    msgs = [sch.digest_beacon(r, None) for r in (1, 2)]
+    rows = [[tbls.sign_partial(sch, shares[i], m) for i in (0, 1)]
+            for m in msgs]
+    import drand_tpu.crypto.host.serialize as HS
+    dec = HS.g2_from_bytes if sch.sig_group.point_len == 96 \
+        else HS.g1_from_bytes
+    found = False
+    for tweak in range(1, 64):
+        cand = bytearray(rows[0][1])
+        cand[-1] ^= tweak                   # low x bits, index untouched
+        try:
+            dec(bytes(cand[2:]), check_subgroup=False)
+        except (ValueError, AssertionError):
+            found = True
+            break
+    assert found, "no non-decompressable tweak found"
+    rows2 = [[rows[0][0], bytes(cand)], rows[1]]
+    got = bv.verify_partials(msgs, rows2)
+    assert got.tolist() == [[True, False], [True, True]]
+    # host golden agrees the tweaked partial is invalid
+    assert not tbls.verify_partial(sch, pp, msgs[0], bytes(cand))

@@ -150,6 +150,37 @@ def test_trace_checker_catches_fixture():
                 if f.path == "ops/trace_bad.py"]) == 1
 
 
+def test_trace_sync_in_loop_catches_fixture():
+    """ISSUE 10 satellite: synchronous device readback inside a per-chunk
+    loop in crypto/ hot paths — the exact class the depth-k pipelined
+    executor exists to remove."""
+    report = _fixture_report("trace")
+    sync = [f for f in report.findings
+            if f.path == "crypto/sync_bad.py"]
+    assert sync and all(f.code == "trace-sync-in-loop" for f in sync)
+    # bool / np.asarray / jax.block_until_ready in the for loop, float /
+    # .block_until_ready in the while loop, and the nested host loop —
+    # all six seeded, each exactly ONCE (no double report through the
+    # enclosing function)
+    assert len(sync) == len({f.line for f in sync}) == 6, \
+        sorted(f.line for f in sync)
+    msgs = [f.message for f in sync]
+    assert any("bool()" in m for m in msgs)
+    assert any("asarray()" in m for m in msgs)
+    assert any(".block_until_ready()" in m for m in msgs)
+    assert any("float()" in m for m in msgs)
+    # the nested host loop is attributed to the INNER function
+    assert any("inner()" in m for m in msgs)
+    # negatives: sync after the stream, host numpy in a loop, and a loop
+    # inside a nested JITTED function (traced device code)
+    assert not any("sync_once_after_stream" in m for m in msgs)
+    assert not any("host_work_in_loop" in m for m in msgs)
+    assert not any("jitted_inner" in m or "run()" in m for m in msgs)
+    # the justified per-chunk bisection readback is a suppression
+    assert len([f for f in report.suppressed
+                if f.path == "crypto/sync_bad.py"]) == 1
+
+
 def test_store_checker_catches_fixture():
     report = _fixture_report("store")
     codes = _codes(report, "store_bad.py")

@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Lane-width x pipeline-depth autotune sweep (ISSUE 10, ROADMAP item 2).
+
+Sequential scan stages (the 758-step E2 pow, the GLV ladders) cost per
+STEP, not per lane, so wider pads amortize them — and a depth-k dispatch
+window amortizes the ~74 ms/dispatch RPC latency.  Which (pad, depth)
+point wins depends on the accelerator, so it is MEASURED, not assumed:
+this tool sweeps pad x depth per scheme kind on the current backend,
+streams a signed fixture through `BatchBeaconVerifier.verify_stream`,
+and persists the winner to TUNING.json — which the resident verify
+service consults at handle creation (crypto/tuning.py; env overrides
+win; a container with no chip and no tuning file is unchanged).
+
+    python tools/autotune.py                      # full sweep -> TUNING.json
+    python tools/autotune.py --pads 8192,16384,32768 --depths 1,2,4
+    python tools/autotune.py --selftest           # tiny CPU sweep into a
+                                                  # temp file + proof the
+                                                  # service consults it
+
+The full sweep is sized for a chip round (pad 32768 x G2 is hours of
+compile on a cold CPU cache); the driver runs it once per chip round,
+after bench.py has pre-warmed the compilation cache.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/drand_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+KIND_SCHEMES = {"g1": "bls-unchained-on-g1", "g2": "pedersen-bls-unchained"}
+
+
+def _fixture(kind, n):
+    """n device-signed unchained beacons for the kind's scheme."""
+    from drand_tpu.chain.beacon import Beacon
+    from drand_tpu.crypto import batch, schemes
+
+    sch = schemes.scheme_from_name(KIND_SCHEMES[kind])
+    sec, pub = sch.keypair(seed=b"autotune-" + kind.encode())
+    msgs = [sch.digest_beacon(r, None) for r in range(1, n + 1)]
+    sigs = batch.sign_batch(sch, sec, msgs)
+    beacons = [Beacon(round=r, signature=s)
+               for r, s in zip(range(1, n + 1), sigs)]
+    return sch, sch.public_bytes(pub), beacons
+
+
+def _measure(sch, pub, beacons, pad, depth):
+    """Warm rounds/s of one streamed pass at (pad, depth)."""
+    from drand_tpu.crypto import batch
+
+    ver = batch.BatchBeaconVerifier(sch, pub, pad_to=pad)
+
+    def replay():
+        n = 0
+        for rounds, ok in ver.verify_stream(iter(beacons), chunk_size=pad,
+                                            depth=depth):
+            assert ok.all(), "autotune fixture failed verification"
+            n += len(rounds)
+        return n
+
+    replay()                                  # cold: compile/cache-load
+    t0 = time.perf_counter()
+    n = replay()
+    dt = time.perf_counter() - t0
+    return n / dt, ver.pipeline_depth(depth, pad)
+
+
+def sweep(kinds, pads, depths, n, progress=lambda m: None):
+    """-> (winners {kind: entry}, rows [sweep table])."""
+    rows = []
+    winners = {}
+    for kind in kinds:
+        nn = max(n, 2 * max(pads))            # >= 2 chunks at the widest pad
+        progress(f"fixture {kind}: signing {nn} rounds")
+        sch, pub, beacons = _fixture(kind, nn)
+        best = None
+        for pad in pads:
+            for depth in depths:
+                progress(f"{kind} pad={pad} depth={depth}")
+                rps, eff_depth = _measure(sch, pub, beacons, pad, depth)
+                row = {"kind": kind, "pad": pad, "depth": depth,
+                       "effective_depth": eff_depth,
+                       "rounds_per_s": round(rps, 1)}
+                rows.append(row)
+                progress(f"{kind} pad={pad} depth={depth}: {rps:.1f} r/s")
+                if best is None or rps > best["rounds_per_s"]:
+                    best = row
+        winners[kind] = {"pad": best["pad"], "depth": best["depth"],
+                         "rounds_per_s": best["rounds_per_s"]}
+    return winners, rows
+
+
+def _selftest(args):
+    """Tiny CPU-scale sweep into a temp TUNING.json, then prove the
+    verify service CONSULTS it: a fresh service (pad=0 auto) must resolve
+    the written winner for a new handle (the ISSUE acceptance)."""
+    import jax
+
+    from drand_tpu.crypto import schemes, tuning
+    from drand_tpu.crypto.verify_service import VerifyService
+
+    # explicit env overrides would (correctly) beat the file — clear them
+    # so the selftest exercises the TUNING.json leg of the precedence
+    for var in ("DRAND_VERIFY_PAD", "DRAND_VERIFY_PIPELINE_DEPTH"):
+        os.environ.pop(var, None)
+    out = args.out or os.path.join(
+        tempfile.mkdtemp(prefix="drand_tpu_autotune_"), "TUNING.json")
+    platform = jax.default_backend()
+    winners, rows = sweep(["g1"], [32, 64], [1, 2], 128,
+                          progress=lambda m: print(f"# {m}", file=sys.stderr,
+                                                   flush=True))
+    tuning.write_tuning(out, platform, winners)
+    os.environ["DRAND_TUNING_FILE"] = out
+
+    sch = schemes.scheme_from_name(KIND_SCHEMES["g1"])
+    _, pub = sch.keypair(seed=b"autotune-consult")
+    svc = VerifyService(pad=0)                # AUTO: must consult the file
+    try:
+        h = svc.handle(sch, sch.public_bytes(pub), device=True)
+        got = next(iter(svc.stats()["tuning"].values()))
+        want = winners["g1"]
+        consulted = (got["pad"] == want["pad"]
+                     and got["depth"] == want["depth"]
+                     and getattr(h.backend, "pad_to", None) == want["pad"])
+        report = {"ok": bool(consulted), "platform": platform,
+                  "tuning_file": out, "winner": want, "consulted": got,
+                  "sweep": rows}
+        print(json.dumps(report), flush=True)
+        return 0 if consulted else 1
+    finally:
+        svc.stop()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--pads", default="8192,16384,32768")
+    ap.add_argument("--depths", default="1,2,4")
+    ap.add_argument("--kinds", default="g1,g2")
+    ap.add_argument("--n", type=int, default=0,
+                    help="fixture rounds (default: 2x the widest pad)")
+    ap.add_argument("--out", default=None,
+                    help="TUNING.json path (default: repo root; selftest: "
+                         "a fresh temp file)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="tiny CPU sweep + proof the service consults "
+                         "the result (exit 0/1)")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest(args)
+
+    import jax
+    platform = jax.default_backend()
+    pads = [int(x) for x in args.pads.split(",") if x.strip()]
+    depths = [int(x) for x in args.depths.split(",") if x.strip()]
+    kinds = [k.strip() for k in args.kinds.split(",") if k.strip()]
+    for k in kinds:
+        if k not in KIND_SCHEMES:
+            ap.error(f"unknown kind {k!r} (have {sorted(KIND_SCHEMES)})")
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "TUNING.json")
+    winners, rows = sweep(kinds, pads, depths, args.n,
+                          progress=lambda m: print(f"# {m}", file=sys.stderr,
+                                                   flush=True))
+    from drand_tpu.crypto import tuning
+    tuning.write_tuning(out, platform, winners)
+    print(json.dumps({"ok": True, "platform": platform, "out": out,
+                      "winners": winners, "sweep": rows}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
