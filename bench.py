@@ -36,6 +36,14 @@ Configs (BASELINE.json north_star):
                        merges 4 submissions per PAD-lane dispatch
                        (dispatch counter recorded in stats), double
                        buffering via the service's pipelined executor
+  7. multidevice_scaleout (ISSUE 11): one chain per device group served
+                       CONCURRENTLY through per-group dispatch streams
+                       (per-group throughput recorded), then one huge
+                       batch round-axis-sharded across the FULL pool;
+                       n_devices/group_map land in the JSON (on a
+                       1-device chip this degenerates to one group +
+                       an unsharded huge batch — still measured, never
+                       marked degraded for that)
 
 Compiled-program economy: every verifier pads to PAD=8192 (pad_to), so
 the whole bench needs exactly five on-chip programs — G1-RLC@8192 in
@@ -72,6 +80,10 @@ N_CHAINED = int(os.environ.get("DRAND_TPU_BENCH_N_CHAINED", "1024"))
 N_PARTIAL_ROUNDS = int(os.environ.get("DRAND_TPU_BENCH_N_PARTIALS", "10240"))
 PARTIAL_CHUNK = int(os.environ.get("DRAND_TPU_BENCH_PARTIAL_CHUNK", "2048"))
 N_MIXED = int(os.environ.get("DRAND_TPU_BENCH_N_MIXED", "4096"))
+# config 7: rounds per chain (2 pad-chunks each) and how many chains at
+# most — one per device group, capped so fixture signing stays bounded
+N_MD = int(os.environ.get("DRAND_TPU_BENCH_N_MD", str(2 * PAD)))
+MD_MAX_CHAINS = int(os.environ.get("DRAND_TPU_BENCH_MD_CHAINS", "4"))
 CHUNK = int(os.environ.get("DRAND_TPU_BENCH_CHUNK", str(PAD)))
 
 
@@ -84,13 +96,13 @@ def _progress(msg):
 
 
 def _configs():
-    raw = os.environ.get("DRAND_TPU_BENCH_CONFIGS", "1,2,3,4,5,6")
+    raw = os.environ.get("DRAND_TPU_BENCH_CONFIGS", "1,2,3,4,5,6,7")
     out = set()
     for x in raw.split(","):
         x = x.strip()
-        if x.isdigit() and 1 <= int(x) <= 6:
+        if x.isdigit() and 1 <= int(x) <= 7:
             out.add(int(x))
-    return out or {1, 2, 3, 4, 5, 6}
+    return out or {1, 2, 3, 4, 5, 6, 7}
 
 
 def _jax_setup():
@@ -439,6 +451,131 @@ def bench_coalesced_service(stats):
         svc.stop()
 
 
+def bench_multidevice_scaleout(stats):
+    """Config 7 (ISSUE 11): the device pool on the serving path.  One
+    chain per device group, submitted CONCURRENTLY through the service's
+    per-group dispatch streams (per-group throughput + the concurrency
+    proof recorded), then one huge batch whose single submission crosses
+    the shard threshold and round-axis-shards across the FULL pool.  On
+    a 1-device chip the pool degenerates to one group and the huge batch
+    runs unsharded — still measured, and NOT a degraded run."""
+    import threading
+
+    from drand_tpu.crypto import schemes
+    from drand_tpu.crypto.verify_service import VerifyService
+
+    # AUTO shard threshold (pad x max(2, n_devices)): the per-group
+    # replays below submit half-fixture spans that stay UNDER it, the
+    # huge batch is sized exactly AT it — so the sharded dispatch is a
+    # full pool-wide chunk, not mostly pad slots.  The watchdog floor is
+    # raised to compile scale — config 7's group- and pool-pinned
+    # programs are FRESH compile flavors (placement lands in the
+    # executable cache key), and a cold compile tripping the watchdog
+    # would silently turn this into a host measurement (the backend
+    # self-report below would catch it, but the bench should measure
+    # the device, not the failover)
+    svc = VerifyService(pad=PAD, background_window=0.0,
+                        watchdog_floor=3600.0)
+    try:
+        n_groups = 1
+        chains = []
+        for i in range(MD_MAX_CHAINS):
+            sch, pub, store = _unchained_store(
+                schemes.SHORT_SIG_SCHEME_ID, N_MD,
+                f"md-{i}".encode(), f"md{i}")
+            handle = svc.handle(sch, pub)
+            if i == 0:
+                n_groups = svc.stats()["n_groups"]
+            chains.append((handle, store))
+            if len(chains) >= n_groups:
+                break       # one chain per group is the point
+        _progress(f"multidevice fixtures ready: {len(chains)} chains "
+                  f"over {n_groups} groups")
+
+        def replay(handle, store, n_rounds=N_MD, split=2):
+            """One replay of `n_rounds`.  The per-group phases submit in
+            `split` under-threshold spans so they measure the GROUP
+            stream; the huge-batch phase submits ONE threshold-sized
+            span (split=1), deliberately crossing into the pool-wide
+            sharded path."""
+            rounds = list(range(1, n_rounds + 1))
+            sigs = [store.get(r).signature for r in rounds]
+            step = (n_rounds + split - 1) // split
+            futs = [handle.submit(rounds[lo:lo + step], sigs[lo:lo + step],
+                                  lane="live", flush_now=True)
+                    for lo in range(0, n_rounds, step)]
+            n = 0
+            for f in futs:
+                ok = f.result()
+                assert ok.all()
+                n += len(ok)
+            return n
+
+        for handle, store in chains:        # warm/compile, serial
+            replay(handle, store)
+        _progress("multidevice warm; timing concurrent per-group replay")
+        per_group = {}
+        errs = []
+
+        def worker(handle, store):
+            try:
+                t0 = time.perf_counter()
+                n = replay(handle, store)
+                per_group[svc._slots[handle.key].label] = round(
+                    n / (time.perf_counter() - t0), 1)
+            except Exception as e:          # surfaced after join
+                errs.append(e)
+
+        before = svc.stats()
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker, args=c) for c in chains]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+        st = svc.stats()
+        total = len(chains) * N_MD / dt
+
+        # the huge-batch half: ONE submission sized pad x max(2,
+        # n_devices) — exactly the AUTO shard threshold, i.e. one FULL
+        # pool-wide chunk, so the sharded number measures the pool and
+        # not pad slots.  (pool_sharding permitting; 1 device =
+        # unsharded, recorded.)  The fixture store extends on demand.
+        h0, _ = chains[0]
+        huge_n = PAD * max(2, st["n_devices"])
+        _, _, store0 = _unchained_store(
+            schemes.SHORT_SIG_SCHEME_ID, huge_n, b"md-0", "md0")
+        _progress(f"multidevice huge batch: {huge_n} rounds")
+        replay(h0, store0, huge_n, split=1)     # warm the pool program
+        t0 = time.perf_counter()
+        n = replay(h0, store0, huge_n, split=1)
+        sharded_dt = time.perf_counter() - t0
+        st2 = svc.stats()
+        stats["multidevice_huge_n"] = huge_n
+        stats["multidevice_n_devices"] = st2["n_devices"]
+        stats["multidevice_n_groups"] = st2["n_groups"]
+        stats["multidevice_group_map"] = st2["group_map"]
+        stats["multidevice_per_group_rps"] = per_group
+        stats["multidevice_concurrent_streams"] = \
+            st2["concurrent_streams_max"]
+        stats["multidevice_sharded"] = \
+            st2["sharded_dispatches"] > st["sharded_dispatches"]
+        stats["multidevice_sharded_rps"] = round(n / sharded_dt, 1)
+        stats["multidevice_migrations"] = st2["migrations"]
+        # self-report the serving backend like config 6: a mid-run
+        # failover means these are HOST numbers
+        stats["multidevice_scaleout_backend"] = (
+            "host_fallback" if st2["failovers"] > before["failovers"]
+            or "degraded" in st2["backends"].values()
+            or "probing" in st2["backends"].values() else "device")
+        return total
+    finally:
+        svc.stop()
+
+
 _RUNNERS = {
     1: "chained_catchup",
     2: "unchained_resident",
@@ -446,11 +583,12 @@ _RUNNERS = {
     4: "mixed_4chains",
     5: "streamed_store",
     6: "coalesced_service",
+    7: "multidevice_scaleout",
 }
-# Order: config 2 compiles/loads the shared G1@PAD program that 5, 6, 3
-# and 4 reuse; G2 (1, then 4) go after the G1 family so a G2 compile
+# Order: config 2 compiles/loads the shared G1@PAD program that 5, 6, 7,
+# 3 and 4 reuse; G2 (1, then 4) go after the G1 family so a G2 compile
 # overrun cannot starve the G1 numbers.
-_ORDER = [2, 5, 6, 3, 1, 4]
+_ORDER = [2, 5, 6, 7, 3, 1, 4]
 
 
 def _child(indices):
@@ -466,6 +604,7 @@ def _child(indices):
             4: bench_mixed_4chains,
             5: lambda: bench_streamed_store(stats),
             6: lambda: bench_coalesced_service(stats),
+            7: lambda: bench_multidevice_scaleout(stats),
         }
         t0 = time.monotonic()
         try:
@@ -534,6 +673,7 @@ def _emit(configs, stats):
               "partials_recover": N_PARTIAL_ROUNDS,
               "mixed_4chains": N_CHAINED + 3 * N_MIXED,
               "coalesced_service": N_STREAM,
+              "multidevice_scaleout": N_MD,
               **stats},
     }
     print(json.dumps(out), flush=True)

@@ -61,6 +61,15 @@ class Config:
     verify_pad: int = 0
     verify_pipeline_depth: int = 0
     verify_window: float = 0.02
+    # multi-device scale-out (crypto/device_pool.py): the visible devices
+    # partition into this many groups, each with its own dispatch stream
+    # and chain→device handle affinity; 0 = AUTO (DRAND_VERIFY_DEVICE_
+    # GROUPS env, else one group per device).  Single submissions of at
+    # least verify_shard_threshold rounds shard over the FULL pool's
+    # persistent round-axis mesh instead of one group; 0 = AUTO
+    # (DRAND_VERIFY_SHARD_THRESHOLD env, else pad x max(2, n_devices)).
+    verify_device_groups: int = 0
+    verify_shard_threshold: int = 0
     # device failure domain (verify_service watchdog/failover/probe):
     # watchdog deadline = max(floor, factor * observed p99 dispatch
     # latency); the probe interval rate-limits the canary that re-promotes
@@ -141,7 +150,9 @@ class Config:
                 background_window=self.verify_window,
                 watchdog_factor=self.verify_watchdog_factor or None,
                 probe_interval=self.verify_probe_interval or None,
-                pipeline_depth=self.verify_pipeline_depth)
+                pipeline_depth=self.verify_pipeline_depth,
+                device_groups=self.verify_device_groups,
+                shard_threshold=self.verify_shard_threshold)
             # a service created while the admission ladder already has
             # background work paused must start paused, not race a level
             # change it never saw

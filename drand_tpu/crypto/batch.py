@@ -433,7 +433,7 @@ class BatchBeaconVerifier:
     kind = "device"  # metrics label for integrity scans (chain/integrity.py)
 
     def __init__(self, scheme: Scheme, public_key_bytes: bytes,
-                 pad_to: int | None = None, sharding=None):
+                 pad_to: int | None = None, sharding=None, devices=None):
         self.scheme = scheme
         self.g2sig = scheme.sig_group is GroupG2
         # pad_to: optional canonical batch width.  Batches pad UP to it so
@@ -441,11 +441,19 @@ class BatchBeaconVerifier:
         # pads every config to 8192: compile count is the scarce resource
         # on-chip, and pad slots cost ~linear device time but zero compiles)
         self.pad_to = pad_to
-        # sharding: optional persistent NamedSharding over the round axis,
-        # owned by the caller (the verify service builds ONE mesh for all
-        # backends); None falls back to a per-dispatch mesh when more than
-        # one device is visible
+        # sharding: optional persistent placement over the round axis,
+        # owned by the caller (the verify service's device pool builds ONE
+        # mesh per scope); devices: an explicit device group this verifier
+        # is pinned to (crypto/device_pool.py) — its placement is built
+        # once and cached.  With neither, a multi-device host gets a
+        # cached all-device mesh (built on FIRST dispatch, not per
+        # dispatch — the per-dispatch Mesh construction was pure overhead
+        # on every multi-device dispatch).
         self.sharding = sharding
+        self.devices = list(devices) if devices is not None else None
+        self._cached_sharding = None
+        self._sharding_built = False
+        self._pin_sharding = None
         self.pub_point = scheme.key_group.from_bytes(public_key_bytes)
         if self.g2sig:
             self.pk_aff = (L.encode_mont(self.pub_point[0]), L.encode_mont(self.pub_point[1]))
@@ -518,24 +526,56 @@ class BatchBeaconVerifier:
     # pairing program compiles far slower and tiny shards leave devices idle
     SHARD_MIN_PAD = 512
 
-    def _shard_round_axis(self, enc):
-        """Shard the round/batch axis over every visible device (the DP/SP
-        axis of this domain, SURVEY.md §5.7).  XLA inserts the collectives
-        for the cross-shard point-sum reduction; single-device runs are
-        unchanged (no-op sharding).  The randomizer bits are generated
-        inside the pipeline (on device) and inherit their sharding from
-        propagation."""
-        devs = jax.devices()
-        pad = self._leaf_len(enc)
-        if len(devs) < 2 or pad < self.SHARD_MIN_PAD \
-                or pad % len(devs) != 0:
-            return enc
+    def _placement(self):
+        """The persistent round-axis placement for this verifier, built
+        ONCE and cached (via device_pool.build_round_sharding — the one
+        construction site): the injected service sharding wins; an
+        explicit device group (crypto/device_pool.py) pins to its
+        devices; otherwise a multi-device host gets one cached
+        all-device mesh.  None = no placement (single visible device,
+        nothing to pin)."""
         if self.sharding is not None:
-            sh = self.sharding      # service-owned persistent mesh
-        else:
-            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-            mesh = Mesh(np.array(devs), ("round",))
-            sh = NamedSharding(mesh, P("round"))
+            return self.sharding
+        if self._sharding_built:
+            return self._cached_sharding
+        from .device_pool import build_round_sharding, jax_devices
+        devs = self.devices
+        if devs is None:
+            devs = jax_devices()
+            if len(devs) < 2:
+                devs = []       # default device; placement buys nothing
+        self._cached_sharding = build_round_sharding(devs)
+        self._sharding_built = True
+        return self._cached_sharding
+
+    def _pin_fallback(self, sh):
+        """A multi-device sharding whose batch cannot be split cleanly
+        still has to stay on ITS devices: pin to one of them (lowest id,
+        deterministic) rather than fall back to the process default
+        device — that would dump another group's work onto device 0 and
+        break group isolation.  Cached per verifier."""
+        if self._pin_sharding is None:
+            from jax.sharding import SingleDeviceSharding
+            dev = min(sh.device_set, key=lambda d: d.id)
+            self._pin_sharding = SingleDeviceSharding(dev)
+        return self._pin_sharding
+
+    def _shard_round_axis(self, enc):
+        """Place/shard the round axis per the cached `_placement` (the
+        DP/SP axis of this domain, SURVEY.md §5.7).  XLA inserts the
+        collectives for the cross-shard point-sum reduction; single-device
+        placements just pin the group's device, and no-placement runs are
+        unchanged.  The randomizer bits are generated inside the pipeline
+        (on device) and inherit their sharding from propagation."""
+        sh = self._placement()
+        if sh is None:
+            return enc
+        nsh = len(sh.device_set)
+        pad = self._leaf_len(enc)
+        if nsh > 1 and (pad < self.SHARD_MIN_PAD or pad % nsh != 0):
+            # tiny/indivisible batches don't split — but they must still
+            # run on this verifier's own devices, not the default one
+            sh = self._pin_fallback(sh)
 
         def put(t):
             return jax.device_put(t, sh) if t.shape[0] == pad else t

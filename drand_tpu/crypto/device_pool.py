@@ -1,0 +1,271 @@
+"""Device pool: inventory, per-handle device groups, and the pool-wide
+round-axis sharding (ISSUE 11, ROADMAP item 2 serving path).
+
+Everything the verify plane served before this module ran on ONE device
+while `__graft_entry__.dryrun_multichip` proved an 8-device mesh is
+placeable.  The pool turns that hardware multiplier into two serving-path
+shapes:
+
+  * **Per-handle device groups.**  The visible devices are partitioned
+    into `n_groups` groups (`Config.verify_device_groups` /
+    `DRAND_VERIFY_DEVICE_GROUPS`; 0 = AUTO, one group per device) and
+    every `VerifyService` handle is assigned one — sticky chain→device
+    affinity, least-loaded at assignment, so k chips run k concurrent
+    depth-k dispatch windows instead of sharing one stream.  A group
+    whose device faults is marked and new work avoids it; its handles
+    fail over to a healthy sibling group before falling to host.
+  * **Pool-wide round-axis sharding.**  One persistent Mesh/NamedSharding
+    over ALL devices for huge batches (catch-up sync, integrity scans,
+    strict-walk sweeps) — the maxtext-style data-axis shape from the
+    SNIPPETS.md pjit/mesh exemplars, built once and reused forever.
+
+This module is the ONLY place in the package allowed to call
+`jax.devices()` / `jax.local_devices()` (tpu-vet `verifier` checker):
+device enumeration blocks in native code while holding jax's global
+client lock when an accelerator tunnel is down (drand_tpu/accel.py), so
+every consumer must share this one call site — and the pool caches the
+inventory, so the hang window is paid at most once per process.
+
+jax is imported lazily; with no jax at all the pool degenerates to one
+deviceless group, so the host-fallback paths keep their stream without
+touching an accelerator stack.
+"""
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_GROUPS = int(os.environ.get("DRAND_VERIFY_DEVICE_GROUPS", "0"))
+
+GROUP_HEALTHY = "healthy"
+GROUP_FAULTED = "faulted"
+GROUP_PROBING = "probing"
+
+_inventory_lock = threading.Lock()
+_inventory: Optional[list] = None
+
+
+def jax_devices() -> list:
+    """The sanctioned device-enumeration call site (cached for the
+    process: `jax.devices()` is stable after backend init, and re-calling
+    it re-risks the tunnel-down hang).  [] when jax is unavailable."""
+    global _inventory
+    with _inventory_lock:
+        if _inventory is not None:
+            return list(_inventory)
+    try:
+        import jax
+        devs = list(jax.devices())
+    except Exception:
+        # a TRANSIENT enumeration failure (backend init raced, tunnel
+        # flap) must not be cached as "no devices" for the process
+        # lifetime — return empty but leave the cache unset so the next
+        # caller retries
+        return []
+    with _inventory_lock:
+        if _inventory is None:
+            _inventory = devs
+        return list(_inventory)
+
+
+def _reset_inventory_for_tests(devices=None) -> None:
+    """Test hook: override (or clear) the cached inventory."""
+    global _inventory
+    with _inventory_lock:
+        _inventory = list(devices) if devices is not None else None
+
+
+def build_round_sharding(devices):
+    """The one place the round-axis placement is constructed: None for
+    no devices (nothing to pin), `SingleDeviceSharding` for one, a
+    round-axis `Mesh`/`NamedSharding` for several.  Group shardings,
+    the pool-wide mesh and `BatchBeaconVerifier._placement` all build
+    through here so the axis name and single-vs-multi rules cannot
+    drift apart."""
+    devices = list(devices)
+    if not devices:
+        return None
+    if len(devices) == 1:
+        from jax.sharding import SingleDeviceSharding
+        return SingleDeviceSharding(devices[0])
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    return NamedSharding(Mesh(np.array(devices), ("round",)),
+                         PartitionSpec("round"))
+
+
+class DeviceGroup:
+    """One failure/dispatch domain: a slice of the device inventory with
+    a lazily-built persistent placement (SingleDeviceSharding for one
+    device, NamedSharding over a round-axis mesh for several, None for a
+    deviceless host group)."""
+
+    __slots__ = ("gid", "devices", "state", "faulted_at", "probe_backend",
+                 "probe_sample", "_sharding", "_sharding_built")
+
+    def __init__(self, gid: int, devices: list):
+        self.gid = gid
+        self.devices = list(devices)
+        self.state = GROUP_HEALTHY
+        self.faulted_at: Optional[float] = None
+        # the canary context stashed when the group faults: the backend
+        # that was serving on it and its last known-good 1-lane sample
+        self.probe_backend = None
+        self.probe_sample = None
+        self._sharding = None
+        self._sharding_built = False
+
+    @property
+    def n_devices(self) -> int:
+        return len(self.devices)
+
+    def sharding(self):
+        """Persistent placement for this group, built once (the
+        per-dispatch mesh construction this PR retires was pure
+        overhead)."""
+        if self._sharding_built:
+            return self._sharding
+        self._sharding = build_round_sharding(self.devices)
+        self._sharding_built = True
+        return self._sharding
+
+    def __repr__(self):
+        return (f"DeviceGroup(gid={self.gid}, devices={self.n_devices}, "
+                f"state={self.state})")
+
+
+class DevicePool:
+    """Owns the device inventory and the handle→group assignment map.
+
+    Assignment is sticky (chain→device affinity: a chain's compiled
+    programs live on its group's devices) and least-loaded among HEALTHY
+    groups at creation time; `release` drops an assignment so handle
+    churn rebalances — the next assignment fills the emptied group.
+    """
+
+    def __init__(self, n_groups: int = 0, devices: Optional[list] = None):
+        devs = list(devices) if devices is not None else jax_devices()
+        want = int(n_groups) if n_groups and int(n_groups) > 0 \
+            else (DEFAULT_GROUPS or 0)
+        if want <= 0:
+            want = max(1, len(devs))        # AUTO: one group per device
+        want = max(1, min(want, max(1, len(devs))))
+        self.groups: List[DeviceGroup] = []
+        if devs:
+            base, extra = divmod(len(devs), want)
+            lo = 0
+            for g in range(want):
+                hi = lo + base + (1 if g < extra else 0)
+                self.groups.append(DeviceGroup(g, devs[lo:hi]))
+                lo = hi
+        else:
+            self.groups.append(DeviceGroup(0, []))  # deviceless host group
+        self._devices = devs
+        self._assignments: Dict[Tuple, int] = {}
+        # keys whose handles never dispatch on the group's devices (host
+        # fallback handles): they keep a stream affinity but must not
+        # weigh on the least-loaded placement of real device chains
+        self._weightless: set = set()
+        self._lock = threading.Lock()
+        self._pool_sharding = None
+        self._pool_sharding_built = False
+
+    # -- inventory ------------------------------------------------------------
+
+    @property
+    def n_devices(self) -> int:
+        return len(self._devices)
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.groups)
+
+    def group(self, gid: int) -> DeviceGroup:
+        return self.groups[gid]
+
+    def healthy_groups(self) -> List[DeviceGroup]:
+        return [g for g in self.groups if g.state == GROUP_HEALTHY]
+
+    def pool_sharding(self):
+        """ONE persistent NamedSharding over the round axis spanning the
+        FULL pool — the huge-batch (catch-up sync / integrity scan) path.
+        None with fewer than 2 devices: single-device runs gain nothing
+        from an SPMD-partitioned program."""
+        if self._pool_sharding_built:
+            return self._pool_sharding
+        sh = build_round_sharding(self._devices) \
+            if len(self._devices) >= 2 else None
+        self._pool_sharding = sh
+        self._pool_sharding_built = True
+        return sh
+
+    # -- assignment -----------------------------------------------------------
+
+    def _loads_locked(self) -> Dict[int, int]:
+        loads = {g.gid: 0 for g in self.groups}
+        for key, gid in self._assignments.items():
+            if key not in self._weightless:
+                loads[gid] = loads.get(gid, 0) + 1
+        return loads
+
+    def assign(self, key, weigh: bool = True) -> DeviceGroup:
+        """Sticky least-loaded assignment.  Healthy groups are preferred;
+        with every group faulted the least-loaded one is used anyway
+        (the service's own failover ladder handles the fault).
+        `weigh=False` grants a stream affinity without counting toward
+        group load — host-fallback handles never dispatch on the
+        devices, so they must not push device chains off a group."""
+        with self._lock:
+            gid = self._assignments.get(key)
+            if gid is not None:
+                return self.groups[gid]
+            loads = self._loads_locked()
+            candidates = [g for g in self.groups
+                          if g.state == GROUP_HEALTHY] or self.groups
+            best = min(candidates, key=lambda g: (loads[g.gid], g.gid))
+            self._assignments[key] = best.gid
+            if not weigh:
+                self._weightless.add(key)
+            return best
+
+    def reassign(self, key) -> Optional[DeviceGroup]:
+        """Move `key` to the least-loaded HEALTHY group other than its
+        current one (group failover: handle → healthy sibling).  None
+        when no healthy sibling exists — the caller falls to host."""
+        with self._lock:
+            cur = self._assignments.get(key)
+            loads = self._loads_locked()
+            candidates = [g for g in self.groups
+                          if g.state == GROUP_HEALTHY and g.gid != cur]
+            if not candidates:
+                return None
+            best = min(candidates, key=lambda g: (loads[g.gid], g.gid))
+            self._assignments[key] = best.gid
+            return best
+
+    def place(self, key, gid: int) -> None:
+        """Force an assignment (the migrate-revert path: a failed
+        sibling rebuild must put the affinity back where the backend
+        actually still lives, or load accounting and stats drift)."""
+        with self._lock:
+            self._assignments[key] = gid
+
+    def release(self, key) -> None:
+        """Drop an assignment (handle churn): the next `assign` call
+        rebalances into the emptied group."""
+        with self._lock:
+            self._assignments.pop(key, None)
+            self._weightless.discard(key)
+
+    def loads(self) -> Dict[int, int]:
+        with self._lock:
+            return self._loads_locked()
+
+    def snapshot(self) -> dict:
+        """Per-group view for stats()/health: device count, state and
+        handle load."""
+        with self._lock:
+            loads = self._loads_locked()
+        return {g.gid: {"devices": g.n_devices, "state": g.state,
+                        "handles": loads.get(g.gid, 0)}
+                for g in self.groups}

@@ -22,8 +22,16 @@ File shape::
 
     {"version": 1,
      "entries": {"tpu": {"g2": {"pad": 32768, "depth": 4,
-                                "rounds_per_s": 21000.0}, ...},
+                                "rounds_per_s": 21000.0},
+                         "g2@4": {"pad": 65536, "depth": 2, ...}, ...},
                  "cpu": {...}}}
+
+Entries are additionally keyed by DEVICE-GROUP SIZE (ISSUE 11): a
+`<kind>@<n>` entry is the winner measured on an n-device group and beats
+the bare `<kind>` entry for handles whose group owns n devices — a
+1-device and a 4-device group never share a winner.  The bare kind is
+the group-size-1 legacy spelling and the fallback for sizes with no
+sweep of their own.
 
 This module imports no jax; the caller supplies the platform string.
 """
@@ -97,15 +105,23 @@ def _env_int(name: str) -> Optional[int]:
 
 def resolve(kind: str, platform: str,
             pad: Optional[int] = None,
-            depth: Optional[int] = None) -> Tuple[int, int, str]:
+            depth: Optional[int] = None,
+            group_size: int = 1) -> Tuple[int, int, str]:
     """(pad, depth, source) for a verify handle of `kind` ("g1" | "g2")
-    on `platform` (jax.default_backend(): "tpu" | "cpu" | ...).  Explicit
-    args pin; env overrides beat the file; the file must match the
-    CURRENT platform (a chip sweep's numbers never apply to the CPU
-    fallback container); otherwise the 8192x1 defaults."""
+    on `platform` (jax.default_backend(): "tpu" | "cpu" | ...) whose
+    device group owns `group_size` devices.  Explicit args pin; env
+    overrides beat the file; the file must match the CURRENT platform
+    (a chip sweep's numbers never apply to the CPU fallback container)
+    and prefers the `<kind>@<group_size>` entry over the bare `<kind>`
+    fallback; otherwise the 8192x1 defaults."""
     src_pad = src_depth = "default"
     out_pad, out_depth = DEFAULT_PAD, DEFAULT_DEPTH
-    ent = load_entries().get(platform, {}).get(kind, {})
+    plat_entries = load_entries().get(platform, {})
+    if not isinstance(plat_entries, dict):
+        plat_entries = {}
+    ent = plat_entries.get(f"{kind}@{int(group_size)}")
+    if not isinstance(ent, dict):
+        ent = plat_entries.get(kind, {})
     if isinstance(ent, dict):
         if isinstance(ent.get("pad"), int) and ent["pad"] > 0:
             out_pad, src_pad = ent["pad"], "tuning"

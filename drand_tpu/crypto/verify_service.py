@@ -48,11 +48,28 @@ instead of few full ones.  This module centralizes dispatch:
     canary probe re-promotes the device backend when it answers again:
     `healthy → suspect → degraded → probing → healthy`.
 
+  * **Multi-device scale-out (ISSUE 11).**  The service owns a
+    `crypto/device_pool.py` `DevicePool`: visible devices partition into
+    GROUPS (`Config.verify_device_groups` / `DRAND_VERIFY_DEVICE_GROUPS`;
+    auto = one group per device), every handle gets a sticky
+    least-loaded group (chain→device affinity), and each group runs its
+    OWN scheduler/packer dispatch stream — k chips run k concurrent
+    depth-k windows instead of sharing one.  The failure domain is
+    per-group: a faulted group's handles fail over to a healthy SIBLING
+    group (backend rebuilt on its devices) before falling to host, and
+    one group degrading never touches the others.  Batch submissions at
+    or above the shard threshold (`Config.verify_shard_threshold` /
+    `DRAND_VERIFY_SHARD_THRESHOLD`; auto = pad x max(2, n_devices))
+    route to a pool-wide persistent round-axis `Mesh`/`NamedSharding`
+    spanning every device — the huge-batch (catch-up sync / integrity
+    scan / strict-walk) path.
+
 Consumers hold a `VerifyHandle` (from `VerifyService.handle`) exposing
 the familiar `verify_batch(rounds, sigs, prev_sigs) -> bool array`
 blocking call plus the async `submit(...) -> VerifyFuture`.  Direct
 `BatchBeaconVerifier(...)` construction outside `crypto/` is forbidden
-by the tpu-vet `verifier` checker.
+by the tpu-vet `verifier` checker, as is `jax.devices()` enumeration
+outside `crypto/device_pool.py`.
 
 This module imports no jax at module scope: device backends are built
 lazily on first device-handle request.
@@ -88,6 +105,14 @@ DEFAULT_LIVE_WINDOW = 0.0   # live work flushes immediately
 # latencies): the factor keeps a healthy-but-slow chip off the trip wire, the
 # floor covers cold XLA compiles, which are minutes-scale and look exactly
 # like a hang to anything less patient.
+# Huge-batch round-axis sharding (ISSUE 11): a single submission of at
+# least this many rounds routes to the pool-wide sharded backend instead
+# of its handle's device group.  0 = AUTO: pad x max(2, pool devices) —
+# below roughly one pool-wide chunk the per-device shards are too narrow
+# to amortize the SPMD program and placement moves.
+DEFAULT_SHARD_THRESHOLD = int(
+    os.environ.get("DRAND_VERIFY_SHARD_THRESHOLD", "0"))
+
 DEFAULT_WATCHDOG_FACTOR = float(
     os.environ.get("DRAND_VERIFY_WATCHDOG_FACTOR", "8"))
 DEFAULT_WATCHDOG_FLOOR = float(
@@ -131,10 +156,12 @@ class _Request:
     internal to `BatchPartialVerifier`)."""
 
     __slots__ = ("kind", "key", "backend", "rounds", "sigs", "prevs", "fn",
-                 "lane", "future", "enqueued", "n", "flush", "retried")
+                 "lane", "future", "enqueued", "n", "flush", "retried",
+                 "sharded")
 
     def __init__(self, kind, lane, future, enqueued, key=None, backend=None,
-                 rounds=None, sigs=None, prevs=None, fn=None, flush=False):
+                 rounds=None, sigs=None, prevs=None, fn=None, flush=False,
+                 sharded=False):
         self.kind = kind            # "batch" | "call"
         self.lane = lane
         self.future = future
@@ -148,25 +175,54 @@ class _Request:
         self.n = len(rounds) if rounds is not None else 1
         self.flush = flush          # dispatch-ready: skip the window
         self.retried = False        # one watchdog-driven requeue spent
+        self.sharded = sharded      # huge batch: pool-wide sharded backend
 
 
 class _Batch:
     """One coalesced dispatch unit handed to the executor."""
 
-    __slots__ = ("lane", "backend", "requests", "call", "key", "slot")
+    __slots__ = ("lane", "backend", "requests", "call", "key", "slot",
+                 "stream", "sharded")
 
     def __init__(self, lane, backend=None, requests=None, call=None,
-                 key=None, slot=None):
+                 key=None, slot=None, stream=None, sharded=False):
         self.lane = lane
         self.backend = backend
         self.requests: List[_Request] = requests or []
         self.call: Optional[_Request] = call
         self.key = key
         self.slot = slot
+        self.stream: Optional["_GroupStream"] = stream
+        self.sharded = sharded
 
     @property
     def n(self) -> int:
         return sum(r.n for r in self.requests)
+
+    @property
+    def gid(self) -> int:
+        return self.stream.gid if self.stream is not None else 0
+
+
+class _GroupStream:
+    """One dispatch stream — the scheduler thread, packer and lane queues
+    of ONE device group.  k groups give the service k independent streams:
+    k concurrent depth-k in-flight windows on k devices, with per-group
+    preemption, failover and accounting (mutable state guarded by the
+    service's one `_cond`; threads are per stream)."""
+
+    __slots__ = ("gid", "queues", "thread", "packer", "dispatches",
+                 "inflight_max", "active")
+
+    def __init__(self, gid: int):
+        self.gid = gid
+        self.queues: Dict[str, deque] = {ln: deque() for ln in LANES}
+        self.thread = None
+        self.packer = None
+        self.dispatches = 0         # per-group dispatch counter (stats)
+        self.inflight_max = 0       # deepest in-flight window of this group
+        self.active = 0             # batches currently executing (depth-2
+                                    # max: a live preemption re-enters)
 
 
 class _Ticket:
@@ -198,10 +254,13 @@ class _BackendSlot:
 
     __slots__ = ("key", "label", "primary", "fallback_factory", "fallback",
                  "state", "latencies", "sample", "failovers", "degraded_at",
-                 "first_fault_at", "pad", "depth")
+                 "first_fault_at", "pad", "depth", "scheme", "pk", "kind",
+                 "gid", "group_size", "backend_factory", "pool_backend",
+                 "pool_pad", "pool_ok", "pool_retry_at", "migrations")
 
     def __init__(self, key, label, primary, fallback_factory=None,
-                 pad=DEFAULT_PAD, depth=1):
+                 pad=DEFAULT_PAD, depth=1, scheme=None, pk=b"",
+                 kind="custom", gid=0, group_size=0, backend_factory=None):
         self.key = key
         self.label = label
         self.primary = primary
@@ -211,6 +270,20 @@ class _BackendSlot:
         self.latencies: deque = deque(maxlen=64)
         self.pad = pad          # coalesced batch width for this handle
         self.depth = depth      # dispatch-pipeline depth for this handle
+        self.scheme = scheme    # retained for sibling-group backend builds
+        self.pk = pk
+        self.kind = kind        # "device" | "host" | "custom"
+        # -- device-group affinity (ISSUE 11) --
+        self.gid = gid                  # this handle's device group
+        self.group_size = group_size    # devices in that group
+        # rebuilds the primary on another group (group→sibling failover);
+        # None = not group-backed, the slot degrades straight to host
+        self.backend_factory = backend_factory
+        self.pool_backend = None        # pool-wide sharded backend (lazy)
+        self.pool_pad = 0               # its chunk span (pad x n_devices)
+        self.pool_ok = True             # sharding disabled after a pool fault
+        self.pool_retry_at = None       # clock time sharding re-arms at
+        self.migrations = 0             # group→sibling failovers taken
         # (rounds, sigs, prevs, verdict) of a known-good 1-lane dispatch:
         # the canary probe replays it and requires the same verdict, so a
         # poisoned device (answers, but wrongly) cannot re-promote itself
@@ -241,6 +314,12 @@ class VerifyHandle:
         self.scheme = scheme
         self.backend = backend
         self.kind = getattr(backend, "kind", "host")
+
+    @property
+    def gid(self) -> int:
+        """This handle's device-group id (chain→device affinity)."""
+        slot = self.service._slots.get(self.key)
+        return slot.gid if slot is not None else 0
 
     def submit(self, rounds, sigs, prev_sigs=None,
                lane: str = LANE_BACKGROUND,
@@ -321,7 +400,10 @@ class VerifyService:
                  watchdog_factor: Optional[float] = None,
                  watchdog_floor: Optional[float] = None,
                  probe_interval: Optional[float] = None,
-                 pipeline_depth: int = 0):
+                 pipeline_depth: int = 0,
+                 device_groups: int = 0,
+                 shard_threshold: int = 0,
+                 pool=None):
         if clock is None:
             # deferred import: crypto must not hard-depend on beacon at
             # module scope (same layering softening as net/resilience.py)
@@ -338,16 +420,22 @@ class VerifyService:
         self.watchdog_factor = watchdog_factor or DEFAULT_WATCHDOG_FACTOR
         self.watchdog_floor = watchdog_floor or DEFAULT_WATCHDOG_FLOOR
         self.probe_interval = probe_interval or DEFAULT_PROBE_INTERVAL
+        # device pool / sharding knobs (ISSUE 11): group count 0 = AUTO
+        # (one group per device), shard threshold 0 = AUTO (pad x
+        # max(2, pool devices)); `pool` injects a prebuilt DevicePool
+        # (tests).  The pool itself is built lazily on first handle.
+        self.device_groups = max(0, int(device_groups or 0))
+        self.shard_threshold = max(0, int(shard_threshold or 0)) \
+            or DEFAULT_SHARD_THRESHOLD
+        self._pool = pool
         self._cond = threading.Condition()
-        self._queues: Dict[str, deque] = {ln: deque() for ln in LANES}
+        self._streams: Dict[int, _GroupStream] = {}
         self._handles: Dict[Tuple, VerifyHandle] = {}
         self._slots: Dict[Tuple, _BackendSlot] = {}
         self._tickets: Dict[int, _Ticket] = {}
-        self._mesh = None
-        self._thread: Optional[threading.Thread] = None
         self._watchdog_thread: Optional[threading.Thread] = None
         self._probe_thread: Optional[threading.Thread] = None
-        self._packer = None
+        self._call_rr = 0           # round-robin lane for opaque calls
         self._stopped = False
         # serving-plane degradation ladder (net/admission.py): while True
         # the BACKGROUND lane does not drive dispatches — its requests
@@ -367,31 +455,78 @@ class VerifyService:
         self._failovers = 0
         self._promotions = 0
         self._watchdog_trips = 0
+        self._migrations = 0        # group→sibling backend rebuilds
+        self._sharded_dispatches = 0    # pool-wide huge-batch dispatches
+        self._concurrent_max = 0    # most streams mid-dispatch at once
 
     # -- handles / backends --------------------------------------------------
 
     def handle(self, scheme, public_key_bytes: bytes, device: bool = True,
-               backend=None, fallback=None) -> VerifyHandle:
+               backend=None, fallback=None, backend_factory=None,
+               pool_backend=None) -> VerifyHandle:
         """The per-chain submit surface.  `device=False` (or jax being
         unavailable) selects the `HostBatchVerifier` fallback behind the
         same API; `backend=` injects a custom verifier (tests/chaos) and
         `fallback=` its failover target.  Device handles get a lazy
         `HostBatchVerifier` failover target automatically.
 
+        The handle is assigned a DEVICE GROUP from the service's pool
+        (sticky least-loaded — chain→device affinity) and dispatches on
+        that group's own scheduler stream; `backend_factory` (a callable
+        `group -> backend`) makes an injected backend group-backed, so
+        it participates in group→sibling failover like a real device
+        backend; `pool_backend` injects the pool-wide sharded backend
+        huge batches route to (tests — device handles build their own).
+
         The handle's coalescing pad and dispatch-pipeline depth are
-        resolved HERE through crypto/tuning.py (explicit ctor values pin;
-        env overrides beat TUNING.json; no file + no env = 8192x1)."""
+        resolved HERE through crypto/tuning.py for ITS GROUP SIZE
+        (explicit ctor values pin; env overrides beat TUNING.json; no
+        file + no env = 8192x1 — a 1-device and a 4-device group never
+        share a winner)."""
         pk = bytes(public_key_bytes)
-        kind = "custom" if backend is not None else \
-            ("device" if device and self._device_available() else "host")
-        key = (scheme.id, pk, kind, id(backend) if backend is not None else 0)
+        if backend is not None or backend_factory is not None:
+            kind = "custom"
+        elif device and self._device_available():
+            kind = "device"
+        else:
+            kind = "host"
+        key = (scheme.id, pk, kind,
+               id(backend) if backend is not None
+               else id(backend_factory) if backend_factory is not None
+               else 0)
         with self._cond:
             h = self._handles.get(key)
         if h is not None:
             return h
-        pad, depth = self._tuned(scheme)
+        pool = self._get_pool()
+        # host handles get a stream but no placement weight: they never
+        # dispatch on the group's devices, and counting them would push
+        # real device chains off otherwise-empty groups
+        group = pool.assign(key, weigh=(kind != "host"))
+        pad, depth = self._tuned(scheme, max(1, group.n_devices))
+        factory = backend_factory
+        if backend is None and factory is None and kind == "device":
+            # pin to the group's devices only when there is more than one
+            # device to tell apart: on a 1-device pool the default device
+            # IS the group, and pinning would change the compiled-program
+            # flavor (placement lands in the executable cache key) for
+            # nothing
+            pin = pool.n_devices > 1
+
+            def factory(g, s=scheme, p=pk, pin=pin):
+                from .batch import BatchBeaconVerifier
+                fpad, _ = self._tuned(s, max(1, g.n_devices))
+                # the group's placement is built once and shared by
+                # every chain on the group (DeviceGroup.sharding caches)
+                return BatchBeaconVerifier(
+                    s, p, pad_to=fpad,
+                    sharding=g.sharding() if pin else None)
         if backend is None:
-            backend = self._make_backend(scheme, pk, kind, pad)
+            if factory is not None:
+                backend = factory(group)
+            else:               # kind == "host": the jax-free fallback
+                from .hostverify import HostBatchVerifier
+                backend = HostBatchVerifier(scheme, pk)
         h = VerifyHandle(self, key, scheme, backend)
         if fallback is not None:
             fallback_factory = lambda fb=fallback: fb  # noqa: E731
@@ -402,13 +537,54 @@ class VerifyService:
         else:
             fallback_factory = None     # host handles have nowhere to go
         slot = _BackendSlot(key, f"{scheme.id}:{pk[:4].hex()}", backend,
-                            fallback_factory, pad=pad, depth=depth)
+                            fallback_factory, pad=pad, depth=depth,
+                            scheme=scheme, pk=pk, kind=kind,
+                            gid=group.gid, group_size=group.n_devices,
+                            backend_factory=factory)
+        if pool_backend is not None:
+            slot.pool_backend = pool_backend
+            slot.pool_pad = getattr(pool_backend, "pad_to", 0) \
+                or pad * max(2, pool.n_devices)
         with self._cond:
             # two racing builders: first insert wins, both see one handle
             h = self._handles.setdefault(key, h)
             slot = self._slots.setdefault(key, slot)
         self._set_state_gauge(slot)
         return h
+
+    def release_handle(self, handle: VerifyHandle) -> None:
+        """Drop a handle (multi-tenant churn): its slot and device-group
+        assignment are released, so the pool rebalances the next handle
+        into the freed group.  Still-queued requests for the key resolve
+        against the backend captured at submit time."""
+        with self._cond:
+            self._handles.pop(handle.key, None)
+            slot = self._slots.pop(handle.key, None)
+        if self._pool is not None:
+            self._pool.release(handle.key)
+        if slot is not None:
+            from ..metrics import verify_backend_state
+            try:
+                verify_backend_state.remove(slot.label, str(slot.gid))
+            except KeyError:
+                pass
+
+    def _get_pool(self):
+        """The service-owned DevicePool, built on first handle (device
+        enumeration is lazy and process-cached in device_pool)."""
+        pool = self._pool
+        if pool is not None:
+            return pool
+        from .device_pool import DevicePool
+        built = DevicePool(n_groups=self.device_groups)
+        with self._cond:
+            if self._pool is None:
+                self._pool = built
+            pool = self._pool
+        from ..metrics import verify_group_devices
+        for g in pool.groups:
+            verify_group_devices.labels(str(g.gid)).set(g.n_devices)
+        return pool
 
     def partials_factory(self, inner_factory: Callable,
                          fallback_factory: Optional[Callable] = None
@@ -443,10 +619,12 @@ class VerifyService:
         except Exception:
             return "cpu"
 
-    def _tuned(self, scheme):
+    def _tuned(self, scheme, group_size: int = 1):
         """(pad, depth) for a new handle: explicit ctor overrides pin;
-        otherwise env > TUNING.json (current platform + scheme kind) >
-        the 8192x1 defaults.  Platform detection (a jax touch) is skipped
+        otherwise env > TUNING.json (current platform + scheme kind AT
+        THIS GROUP SIZE — `kind@n` entries beat the bare-kind fallback,
+        so a 1-device and a 4-device group resolve independently) > the
+        8192x1 defaults.  Platform detection (a jax touch) is skipped
         when nothing could override anyway."""
         from . import tuning
         if self.pad_override and self.depth_override:
@@ -460,7 +638,7 @@ class VerifyService:
         platform = self._platform() if consult else "cpu"
         pad, depth, _src = tuning.resolve(
             kind, platform, pad=self.pad_override or None,
-            depth=self.depth_override or None)
+            depth=self.depth_override or None, group_size=group_size)
         return pad, depth
 
     def _pad_of(self, key) -> int:
@@ -471,44 +649,82 @@ class VerifyService:
             return slot.pad
         return self.pad_override or DEFAULT_PAD
 
-    def _make_backend(self, scheme, pk: bytes, kind: str, pad: int):
-        if kind == "device":
-            from .batch import BatchBeaconVerifier
-            return BatchBeaconVerifier(scheme, pk, pad_to=pad,
-                                       sharding=self._device_sharding())
-        from .hostverify import HostBatchVerifier
-        return HostBatchVerifier(scheme, pk)
+    # -- huge-batch round-axis sharding (ISSUE 11) ---------------------------
 
-    def _device_sharding(self):
-        """Persistent round-axis placement, built once and shared by
-        every device backend (the service owns the mesh; per-dispatch
-        mesh construction was pure overhead)."""
-        import jax
-        devs = jax.devices()
-        if len(devs) < 2:
-            return None
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec
-        if self._mesh is None:
-            self._mesh = Mesh(np.array(devs), ("round",))
-        return NamedSharding(self._mesh, PartitionSpec("round"))
+    def _shard_threshold_for(self, slot: _BackendSlot) -> int:
+        """Rounds per single submission at or above which the pool-wide
+        sharded backend serves it instead of the handle's group."""
+        if self.shard_threshold:
+            return self.shard_threshold
+        pool = self._pool
+        n = pool.n_devices if pool is not None else 1
+        return slot.pad * max(2, n)
+
+    def _ensure_pool_backend(self, slot: _BackendSlot) -> bool:
+        """Build (once) the slot's pool-wide sharded backend: the same
+        scheme/pubkey compiled at pad x n_devices over the pool's ONE
+        persistent round-axis Mesh/NamedSharding.  False when sharding
+        cannot help (single device, non-device slot with no injected
+        pool backend, or a previous pool fault)."""
+        if not slot.pool_ok:
+            # a pool fault disables sharding with a probe-cadence
+            # cooldown, not forever: a transient collective error during
+            # one catch-up sync must not pin every later huge batch to a
+            # single group for the process lifetime (a second fault
+            # re-arms the cooldown)
+            if slot.pool_retry_at is None \
+                    or self.clock.monotonic() < slot.pool_retry_at:
+                return False
+            with self._cond:
+                slot.pool_ok = True
+                slot.pool_retry_at = None
+        if slot.pool_backend is not None:
+            return True
+        if slot.kind != "device":
+            return False
+        pool = self._pool
+        if pool is None:
+            return False
+        sharding = pool.pool_sharding()
+        if sharding is None:
+            return False
+        from .batch import BatchBeaconVerifier
+        pool_pad = slot.pad * pool.n_devices
+        pb = BatchBeaconVerifier(slot.scheme, slot.pk, pad_to=pool_pad,
+                                 sharding=sharding)
+        with self._cond:
+            if slot.pool_backend is None:
+                slot.pool_backend = pb
+                slot.pool_pad = pool_pad
+        return True
 
     # -- submission ----------------------------------------------------------
 
     def submit(self, handle: VerifyHandle, rounds, sigs, prev_sigs=None,
                lane: str = LANE_BACKGROUND,
                flush_now: bool = False) -> VerifyFuture:
-        if lane not in self._queues:
+        if lane not in LANES:
             raise ValueError(f"unknown lane {lane!r}")
         fut = VerifyFuture()
         n = len(rounds)
         if n == 0:
             fut.set_result(np.zeros(0, dtype=bool))
             return fut
+        # huge single submissions (catch-up sync, integrity scans, the
+        # strict-walk sweep) shard over the FULL pool instead of this
+        # handle's one group; a sharded batch is dispatch-ready by
+        # construction (it already dwarfs the pad)
+        sharded = False
+        slot = self._slots.get(handle.key)
+        if slot is not None and slot.state == STATE_HEALTHY \
+                and n >= self._shard_threshold_for(slot):
+            sharded = self._ensure_pool_backend(slot)
         req = _Request("batch", lane, fut, self.clock.monotonic(),
                        key=handle.key, backend=handle.backend,
                        rounds=list(rounds), sigs=list(sigs),
                        prevs=list(prev_sigs) if prev_sigs is not None
-                       else [None] * n, flush=flush_now)
+                       else [None] * n, flush=flush_now or sharded,
+                       sharded=sharded)
         self._enqueue(req)
         return fut
 
@@ -528,23 +744,51 @@ class VerifyService:
                 req.future.set_exception(
                     RuntimeError("verify service stopped"))
                 return
-            self._queues[req.lane].append(req)
+            stream = self._stream_locked(self._gid_for_locked(req))
+            stream.queues[req.lane].append(req)
             self._submitted += 1
             verify_requests.labels(req.lane).inc()
             verify_queue_depth.labels(req.lane).set(
-                len(self._queues[req.lane]))
-            self._ensure_threads_locked()
+                self._qdepth_locked(req.lane))
+            self._ensure_threads_locked(stream)
             self._cond.notify_all()
 
-    def _ensure_threads_locked(self) -> None:
-        """Caller holds the lock.  The scheduler and its watchdog start
-        together; either may be replaced later (a wedged dispatch
-        abandons its thread, see `_trip`)."""
-        if self._thread is None:
+    def _gid_for_locked(self, req: _Request) -> int:
+        """The device group (= dispatch stream) a request rides: its
+        handle's slot affinity for batches (so same-chain work always
+        shares one stream and coalesces), round-robin over the pool for
+        opaque calls (live partial blocks spread across the k streams).
+        Caller holds the lock."""
+        if req.key is not None:
+            slot = self._slots.get(req.key)
+            if slot is not None:
+                return slot.gid
+            return 0
+        pool = self._pool
+        n = pool.n_groups if pool is not None else 1
+        self._call_rr = (self._call_rr + 1) % max(1, n)
+        return self._call_rr
+
+    def _stream_locked(self, gid: int) -> _GroupStream:
+        st = self._streams.get(gid)
+        if st is None:
+            st = self._streams[gid] = _GroupStream(gid)
+        return st
+
+    def _qdepth_locked(self, lane: str) -> int:
+        return sum(len(st.queues[lane]) for st in self._streams.values())
+
+    def _ensure_threads_locked(self, stream: _GroupStream) -> None:
+        """Caller holds the lock.  Each group's scheduler starts on its
+        first work; the one watchdog starts with the first of them.
+        Either may be replaced later (a wedged dispatch abandons its
+        thread, see `_trip`)."""
+        if stream.thread is None:
             # tpu-vet: disable=lock  (caller holds self._cond, see docstring)
-            self._thread = threading.Thread(
-                target=self._run, daemon=True, name="verify-scheduler")
-            self._thread.start()
+            stream.thread = threading.Thread(
+                target=self._run, args=(stream,), daemon=True,
+                name=f"verify-scheduler-g{stream.gid}")
+            stream.thread.start()
         if self._watchdog_thread is None:
             self._watchdog_thread = threading.Thread(
                 target=self._watchdog_run, daemon=True,
@@ -554,6 +798,8 @@ class VerifyService:
     def _requeue(self, requests: List[_Request]) -> None:
         """Put requests back at the FRONT of their lanes (flush-ready, so
         failover redispatch does not wait out a coalescing window).  The
+        stream is re-resolved per request — after a group→sibling
+        failover the slot's new group serves the redispatch.  The
         failover contract: requeued, not failed."""
         from ..metrics import verify_queue_depth
         drained = []
@@ -563,9 +809,11 @@ class VerifyService:
             else:
                 for r in reversed(requests):
                     r.flush = True
-                    self._queues[r.lane].appendleft(r)
+                    stream = self._stream_locked(self._gid_for_locked(r))
+                    stream.queues[r.lane].appendleft(r)
+                    self._ensure_threads_locked(stream)
                 for ln in LANES:
-                    verify_queue_depth.labels(ln).set(len(self._queues[ln]))
+                    verify_queue_depth.labels(ln).set(self._qdepth_locked(ln))
             self._cond.notify_all()
         for r in drained:
             if not r.future.done():
@@ -573,15 +821,15 @@ class VerifyService:
 
     # -- scheduler -----------------------------------------------------------
 
-    def _run(self) -> None:
+    def _run(self, stream: _GroupStream) -> None:
         me = threading.current_thread()
         while True:
             with self._cond:
                 # a watchdog trip may have replaced this thread while it
                 # was wedged in a device call — the queue is no longer ours
-                if self._thread is not me:
+                if stream.thread is not me:
                     return
-            batch = self._next_batch()
+            batch = self._next_batch(stream)
             if batch is None:
                 return
             self._execute(batch)
@@ -592,27 +840,29 @@ class VerifyService:
     # after this much accumulated real cv-wait the batch flushes anyway.
     REAL_FLUSH_CAP = 5.0
 
-    def _next_batch(self) -> Optional[_Batch]:
-        """Block until a batch is ready: live work flushes immediately,
-        background work may wait out its coalescing window to fill.  The
-        whole lane queue is scanned, not just its head — one chain's
-        unexpired window must not head-of-line-block another chain's
-        dispatch-ready batch (multi-beacon daemons share one service)."""
+    def _next_batch(self, stream: _GroupStream) -> Optional[_Batch]:
+        """Block until a batch is ready on THIS group's stream: live work
+        flushes immediately, background work may wait out its coalescing
+        window to fill.  The whole lane queue is scanned, not just its
+        head — one chain's unexpired window must not head-of-line-block
+        another chain's dispatch-ready batch (multi-beacon daemons share
+        one service, and several chains can share one group)."""
         waited = 0.0        # accumulated real cv-wait towards the cap
         with self._cond:
             while True:
                 if self._stopped \
-                        or self._thread is not threading.current_thread():
+                        or stream.thread is not threading.current_thread():
                     return None
-                if self._queues[LANE_LIVE]:
+                if stream.queues[LANE_LIVE]:
                     lane = LANE_LIVE
-                elif self._queues[LANE_BACKGROUND] and not self._bg_paused:
+                elif stream.queues[LANE_BACKGROUND] and not self._bg_paused:
                     lane = LANE_BACKGROUND
                 else:
                     self._cond.wait(0.1)
                     waited = 0.0
                     continue
-                chosen, next_flush = self._pick_ready_locked(lane, waited)
+                chosen, next_flush = self._pick_ready_locked(stream, lane,
+                                                             waited)
                 if chosen is None:
                     # every queued chain is inside its window and under
                     # pad: cv-wait until the earliest flush deadline, with
@@ -624,9 +874,10 @@ class VerifyService:
                     if not self._cond.wait(step):
                         waited += step
                     continue
-                return self._gather_locked(lane, chosen)
+                return self._gather_locked(stream, lane, chosen)
 
-    def _pick_ready_locked(self, lane: str, waited: float):
+    def _pick_ready_locked(self, stream: _GroupStream, lane: str,
+                           waited: float):
         """First dispatch-ready request in `lane` FIFO order, plus the
         earliest flush deadline when none is ready.  Ready = an opaque
         call, a chain whose coalesced fill reaches the pad, an expired
@@ -635,11 +886,11 @@ class VerifyService:
         now = self.clock.monotonic()
         fills: Dict[Tuple, int] = {}
         for ln in LANES:
-            for r in self._queues[ln]:
+            for r in stream.queues[ln]:
                 if r.kind == "batch":
                     fills[r.key] = fills.get(r.key, 0) + r.n
         next_flush = None
-        for r in self._queues[lane]:
+        for r in stream.queues[lane]:
             if r.kind == "call" or r.flush or window <= 0 \
                     or fills[r.key] >= self._pad_of(r.key) \
                     or now >= r.enqueued + window \
@@ -650,46 +901,75 @@ class VerifyService:
                 next_flush = flush_at
         return None, next_flush
 
-    def _try_next(self, lane: str) -> Optional[_Batch]:
+    def _try_next(self, stream: _GroupStream,
+                  lane: str) -> Optional[_Batch]:
         """Non-blocking, no window: the preemption path's grab."""
         with self._cond:
-            if self._stopped or not self._queues[lane]:
+            if self._stopped or not stream.queues[lane]:
                 return None
-            return self._gather_locked(lane, self._queues[lane][0])
+            return self._gather_locked(stream, lane,
+                                       stream.queues[lane][0])
 
-    def _gather_locked(self, lane: str, head: _Request) -> _Batch:
+    def _gather_locked(self, stream: _GroupStream, lane: str,
+                       head: _Request) -> _Batch:
         """Pop `head` plus every same-chain batch request from BOTH lanes
-        (they ride the same dispatch for free).  The backend is resolved
-        HERE, at dispatch time, through the key's failover slot — a
-        degraded chain's requeued requests land on the host fallback, a
-        re-promoted one back on the device.  Caller-holds-lock helper:
-        every call site sits inside `with self._cond` (same shape as
+        of this stream (they ride the same dispatch for free; sharded and
+        unsharded requests never merge — different backend and span).
+        The backend is resolved HERE, at dispatch time, through the key's
+        failover slot — a degraded chain's requeued requests land on the
+        host fallback, a re-promoted one back on the device, a sharded
+        batch on the pool-wide backend.  Caller-holds-lock helper: every
+        call site sits inside `with self._cond` (same shape as
         sqlitedb._fill_previous).
         """
         from ..metrics import verify_queue_depth
         if head.kind == "call":
-            self._queues[lane].remove(head)
-            verify_queue_depth.labels(lane).set(len(self._queues[lane]))
-            return _Batch(lane, call=head)
+            stream.queues[lane].remove(head)
+            verify_queue_depth.labels(lane).set(self._qdepth_locked(lane))
+            return _Batch(lane, call=head, stream=stream)
         requests = []
         for ln in (lane,) + tuple(l for l in LANES if l != lane):
             keep: deque = deque()
-            for r in self._queues[ln]:
-                if r is head or (r.kind == "batch" and r.key == head.key):
+            for r in stream.queues[ln]:
+                if r is head or (r.kind == "batch" and r.key == head.key
+                                 and r.sharded == head.sharded):
                     requests.append(r)
                 else:
                     keep.append(r)
             # tpu-vet: disable=lock  (caller holds self._cond, see docstring)
-            self._queues[ln] = keep
-            verify_queue_depth.labels(ln).set(len(keep))
+            stream.queues[ln] = keep
+            verify_queue_depth.labels(ln).set(self._qdepth_locked(ln))
         slot = self._slots.get(head.key)
-        backend = slot.active() if slot is not None else head.backend
+        if head.sharded and slot is not None \
+                and slot.pool_backend is not None:
+            backend = slot.pool_backend
+        else:
+            backend = slot.active() if slot is not None else head.backend
         return _Batch(lane, backend=backend, requests=requests,
-                      key=head.key, slot=slot)
+                      key=head.key, slot=slot, stream=stream,
+                      sharded=head.sharded)
 
     # -- execution (service thread, outside the lock) -------------------------
 
     def _execute(self, batch: _Batch) -> None:
+        """Run one batch, tracking how many group streams are mid-dispatch
+        at once — `concurrent_streams_max` is the scale-out proof (k
+        groups really do run k overlapping windows, not take turns)."""
+        stream = batch.stream
+        if stream is not None:
+            with self._cond:
+                stream.active += 1
+                busy = sum(1 for s in self._streams.values() if s.active)
+                if busy > self._concurrent_max:
+                    self._concurrent_max = busy
+        try:
+            self._execute_inner(batch)
+        finally:
+            if stream is not None:
+                with self._cond:
+                    stream.active -= 1
+
+    def _execute_inner(self, batch: _Batch) -> None:
         if batch.call is not None:
             self._execute_call(batch)
             return
@@ -746,10 +1026,11 @@ class VerifyService:
             except BaseException as e2:
                 req.future.set_exception(e2)
                 self._account(batch.lane, 1, 1,
-                              self.clock.monotonic() - t0)
+                              self.clock.monotonic() - t0, gid=batch.gid)
                 return
         req.future.set_result(out)
-        self._account(batch.lane, 1, 1, self.clock.monotonic() - t0)
+        self._account(batch.lane, 1, 1, self.clock.monotonic() - t0,
+                      gid=batch.gid)
 
     def _run_chunks(self, batch: _Batch):
         rounds: List = []
@@ -760,7 +1041,13 @@ class VerifyService:
             sigs.extend(r.sigs)
             prevs.extend(r.prevs)
         n = len(rounds)
-        pad = self._pad_of(batch.key)
+        # sharded batches chunk at the pool-wide span (pad x n_devices):
+        # each device sees a pad-sized shard of every chunk
+        if batch.sharded and batch.slot is not None \
+                and batch.slot.pool_pad:
+            pad = batch.slot.pool_pad
+        else:
+            pad = self._pad_of(batch.key)
         spans = [(lo, min(lo + pad, n)) for lo in range(0, n, pad)]
         results = np.zeros(n, dtype=bool)
         errors: List[Tuple[int, int, BaseException]] = []
@@ -768,7 +1055,7 @@ class VerifyService:
         slot = batch.slot
         if hasattr(backend, "pack_chunk"):
             self._run_pipelined(batch, slot, backend, rounds, sigs, prevs,
-                                spans, results, errors)
+                                spans, pad, results, errors)
         else:
             for lo, hi in spans:
                 self._maybe_preempt(batch)
@@ -785,7 +1072,8 @@ class VerifyService:
                     errors.append((lo, hi, e))
                     continue
                 self._account(batch.lane, hi - lo, hi - lo,
-                              self.clock.monotonic() - t0, slot=slot)
+                              self.clock.monotonic() - t0, slot=slot,
+                              gid=batch.gid, sharded=batch.sharded)
                 self._stash_sample(slot, rounds, sigs, prevs, results, lo)
         return results, errors
 
@@ -794,7 +1082,7 @@ class VerifyService:
     PACK_TIMEOUT = 600.0
 
     def _run_pipelined(self, batch, slot, backend, rounds, sigs, prevs,
-                       spans, results, errors) -> None:
+                       spans, span_pad, results, errors) -> None:
         """Device path: host packing of chunk k+1 overlaps device compute
         of chunk k, generalized to a DEPTH-K in-flight window (ISSUE 10):
         up to `depth` dispatches stay enqueued ahead of the resolve point
@@ -805,9 +1093,8 @@ class VerifyService:
         dispatches sharing the device (deadline on the oldest in-flight
         work, not each dispatch independently)."""
         from ..metrics import verify_inflight
-        packer = self._ensure_packer()
-        pad_width = max(self._pad_of(batch.key),
-                        getattr(backend, "pad_to", 0) or 0)
+        packer = self._ensure_packer(batch.stream)
+        pad_width = max(span_pad, getattr(backend, "pad_to", 0) or 0)
         depth = max(1, slot.depth if slot is not None else 1)
         if hasattr(backend, "pipeline_depth"):
             # the backend clamps by per-chunk footprint: depth x chunk
@@ -846,7 +1133,7 @@ class VerifyService:
                 else max(t0, last_resolved[0])
             last_resolved[0] = end
             self._account(batch.lane, hi - lo, pad_width, end - start,
-                          slot=slot)
+                          slot=slot, gid=batch.gid, sharded=batch.sharded)
             self._stash_sample(slot, rounds, sigs, prevs, results, lo)
 
         inflight: deque = deque()
@@ -857,6 +1144,9 @@ class VerifyService:
             with self._cond:
                 if d > self._inflight_max:
                     self._inflight_max = d
+                if batch.stream is not None \
+                        and d > batch.stream.inflight_max:
+                    batch.stream.inflight_max = d
 
         def advance(p):
             fut, lo, hi = p
@@ -983,17 +1273,32 @@ class VerifyService:
                     scale: int = 1):
         """One chunk dispatch with the failover ladder: first failure on
         the primary backend marks it suspect and retries ONCE; a second
-        failure degrades the slot (atomic swap to the fallback) and
-        requeues every request of the batch.  Chunks on non-failover
-        backends (host, custom-without-fallback, or already-degraded)
-        raise through — the caller contains the error to that chunk."""
+        failure takes the group→sibling→host order — the slot's device
+        group is marked FAULTED, the backend is rebuilt on a healthy
+        sibling group when one exists (`_migrate`), else the slot
+        degrades to the host fallback — and every request of the batch
+        is requeued.  A pool-wide SHARDED dispatch that faults twice
+        falls back to unsharded dispatch on the slot's own group
+        (`_unshard`) instead.  Chunks on non-failover backends (host,
+        custom-without-fallback, or already-degraded) raise through —
+        the caller contains the error to that chunk."""
         try:
             return self._guarded(slot, batch, fn, scale=scale)
         except _Abandoned:
             raise
         except BaseException:
-            if slot is None or not slot.can_failover \
-                    or batch.backend is not slot.primary:
+            if slot is None:
+                raise
+            if batch.sharded and batch.backend is slot.pool_backend:
+                try:
+                    return self._guarded(slot, batch, fn, scale=scale)
+                except _Abandoned:
+                    raise
+                except BaseException:
+                    self._unshard(slot, batch)
+                    raise _Requeued()
+            if batch.backend is not slot.primary \
+                    or not (slot.can_failover or self._migratable(slot)):
                 raise
             self._note_fault(slot)
             self._note_suspect(slot)
@@ -1002,9 +1307,84 @@ class VerifyService:
             except _Abandoned:
                 raise
             except BaseException as e2:
-                self._degrade(slot, e2)
+                self._group_fault(slot)
+                if not self._migrate(slot):
+                    self._degrade(slot, e2)
                 self._requeue(batch.requests)
                 raise _Requeued()
+
+    def _migratable(self, slot: _BackendSlot) -> bool:
+        """Group→sibling failover is possible for group-backed slots
+        (device handles, or custom handles built via `backend_factory`)
+        when the pool has more than one group."""
+        return slot.backend_factory is not None and self._pool is not None \
+            and self._pool.n_groups > 1
+
+    def _group_fault(self, slot: _BackendSlot) -> None:
+        """Mark the slot's device group FAULTED (its devices, not just
+        this chain's backend, are the failure domain) and stash the
+        faulting backend + its known-good sample as the group's canary
+        context — `_probe_group` replays it to re-promote the group."""
+        pool = self._pool
+        if pool is None or slot.backend_factory is None:
+            return      # not group-backed: nothing to quarantine
+        from .device_pool import GROUP_FAULTED, GROUP_HEALTHY
+        group = pool.group(slot.gid)
+        with self._cond:
+            if group.state == GROUP_HEALTHY:
+                group.state = GROUP_FAULTED
+                group.faulted_at = self.clock.monotonic()
+                group.probe_backend = slot.primary
+                group.probe_sample = slot.sample
+        self._ensure_probe()
+
+    def _migrate(self, slot: _BackendSlot) -> bool:
+        """Group→sibling failover: rebuild the slot's primary backend on
+        the least-loaded HEALTHY sibling group and move its affinity
+        there.  The slot stays HEALTHY — the chain never saw the host
+        path — and its (pad, depth) re-resolve for the new group size.
+        False when no healthy sibling exists (the caller degrades to
+        host) or the rebuild itself fails."""
+        from ..metrics import verify_failovers
+        if not self._migratable(slot):
+            return False
+        old_gid = slot.gid
+        sibling = self._pool.reassign(slot.key)
+        if sibling is None:
+            return False
+        try:
+            new_backend = slot.backend_factory(sibling)
+        except BaseException:
+            # the rebuild failed: the backend still lives on the old
+            # group — put the pool affinity back so loads/stats agree
+            self._pool.place(slot.key, old_gid)
+            return False
+        pad, depth = self._tuned(slot.scheme, max(1, sibling.n_devices))
+        with self._cond:
+            slot.primary = new_backend
+            slot.gid = sibling.gid
+            slot.group_size = sibling.n_devices
+            slot.pad, slot.depth = pad, depth
+            slot.state = STATE_HEALTHY
+            slot.first_fault_at = None
+            slot.migrations += 1
+            self._migrations += 1
+        verify_failovers.labels(slot.label, "to_sibling").inc()
+        self._set_state_gauge(slot, old_gid=old_gid)
+        return True
+
+    def _unshard(self, slot: _BackendSlot, batch: _Batch) -> None:
+        """A pool-wide sharded dispatch faulted twice: disable sharding
+        for this slot for one probe interval (re-promotion also
+        re-enables it immediately) and requeue the riders unsharded on
+        the slot's own group — requeued, never failed."""
+        with self._cond:
+            slot.pool_ok = False
+            slot.pool_retry_at = self.clock.monotonic() \
+                + self.probe_interval
+            for r in batch.requests:
+                r.sharded = False
+        self._requeue(batch.requests)
 
     def _note_fault(self, slot: _BackendSlot) -> None:
         with self._cond:
@@ -1050,13 +1430,32 @@ class VerifyService:
         with self._cond:
             slot.state = STATE_HEALTHY
             slot.first_fault_at = None
+            slot.pool_ok = True     # a healthy device re-earns sharding
+            slot.pool_retry_at = None
             self._promotions += 1
+        # the canary that promoted this slot ran on its group's devices —
+        # the GROUP is proven healthy too (it degraded with no sibling
+        # available, so the slot kept its original gid)
+        pool = self._pool
+        if pool is not None and slot.backend_factory is not None:
+            from .device_pool import GROUP_HEALTHY
+            group = pool.group(slot.gid)
+            with self._cond:
+                group.state = GROUP_HEALTHY
+                group.probe_backend = group.probe_sample = None
         verify_failovers.labels(slot.label, "to_device").inc()
         self._set_state_gauge(slot)
 
-    def _set_state_gauge(self, slot: _BackendSlot) -> None:
+    def _set_state_gauge(self, slot: _BackendSlot,
+                         old_gid: Optional[int] = None) -> None:
         from ..metrics import verify_backend_state
-        verify_backend_state.labels(slot.label).set(_STATE_CODE[slot.state])
+        if old_gid is not None and old_gid != slot.gid:
+            try:        # retire the migrated-away series
+                verify_backend_state.remove(slot.label, str(old_gid))
+            except KeyError:
+                pass
+        verify_backend_state.labels(slot.label, str(slot.gid)).set(
+            _STATE_CODE[slot.state])
 
     # -- watchdog thread ------------------------------------------------------
 
@@ -1115,6 +1514,12 @@ class VerifyService:
             with self._cond:
                 if slot is not None and slot.state == STATE_PROBING:
                     slot.state = STATE_DEGRADED
+                if self._pool is not None:
+                    # a group canary hung mid-probe: the group stays out
+                    from .device_pool import GROUP_FAULTED, GROUP_PROBING
+                    for g in self._pool.groups:
+                        if g.state == GROUP_PROBING:
+                            g.state = GROUP_FAULTED
                 self._probe_thread = None
             if slot is not None:
                 self._set_state_gauge(slot)
@@ -1128,9 +1533,20 @@ class VerifyService:
             elif not req.future.done():
                 req.future.set_exception(DeviceFailure(
                     "device call abandoned twice by the watchdog"))
-            self._ensure_scheduler()
+            self._ensure_scheduler(batch.stream)
             return
-        if slot is not None and slot.can_failover \
+        if batch.sharded and slot is not None \
+                and batch.backend is slot.pool_backend:
+            # a hung pool-wide dispatch: one retry sharded, then fall
+            # back to unsharded dispatch on the slot's own group
+            if batch.requests and not batch.requests[0].retried:
+                for r in batch.requests:
+                    r.retried = True
+                self._requeue(batch.requests)
+            else:
+                self._unshard(slot, batch)
+        elif slot is not None \
+                and (slot.can_failover or self._migratable(slot)) \
                 and batch.backend is slot.primary:
             self._note_fault(slot)
             with self._cond:
@@ -1139,10 +1555,14 @@ class VerifyService:
                     slot.state = STATE_SUSPECT
             self._set_state_gauge(slot)
             if not first_strike:
-                self._degrade(slot, DeviceFailure(
-                    "device dispatch blew its watchdog deadline twice"))
+                # second strike: the group is the failure domain — try a
+                # healthy sibling before degrading to host
+                self._group_fault(slot)
+                if not self._migrate(slot):
+                    self._degrade(slot, DeviceFailure(
+                        "device dispatch blew its watchdog deadline twice"))
             # requeued, not failed — on the device once (the suspect
-            # retry), on the fallback after the second strike
+            # retry), on the sibling/fallback after the second strike
             self._requeue(batch.requests)
         else:
             if batch.requests and not batch.requests[0].retried:
@@ -1156,19 +1576,22 @@ class VerifyService:
                 for r in batch.requests:
                     if not r.future.done():
                         r.future.set_exception(err)
-        self._ensure_scheduler()
+        self._ensure_scheduler(batch.stream)
 
-    def _ensure_scheduler(self) -> None:
-        """Replace a wedged scheduler thread (the tripped dispatch still
-        owns the old one — it exits via the staleness check when the
-        native call eventually returns)."""
+    def _ensure_scheduler(self, stream: Optional[_GroupStream]) -> None:
+        """Replace a wedged group-stream scheduler thread (the tripped
+        dispatch still owns the old one — it exits via the staleness
+        check when the native call eventually returns)."""
+        if stream is None:
+            return
         with self._cond:
             if self._stopped:
                 return
-            if self._thread is not threading.current_thread():
-                self._thread = threading.Thread(
-                    target=self._run, daemon=True, name="verify-scheduler")
-                self._thread.start()
+            if stream.thread is not threading.current_thread():
+                stream.thread = threading.Thread(
+                    target=self._run, args=(stream,), daemon=True,
+                    name=f"verify-scheduler-g{stream.gid}")
+                stream.thread.start()
 
     # -- canary probe ---------------------------------------------------------
 
@@ -1207,6 +1630,7 @@ class VerifyService:
             return False
 
     def _probe_run(self) -> None:
+        from .device_pool import GROUP_FAULTED
         me = threading.current_thread()
         while True:
             with self._cond:
@@ -1214,7 +1638,11 @@ class VerifyService:
                     return
                 degraded = [s for s in self._slots.values()
                             if s.state == STATE_DEGRADED and s.can_failover]
-                if not degraded:
+                faulted = [g for g in (self._pool.groups
+                                       if self._pool is not None else ())
+                           if g.state == GROUP_FAULTED
+                           and g.probe_backend is not None]
+                if not degraded and not faulted:
                     self._probe_thread = None
                     return
             # rate-limited on the injected clock: one canary round per
@@ -1224,6 +1652,8 @@ class VerifyService:
                 return
             for slot in degraded:
                 self._probe_slot(slot)
+            for group in faulted:
+                self._probe_group(group)
 
     def _probe_slot(self, slot: _BackendSlot) -> None:
         """One canary dispatch against the degraded PRIMARY backend.  The
@@ -1266,19 +1696,61 @@ class VerifyService:
                     slot.state = STATE_DEGRADED
             self._set_state_gauge(slot)
 
+    def _probe_group(self, group) -> None:
+        """One canary dispatch against a FAULTED device group, replayed
+        on the backend that was serving there when it faulted (stashed by
+        `_group_fault`) with the same verdict-parity bar as the slot
+        probe.  Success returns the group to the assignment pool — its
+        migrated chains stay where they landed (sticky affinity; new
+        handles and churn rebalance into it), a poisoned group stays
+        out."""
+        from .device_pool import (GROUP_FAULTED, GROUP_HEALTHY,
+                                  GROUP_PROBING)
+        with self._cond:
+            if self._stopped or group.state != GROUP_FAULTED:
+                return
+            group.state = GROUP_PROBING
+            backend, sample = group.probe_backend, group.probe_sample
+        if sample is not None:
+            rounds, sigs, prevs, want = sample
+        else:
+            rounds, sigs, prevs, want = [1], [b""], [None], None
+        marker = _Batch(LANE_LIVE)      # ticket context only
+        ok = False
+        try:
+            out = self._guarded(
+                None, marker,
+                lambda: self._call_verify(backend, rounds, sigs, prevs),
+                kind="probe")
+            ok = want is None or bool(out[0]) == want
+        except _Abandoned:
+            return      # the watchdog reset us and replaced this thread
+        except BaseException:
+            ok = False
+        with self._cond:
+            if group.state != GROUP_PROBING:
+                return
+            group.state = GROUP_HEALTHY if ok else GROUP_FAULTED
+            if ok:
+                group.probe_backend = group.probe_sample = None
+                group.faulted_at = None
+
     # -- preemption / packing -------------------------------------------------
 
     def _maybe_preempt(self, batch: _Batch) -> None:
         """At a chunk boundary of BACKGROUND work, run any queued LIVE
-        work to completion first.  Live batches never preempt, so the
-        recursion depth is bounded at two."""
+        work of THIS group's stream to completion first (other groups'
+        live work runs on their own streams — no cross-group contention
+        to yield to).  Live batches never preempt, so the recursion depth
+        is bounded at two."""
         from ..metrics import verify_preemptions
-        if batch.lane == LANE_LIVE:
+        stream = batch.stream
+        if batch.lane == LANE_LIVE or stream is None:
             return
         with self._cond:
-            if self._thread is not threading.current_thread():
+            if stream.thread is not threading.current_thread():
                 return      # stale (abandoned) executor: not our queue
-            pending = bool(self._queues[LANE_LIVE])
+            pending = bool(stream.queues[LANE_LIVE])
             if pending:
                 self._preemptions += 1
         if not pending:
@@ -1286,25 +1758,33 @@ class VerifyService:
         verify_preemptions.inc()
         while True:
             with self._cond:
-                if self._thread is not threading.current_thread():
+                if stream.thread is not threading.current_thread():
                     return
-            live = self._try_next(LANE_LIVE)
+            live = self._try_next(stream, LANE_LIVE)
             if live is None:
                 return
             self._execute(live)
 
-    def _ensure_packer(self):
-        if self._packer is None:
+    def _ensure_packer(self, stream: Optional[_GroupStream]):
+        """Per-stream packer: k groups pack k chunks concurrently (host
+        packing is numpy + native hash-to-field, which release the GIL)."""
+        if stream is None:
+            with self._cond:
+                stream = self._stream_locked(0)
+        if stream.packer is None:
             from concurrent.futures import ThreadPoolExecutor
-            self._packer = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="verify-packer")
-        return self._packer
+            stream.packer = ThreadPoolExecutor(
+                max_workers=1,
+                thread_name_prefix=f"verify-packer-g{stream.gid}")
+        return stream.packer
 
     def _account(self, lane: str, lanes: int, slots: int,
-                 elapsed: float, slot: Optional[_BackendSlot] = None) -> None:
+                 elapsed: float, slot: Optional[_BackendSlot] = None,
+                 gid: Optional[int] = None, sharded: bool = False) -> None:
         from ..metrics import (verify_dispatch_latency, verify_dispatches,
                                verify_fill_ratio)
-        verify_dispatches.labels(lane).inc()
+        verify_dispatches.labels(lane, str(gid if gid is not None
+                                           else 0)).inc()
         verify_fill_ratio.observe(lanes / max(1, slots))
         verify_dispatch_latency.labels(lane, "device").observe(
             max(0.0, elapsed))
@@ -1313,6 +1793,11 @@ class VerifyService:
             self._dispatch_lanes += lanes
             self._dispatch_slots += slots
             self._device_time += max(0.0, elapsed)
+            if sharded:
+                self._sharded_dispatches += 1
+            st = self._streams.get(gid) if gid is not None else None
+            if st is not None:
+                st.dispatches += 1
             if slot is not None:
                 # the latency history the watchdog deadline derives from
                 slot.latencies.append(max(0.0, elapsed))
@@ -1341,7 +1826,13 @@ class VerifyService:
     # -- observability / lifecycle -------------------------------------------
 
     def stats(self) -> dict:
+        pool = self._pool
+        groups = pool.snapshot() if pool is not None else {}
         with self._cond:
+            for gid, g in groups.items():
+                st = self._streams.get(gid)
+                g["dispatches"] = st.dispatches if st is not None else 0
+                g["inflight_max"] = st.inflight_max if st is not None else 0
             return {
                 "submitted": self._submitted,
                 "dispatches": self._dispatches,
@@ -1365,8 +1856,19 @@ class VerifyService:
                 "inflight_depth_max": self._inflight_max,
                 "tuning": {s.label: {"pad": s.pad, "depth": s.depth}
                            for s in self._slots.values()},
-                "queue_depth": {ln: len(self._queues[ln]) for ln in LANES},
+                "queue_depth": {ln: self._qdepth_locked(ln)
+                                for ln in LANES},
                 "background_paused": self._bg_paused,
+                # multi-device scale-out (ISSUE 11): the device pool view,
+                # chain→group affinity, and the concurrency/sharding proof
+                "n_devices": pool.n_devices if pool is not None else 0,
+                "n_groups": pool.n_groups if pool is not None else 0,
+                "groups": groups,
+                "group_map": {s.label: s.gid
+                              for s in self._slots.values()},
+                "migrations": self._migrations,
+                "sharded_dispatches": self._sharded_dispatches,
+                "concurrent_streams_max": self._concurrent_max,
             }
 
     def set_background_paused(self, paused: bool) -> None:
@@ -1401,11 +1903,22 @@ class VerifyService:
                 f"queue={q[LANE_LIVE]}/{q[LANE_BACKGROUND]} "
                 f"inflight<={s['inflight_depth_max']} "
                 f"qt/dt={s['queue_time_s']:.1f}/{s['device_time_s']:.1f}s")
+        if s["n_groups"]:
+            line += (f" groups={s['n_groups']}"
+                     f"x{max(1, s['n_devices']) // max(1, s['n_groups'])}dev")
+        if s["sharded_dispatches"]:
+            line += f" sharded={s['sharded_dispatches']}"
+        if s["migrations"]:
+            line += f" migrations={s['migrations']}"
         if s["failovers"] or s["watchdog_trips"]:
             line += (f" failovers={s['failovers']}"
                      f" trips={s['watchdog_trips']}")
         if s["background_paused"]:
             line += " BG-PAUSED"
+        bad_groups = sorted(str(gid) for gid, g in s["groups"].items()
+                            if g["state"] != "healthy")
+        if bad_groups:
+            line += " GROUP-FAULTED=g" + ",g".join(bad_groups)
         deg = self.degraded_backends()
         if deg:
             line += " DEGRADED=" + ",".join(deg)
@@ -1414,10 +1927,19 @@ class VerifyService:
     def stop(self) -> None:
         with self._cond:
             self._stopped = True
-            drained = [r for ln in LANES for r in self._queues[ln]]
-            for ln in LANES:
-                self._queues[ln] = deque()
-            thread, self._thread = self._thread, None
+            drained = []
+            threads = []
+            packers = []
+            for st in self._streams.values():
+                for ln in LANES:
+                    drained.extend(st.queues[ln])
+                    st.queues[ln] = deque()
+                if st.thread is not None:
+                    threads.append(st.thread)
+                    st.thread = None
+                if st.packer is not None:
+                    packers.append(st.packer)
+                    st.packer = None
             wd, self._watchdog_thread = self._watchdog_thread, None
             probe, self._probe_thread = self._probe_thread, None
             # cancel in-flight tickets so the watchdog exits and any
@@ -1429,11 +1951,10 @@ class VerifyService:
         for r in drained:
             if not r.future.done():
                 r.future.set_exception(RuntimeError("verify service stopped"))
-        for t in (thread, wd, probe):
+        for t in threads + [wd, probe]:
             if t is not None and t is not threading.current_thread():
                 t.join(timeout=5)
-        packer, self._packer = self._packer, None
-        if packer is not None:
+        for packer in packers:
             packer.shutdown(wait=False)
 
 

@@ -399,6 +399,20 @@ class RestServer:
             payload["verify_latency_split"] = {
                 "queue_s": round(st["queue_time_s"], 3),
                 "device_s": round(st["device_time_s"], 3)}
+            # multi-device scale-out (ISSUE 11): the device-group view —
+            # group count/size, per-group state + dispatch counters and
+            # the chain→group affinity map, so a faulted group (and which
+            # chains it serves) is visible without a metrics scrape
+            if st["n_groups"]:
+                payload["verify_groups"] = {
+                    "n_groups": st["n_groups"],
+                    "n_devices": st["n_devices"],
+                    "groups": {str(g): info
+                               for g, info in st["groups"].items()},
+                    "group_map": st["group_map"],
+                    "sharded_dispatches": st["sharded_dispatches"],
+                    "migrations": st["migrations"],
+                }
             # the failure-domain degraded line: name every backend that is
             # currently failed over to the host path (or mid-probe) so an
             # operator scraping /health sees accelerator loss immediately

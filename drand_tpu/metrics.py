@@ -124,8 +124,9 @@ verify_requests = Counter(
     ["lane"], registry=PRIVATE)
 verify_dispatches = Counter(
     "verify_service_dispatches_total",
-    "Device/host dispatches issued by the verify service",
-    ["lane"], registry=PRIVATE)
+    "Device/host dispatches issued by the verify service "
+    "(group = the device group whose stream dispatched)",
+    ["lane", "group"], registry=PRIVATE)
 verify_queue_depth = Gauge(
     "verify_service_queue_depth",
     "Requests waiting in a verify-service lane", ["lane"],
@@ -163,7 +164,15 @@ verify_failovers = Counter(
 verify_backend_state = Gauge(
     "verify_service_backend_state",
     "Verify backend failover state (0 healthy, 1 suspect, 2 degraded, "
-    "3 probing)", ["chain"], registry=PRIVATE)
+    "3 probing); group = the chain's device-group affinity",
+    ["chain", "group"], registry=PRIVATE)
+# Multi-device scale-out (crypto/device_pool.py): one series per device
+# group — how many devices it owns.  Group membership is static for a
+# process; the gauge going to a new label set means the pool was rebuilt.
+verify_group_devices = Gauge(
+    "verify_service_group_devices",
+    "Devices owned by each verify-service device group",
+    ["group"], registry=PRIVATE)
 verify_watchdog_trips = Counter(
     "verify_service_watchdog_trips_total",
     "Device dispatches abandoned after blowing their watchdog deadline",

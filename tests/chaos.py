@@ -46,6 +46,7 @@ Building blocks:
 import hashlib
 import random
 import threading
+import types
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -933,6 +934,163 @@ class DeviceFailoverSyncScenario:
         finally:
             self.device.release.set()
             self.svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# multi-device group isolation (ISSUE 11): one group's induced device
+# fault must degrade ONLY that group — its chain fails over to a healthy
+# sibling group (or host), while every other chain's verdicts, backend
+# state and latency history stay untouched.
+# ---------------------------------------------------------------------------
+
+
+class _RuleBackend:
+    """Deterministic stub verdict backend (sig == b"sig-<round>") with
+    per-backend dispatch accounting, for scheduler-level group scenarios
+    that need zero crypto."""
+
+    kind = "device"
+
+    def __init__(self):
+        self.calls: List[list] = []
+
+    def verify_batch(self, rounds, sigs, prev_sigs=None):
+        import numpy as np
+        self.calls.append(list(rounds))
+        return np.array([s == b"sig-%d" % r for r, s in zip(rounds, sigs)],
+                        dtype=bool)
+
+
+@dataclass
+class GroupIsolationResult:
+    all_resolved: bool
+    verdicts_match: bool              # every chain == the stub rule
+    victim_failed_over: bool          # sibling migration OR host degrade
+    victim_final_state: str
+    faulted_groups: List[int]         # must be exactly the victim's group
+    victim_group: int
+    sibling_states: List[str]
+    siblings_untouched: bool          # no extra dispatches/latency samples
+    migrations: int
+    failovers: int
+
+    @property
+    def ok(self) -> bool:
+        return (self.all_resolved and self.verdicts_match
+                and self.victim_failed_over
+                and self.faulted_groups == [self.victim_group]
+                and all(s == "healthy" for s in self.sibling_states)
+                and self.siblings_untouched)
+
+
+class GroupIsolationScenario:
+    """k chains on k device groups; the victim chain's group dies (its
+    backend raises on every dispatch from the fault point on).  The
+    failover order is group→sibling→host: with a healthy sibling
+    available the victim's backend is REBUILT there (never touching the
+    host path) and every other group keeps serving undisturbed."""
+
+    def __init__(self, seed: int, chains: int = 4, rounds_per_chain: int = 8,
+                 siblings_available: bool = True):
+        from drand_tpu.crypto.verify_service import VerifyService
+
+        self.seed = seed
+        self.k = chains
+        self.n = rounds_per_chain
+        self.clock = AutoClock(start=1_000.0)
+        self.siblings_available = siblings_available
+        self.svc = VerifyService(
+            clock=self.clock, pad=8, background_window=0.0,
+            watchdog_floor=30.0, probe_interval=5.0,
+            device_groups=chains if siblings_available else 1)
+        dice = random.Random(stable_seed(seed, "group-isolation"))
+        self.victim = dice.randrange(chains)
+        self.plan = DeviceFaultPlan(seed=stable_seed(seed, "group-kill"),
+                                    die_after=1, down_mode=DEVICE_RAISE)
+        self.backends: Dict[int, list] = {i: [] for i in range(chains)}
+        self.handles = []
+        for i in range(chains):
+            self.handles.append(self.svc.handle(
+                types.SimpleNamespace(id=f"chaos-chain-{i}"),
+                bytes([i + 1]) * 48,
+                backend_factory=self._factory(i),
+                fallback=_RuleBackend()))
+        self.victim_gid0 = self.handles[self.victim].gid
+
+    def _factory(self, i):
+        def build(group):
+            if i == self.victim and not self.backends[i]:
+                # the victim group's device: healthy for dispatch #0,
+                # dead for good afterwards (the seeded kill switch)
+                b = FaultyDeviceBackend(_RuleBackend(), self.plan,
+                                        self.clock)
+            else:
+                b = _RuleBackend()      # sibling rebuilds land healthy
+            self.backends[i].append(b)
+            return b
+        return build
+
+    def _workload(self, i, phase):
+        dice = random.Random(stable_seed(self.seed, "forge", i, phase))
+        rounds = list(range(1, self.n + 1))
+        forged = set(dice.sample(rounds, 2))
+        sigs = [b"sig-%d" % r if r not in forged else b"forged"
+                for r in rounds]
+        return rounds, sigs, [r in forged for r in rounds]
+
+    def run(self) -> GroupIsolationResult:
+        import numpy as np
+
+        futs = []       # (chain, expected_bad, future)
+        # phase 1: every chain healthy (the victim's dispatch #0)
+        for i, h in enumerate(self.handles):
+            rounds, sigs, bad = self._workload(i, 1)
+            futs.append((i, bad, h.submit(rounds, sigs, flush_now=True)))
+        for _, _, f in futs:
+            f.result(30)
+        # phase 2: the victim group is dead — mixed lanes across chains
+        for i, h in enumerate(self.handles):
+            rounds, sigs, bad = self._workload(i, 2)
+            lane = "live" if i % 2 else "background"
+            futs.append((i, bad, h.submit(rounds, sigs, lane=lane,
+                                          flush_now=True)))
+        all_resolved = True
+        verdicts_match = True
+        for i, bad, f in futs:
+            try:
+                got = f.result(30)
+            except Exception:
+                all_resolved = False
+                continue
+            want = np.array([not b for b in bad])
+            verdicts_match &= bool((got == want).all())
+        st = self.svc.stats()
+        victim_slot = self.svc._slots[self.handles[self.victim].key]
+        sibling_slots = [self.svc._slots[h.key]
+                         for i, h in enumerate(self.handles)
+                         if i != self.victim]
+        faulted = sorted(g for g, info in st["groups"].items()
+                         if info["state"] != "healthy")
+        # siblings untouched: each served exactly its own 2 submissions,
+        # on its own group, with exactly 2 latency samples
+        untouched = all(
+            s.state == "healthy" and len(s.latencies) == 2
+            and len(self.backends[i][0].calls) == 2
+            for s, i in zip(sibling_slots,
+                            [i for i in range(self.k) if i != self.victim]))
+        self.svc.stop()
+        return GroupIsolationResult(
+            all_resolved=all_resolved,
+            verdicts_match=verdicts_match,
+            victim_failed_over=(st["migrations"] >= 1
+                                or st["failovers"] >= 1),
+            victim_final_state=victim_slot.state,
+            faulted_groups=faulted,
+            victim_group=self.victim_gid0,
+            sibling_states=[s.state for s in sibling_slots],
+            siblings_untouched=untouched,
+            migrations=st["migrations"],
+            failovers=st["failovers"])
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,14 @@
+"""Negative case for the verifier checker's device-enumeration rule:
+this file's rel is crypto/device_pool.py — the ONE module that owns
+device inventory — so raw enumeration here is the sanctioned call site
+and must NOT be flagged."""
+
+import jax
+
+
+def sanctioned_enumeration():
+    return jax.devices()                            # allowed (the pool)
+
+
+def sanctioned_local_enumeration():
+    return jax.local_devices()                      # allowed (the pool)

@@ -1,0 +1,15 @@
+"""Scoping case for the device-enumeration rule: crypto/ exempts raw
+BatchBeaconVerifier construction, but enumeration is allowed ONLY in
+crypto/device_pool.py — this sibling module must still be flagged."""
+
+import jax
+
+from drand_tpu.crypto.batch import BatchBeaconVerifier
+
+
+def construction_is_fine_here(scheme, pk):
+    return BatchBeaconVerifier(scheme, pk)          # allowed: crypto/
+
+
+def enumeration_is_not():
+    return jax.devices()                            # VIOLATION: not the pool

@@ -37,3 +37,33 @@ def host_fallback_is_fine(scheme, pk):
 def suppressed(scheme, pk):
     # tpu-vet: disable=verifier
     return BatchBeaconVerifier(scheme, pk)
+
+
+# -- device enumeration (ISSUE 11): only crypto/device_pool.py may call
+# jax.devices()/jax.local_devices() — everything below must be flagged
+
+import jax
+from jax import devices as jdevs
+
+
+def direct_enumeration():
+    return jax.devices()                            # VIOLATION
+
+
+def local_enumeration():
+    return jax.local_devices()                      # VIOLATION
+
+
+def aliased_enumeration():
+    return jdevs()                                  # VIOLATION: alias
+
+
+def pool_route_is_fine():
+    # the sanctioned path: NOT flagged
+    from drand_tpu.crypto.device_pool import jax_devices
+    return jax_devices()
+
+
+def suppressed_enumeration():
+    # tpu-vet: disable=verifier  (dryrun tooling probes the raw backend)
+    return jax.devices()

@@ -1,0 +1,395 @@
+"""Multi-device scale-out (ISSUE 11): per-handle device groups,
+independent dispatch streams, group-isolated failover, and pool-wide
+round-axis sharding — the CPU suite on the 8 virtual devices conftest
+forces (`XLA_FLAGS=--xla_force_host_platform_device_count=8`).
+
+Scheduler-level tests run against stub backends (no compiles); the real
+jax surface is exercised placement-only (device_put, no programs) in
+test_verify_service.test_device_backend_gets_group_placement_and_pool_
+sharding, and the sharded RLC program itself by the heavy-bucket
+test_multichip.py.
+"""
+
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from drand_tpu.beacon.clock import FakeClock
+from drand_tpu.crypto.device_pool import (DevicePool, GROUP_FAULTED,
+                                          GROUP_HEALTHY, jax_devices)
+from drand_tpu.crypto.verify_service import (LANE_BACKGROUND, LANE_LIVE,
+                                             VerifyService)
+
+SCHEME = types.SimpleNamespace(id="stub-scheme")
+
+
+def pk(i: int) -> bytes:
+    return bytes([i]) * 48
+
+
+def stub_rule(round_, sig):
+    return sig == b"sig-%d" % round_
+
+
+def beacons(rng, bad=()):
+    rounds = list(rng)
+    sigs = [b"sig-%d" % r if r not in bad else b"forged" for r in rounds]
+    return rounds, sigs, [None] * len(rounds)
+
+
+class StubBackend:
+    kind = "stub"
+
+    def __init__(self):
+        self.calls = []
+        self.started = threading.Event()
+
+    def verify_batch(self, rounds, sigs, prev_sigs=None):
+        self.calls.append(list(rounds))
+        self.started.set()
+        return np.array([stub_rule(r, s) for r, s in zip(rounds, sigs)],
+                        dtype=bool)
+
+
+def make_service(**kw):
+    kw.setdefault("clock", FakeClock(1000.0))
+    kw.setdefault("pad", 8)
+    kw.setdefault("background_window", 0.0)
+    return VerifyService(**kw)
+
+
+# -- device pool --------------------------------------------------------------
+
+
+def test_pool_partitions_devices_into_groups():
+    devs = jax_devices()
+    assert len(devs) == 8, "conftest must force 8 virtual CPU devices"
+    pool = DevicePool()                     # AUTO: one group per device
+    assert pool.n_groups == 8 and pool.n_devices == 8
+    assert all(g.n_devices == 1 for g in pool.groups)
+    seen = [d for g in pool.groups for d in g.devices]
+    assert len(set(map(id, seen))) == 8     # a partition, not copies
+    quad = DevicePool(n_groups=4)
+    assert quad.n_groups == 4
+    assert [g.n_devices for g in quad.groups] == [2, 2, 2, 2]
+    assert dict(quad.pool_sharding().mesh.shape)["round"] == 8
+
+
+def test_pool_assignment_is_sticky_and_least_loaded():
+    pool = DevicePool(n_groups=4)
+    gids = [pool.assign(("k", i)).gid for i in range(8)]
+    assert sorted(gids) == [0, 0, 1, 1, 2, 2, 3, 3]
+    assert pool.assign(("k", 3)).gid == gids[3]     # sticky
+    # churn rebalances: release group-0 tenants, the next handles refill it
+    for i, g in enumerate(gids):
+        if g == 0:
+            pool.release(("k", i))
+    assert pool.assign(("k", "new-a")).gid == 0
+    assert pool.assign(("k", "new-b")).gid == 0
+    assert pool.loads() == {0: 2, 1: 2, 2: 2, 3: 2}
+
+
+def test_pool_reassign_avoids_faulted_groups():
+    pool = DevicePool(n_groups=3)
+    g = pool.assign("key")
+    g.state = GROUP_FAULTED
+    sib = pool.reassign("key")
+    assert sib is not None and sib.gid != g.gid
+    assert sib.state == GROUP_HEALTHY
+    # all faulted -> nowhere to go
+    for grp in pool.groups:
+        grp.state = GROUP_FAULTED
+    assert pool.reassign("key") is None
+
+
+# -- k chains, k groups, overlapping windows (the ISSUE acceptance) -----------
+
+
+def run_workload(svc, n_chains=8, gate_pair=None):
+    """n_chains handles, one submission each; returns (handles, verdicts).
+    `gate_pair` (i, j) wires chains i and j with backends that each BLOCK
+    until the other's dispatch has started — resolvable only if the two
+    groups' streams really dispatch concurrently."""
+    handles = []
+    backends = []
+    for i in range(n_chains):
+        b = StubBackend()
+        if gate_pair is not None and i in gate_pair:
+            other = gate_pair[1] if i == gate_pair[0] else gate_pair[0]
+
+            class Gated(StubBackend):
+                def __init__(self, me_i, other_i, all_backends):
+                    super().__init__()
+                    self.me_i, self.other_i = me_i, other_i
+                    self.all = all_backends
+
+                def verify_batch(self, rounds, sigs, prev_sigs=None):
+                    self.started.set()
+                    assert self.all[self.other_i].started.wait(20), (
+                        "the sibling group's dispatch never started — "
+                        "streams are serialized, not concurrent")
+                    return super().verify_batch(rounds, sigs, prev_sigs)
+
+            b = Gated(i, other, backends)
+        backends.append(b)
+        handles.append(svc.handle(SCHEME, pk(i), backend=b))
+    futs = [h.submit(*beacons(range(1, 9), bad={2 + i}), lane=LANE_LIVE)
+            for i, h in enumerate(handles)]
+    verdicts = [f.result(30) for f in futs]
+    return handles, verdicts
+
+
+def test_8_handles_dispatch_through_independent_groups():
+    """8 chains land on 8 distinct device groups with CONCURRENTLY
+    in-flight windows (two gated chains each block until the other's
+    dispatch starts — deadlock unless the streams overlap), and the
+    verdicts are bit-identical to the single-group (old single-device)
+    path."""
+    svc = make_service()
+    handles, verdicts = run_workload(svc, 8, gate_pair=(0, 5))
+    st = svc.stats()
+    gids = {h.gid for h in handles}
+    assert len(gids) >= 2, st["group_map"]
+    assert len(gids) == 8                   # AUTO: one group per device
+    assert st["n_groups"] == 8 and st["n_devices"] == 8
+    assert st["concurrent_streams_max"] >= 2
+    # every group really dispatched (per-group streams, not one shared)
+    dispatched = {g for g, info in st["groups"].items()
+                  if info["dispatches"] > 0}
+    assert len(dispatched) == 8
+    svc.stop()
+
+    single = make_service(device_groups=1)
+    _, single_verdicts = run_workload(single, 8)
+    assert single.stats()["n_groups"] == 1
+    for got, want in zip(verdicts, single_verdicts):
+        assert (got == want).all()          # bit-identical to 1-group path
+    single.stop()
+
+
+# -- group-isolated failover --------------------------------------------------
+
+
+class DeadBackend(StubBackend):
+    def verify_batch(self, rounds, sigs, prev_sigs=None):
+        self.calls.append(list(rounds))
+        raise ConnectionError("device gone")
+
+
+def test_one_groups_fault_degrades_only_that_group():
+    """Kill one chain's backend: it degrades to its host fallback; the
+    other chains' verdicts, backend states and latency histories are
+    untouched."""
+    svc = make_service()
+    dead, fb = DeadBackend(), StubBackend()
+    h_bad = svc.handle(SCHEME, pk(0), backend=dead, fallback=fb)
+    healthy = [(svc.handle(SCHEME, pk(i), backend=StubBackend()), i)
+               for i in range(1, 5)]
+    assert h_bad.verify_batch(*beacons([1, 2], bad={2})).tolist() \
+        == [True, False]                    # via the fallback, requeued
+    for h, i in healthy:
+        assert h.verify_batch(*beacons(range(1, 5))).all()
+    st = svc.stats()
+    assert st["failovers"] == 1
+    assert svc.degraded_backends() == [svc._slots[h_bad.key].label]
+    for h, _ in healthy:
+        slot = svc._slots[h.key]
+        assert slot.state == "healthy"
+        assert len(slot.latencies) == 1     # its own dispatch, nothing else
+        assert slot.gid != h_bad.gid        # distinct failure domains
+    svc.stop()
+
+
+def test_group_fault_fails_over_to_sibling_group_before_host():
+    """A group-backed handle (backend_factory) whose group faults is
+    REBUILT on a healthy sibling group — the slot stays healthy, never
+    sees the host path, and the faulted group is quarantined."""
+    built = []
+
+    def factory(group):
+        b = DeadBackend() if not built else StubBackend()
+        built.append((group.gid, b))
+        return b
+
+    svc = make_service(device_groups=4)
+    h = svc.handle(SCHEME, pk(0), backend_factory=factory)
+    old_gid = h.gid
+    ok = h.verify_batch(*beacons([1, 2, 3], bad={3}))
+    assert ok.tolist() == [True, True, False]
+    st = svc.stats()
+    assert st["migrations"] == 1
+    assert st["failovers"] == 0             # host path never taken
+    slot = svc._slots[h.key]
+    assert slot.state == "healthy"
+    assert h.gid != old_gid                 # moved to the sibling
+    assert len(built) == 2 and built[1][0] == h.gid
+    assert isinstance(slot.primary, StubBackend) \
+        and not isinstance(slot.primary, DeadBackend)
+    assert st["groups"][old_gid]["state"] == "faulted"
+    assert st["groups"][h.gid]["state"] == "healthy"
+    svc.stop()
+
+
+def test_group_fault_degrades_to_host_when_no_healthy_sibling():
+    """device_groups=1: there is no sibling — the ladder's last rung
+    (host fallback) serves, exactly the pre-pool behavior."""
+    fb = StubBackend()
+    svc = make_service(device_groups=1)
+    h = svc.handle(SCHEME, pk(0),
+                   backend_factory=lambda g: DeadBackend(), fallback=fb)
+    assert h.verify_batch(*beacons([1, 2])).all()
+    st = svc.stats()
+    assert st["migrations"] == 0 and st["failovers"] == 1
+    assert fb.calls == [[1, 2]]
+    assert st["groups"][0]["state"] == "faulted"
+    svc.stop()
+
+
+# -- pool-wide round-axis sharding for huge batches ---------------------------
+
+
+class PoolStub(StubBackend):
+    """Stands in for the pool-wide sharded BatchBeaconVerifier."""
+    pad_to = 64
+
+
+def test_huge_batch_routes_to_pool_sharded_backend():
+    group_stub, pool_stub = StubBackend(), PoolStub()
+    svc = make_service(shard_threshold=32)
+    h = svc.handle(SCHEME, pk(0), backend=group_stub,
+                   pool_backend=pool_stub)
+    # under the threshold: the handle's own group serves
+    assert h.verify_batch(*beacons(range(1, 11))).all()
+    assert len(group_stub.calls) == 2 and not pool_stub.calls
+    # at/over the threshold: ONE pool-wide dispatch (span = pool pad 64)
+    big = beacons(range(1, 41), bad={7, 33})
+    ok = h.submit(*big, lane=LANE_BACKGROUND).result(30)
+    assert len(ok) == 40 and not ok[6] and not ok[32] and ok.sum() == 38
+    assert pool_stub.calls == [list(range(1, 41))]
+    assert len(group_stub.calls) == 2       # untouched by the huge batch
+    st = svc.stats()
+    assert st["sharded_dispatches"] == 1
+    # bit-identical to the unsharded path
+    svc2 = make_service()                   # no pool backend: never shards
+    h2 = svc2.handle(SCHEME, pk(0), backend=StubBackend())
+    want = h2.verify_batch(*big)
+    assert (ok == want).all()
+    svc2.stop()
+    svc.stop()
+
+
+def test_sharded_dispatch_fault_falls_back_to_unsharded():
+    """A faulting pool-wide dispatch retries once, then the riders are
+    requeued UNSHARDED on the slot's own group — requeued, never
+    failed — and sharding stays off for the slot until re-promotion."""
+    class DeadPool(PoolStub):
+        def verify_batch(self, rounds, sigs, prev_sigs=None):
+            self.calls.append(list(rounds))
+            raise ConnectionError("collective wedged")
+
+    group_stub, pool_stub = StubBackend(), DeadPool()
+    svc = make_service(shard_threshold=16)
+    h = svc.handle(SCHEME, pk(0), backend=group_stub,
+                   pool_backend=pool_stub)
+    ok = h.submit(*beacons(range(1, 21), bad={4})).result(30)
+    assert len(ok) == 20 and not ok[3] and ok.sum() == 19
+    assert len(pool_stub.calls) == 2        # original + the one retry
+    assert [len(c) for c in group_stub.calls] == [8, 8, 4]  # unsharded
+    assert not svc._slots[h.key].pool_ok
+    # inside the cooldown: huge submissions skip sharding entirely
+    assert h.verify_batch(*beacons(range(1, 21))).all()
+    assert len(pool_stub.calls) == 2
+    # past the probe-cadence cooldown sharding re-arms (one transient
+    # collective fault must not pin huge batches to one group forever);
+    # this pool backend still faults, so it re-disarms after its retry
+    svc.clock.advance(svc.probe_interval + 1.0)
+    assert h.verify_batch(*beacons(range(1, 21))).all()
+    assert len(pool_stub.calls) == 4        # re-armed: original + retry
+    assert not svc._slots[h.key].pool_ok    # ... and re-disarmed
+    svc.stop()
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_stats_and_summary_carry_group_view():
+    svc = make_service(device_groups=2)
+    h0 = svc.handle(SCHEME, pk(0), backend=StubBackend())
+    h1 = svc.handle(SCHEME, pk(1), backend=StubBackend())
+    assert h0.verify_batch(*beacons([1])).all()
+    assert h1.verify_batch(*beacons([2], bad={2})).tolist() == [False]
+    st = svc.stats()
+    assert st["n_groups"] == 2 and st["n_devices"] == 8
+    assert sorted(st["group_map"].values()) == [0, 1]
+    assert st["groups"][0]["devices"] == 4
+    assert st["groups"][0]["dispatches"] == 1
+    assert st["groups"][1]["dispatches"] == 1
+    s = svc.summary()
+    assert "groups=2x4dev" in s
+    svc.stop()
+
+
+def test_group_metrics_series_exist():
+    from drand_tpu import metrics
+    metrics.verify_group_devices.labels("0").set(4)
+    metrics.verify_dispatches.labels("live", "3").inc()
+    metrics.verify_backend_state.labels("stub:chain", "2").set(0)
+    blob = metrics.scrape("private").decode()
+    assert 'verify_service_group_devices{group="0"} 4.0' in blob
+    assert ('verify_service_dispatches_total{group="3",lane="live"}'
+            in blob)
+    assert ('verify_service_backend_state{chain="stub:chain",group="2"}'
+            in blob)
+
+
+# -- seeded group-isolation chaos (the ISSUE 11 acceptance scenario) ----------
+
+
+def test_group_isolation_chaos_scenario():
+    """One group's induced device fault degrades ONLY that group: the
+    victim chain migrates to a healthy sibling group (host path never
+    taken), every sibling chain's verdicts/state/latencies untouched."""
+    from chaos import GroupIsolationScenario
+
+    result = GroupIsolationScenario(seed=4242, chains=4).run()
+    assert result.all_resolved
+    assert result.verdicts_match
+    assert result.victim_failed_over
+    assert result.migrations >= 1 and result.failovers == 0
+    assert result.victim_final_state == "healthy"   # sibling, not host
+    assert result.faulted_groups == [result.victim_group]
+    assert result.siblings_untouched
+    assert result.ok
+
+
+def test_group_isolation_without_siblings_degrades_to_host():
+    from chaos import GroupIsolationScenario
+
+    result = GroupIsolationScenario(seed=7, chains=3,
+                                    siblings_available=False).run()
+    assert result.all_resolved and result.verdicts_match
+    assert result.migrations == 0 and result.failovers >= 1
+    assert result.victim_final_state == "degraded"
+
+
+def test_group_isolation_scenario_is_seed_deterministic():
+    from chaos import GroupIsolationScenario
+
+    r1 = GroupIsolationScenario(seed=99, chains=4).run()
+    r2 = GroupIsolationScenario(seed=99, chains=4).run()
+    assert r1.ok and r2.ok
+    assert r1.victim_group == r2.victim_group
+    assert r1.migrations == r2.migrations
+
+
+def test_release_handle_frees_the_group_assignment():
+    svc = make_service(device_groups=4)
+    handles = [svc.handle(SCHEME, pk(i), backend=StubBackend())
+               for i in range(4)]
+    gid0 = handles[0].gid
+    svc.release_handle(handles[0])
+    h_new = svc.handle(SCHEME, pk(9), backend=StubBackend())
+    assert h_new.gid == gid0                # churn rebalanced into the gap
+    svc.stop()

@@ -193,7 +193,8 @@ def test_watchdog_trips_only_the_oldest_ticket_per_slot():
     trips = []
     svc._trip = lambda t: trips.append(t)      # observe, don't failover
     with svc._cond:
-        svc._ensure_threads_locked()           # start the watchdog
+        # start the watchdog (via the slot's group stream)
+        svc._ensure_threads_locked(svc._stream_locked(slot.gid))
         svc._tickets[id(old)] = old
         svc._tickets[id(young)] = young
         svc._cond.notify_all()
@@ -295,6 +296,49 @@ def test_tuning_malformed_file_is_ignored(tmp_path, monkeypatch):
     monkeypatch.delenv("DRAND_VERIFY_PAD", raising=False)
     monkeypatch.delenv("DRAND_VERIFY_PIPELINE_DEPTH", raising=False)
     assert tuning.resolve("g1", "cpu")[:2] == (DEFAULT_PAD, 1)
+
+
+def test_tuning_resolve_keyed_by_group_size(tmp_path, monkeypatch):
+    """ISSUE 11: a `<kind>@<n>` entry is the n-device-group winner and
+    beats the bare kind; sizes with no sweep fall back to the bare kind
+    (the legacy 1-device spelling)."""
+    tf = tmp_path / "TUNING.json"
+    with open(tf, "w") as f:
+        json.dump({"version": 1, "entries": {"cpu": {
+            "g1": {"pad": 64, "depth": 1},
+            "g1@4": {"pad": 256, "depth": 2}}}}, f)
+    monkeypatch.setenv("DRAND_TUNING_FILE", str(tf))
+    monkeypatch.delenv("DRAND_VERIFY_PAD", raising=False)
+    monkeypatch.delenv("DRAND_VERIFY_PIPELINE_DEPTH", raising=False)
+    assert tuning.resolve("g1", "cpu", group_size=1)[:2] == (64, 1)
+    assert tuning.resolve("g1", "cpu", group_size=4)[:2] == (256, 2)
+    # no @2 sweep: the bare-kind fallback serves
+    assert tuning.resolve("g1", "cpu", group_size=2)[:2] == (64, 1)
+    # a different-platform @4 entry never leaks
+    assert tuning.resolve("g1", "tpu", group_size=4)[:2] \
+        == (DEFAULT_PAD, 1)
+
+
+def test_service_resolves_tuning_for_its_group_size(tmp_path, monkeypatch):
+    """A handle whose device group owns 2 devices resolves the g1@2
+    winner, not the 1-device entry."""
+    import jax
+    tf = tmp_path / "TUNING.json"
+    with open(tf, "w") as f:
+        json.dump({"version": 1, "entries": {jax.default_backend(): {
+            "g1": {"pad": 4, "depth": 1},
+            "g1@2": {"pad": 6, "depth": 2}}}}, f)
+    monkeypatch.setenv("DRAND_TUNING_FILE", str(tf))
+    monkeypatch.delenv("DRAND_VERIFY_PAD", raising=False)
+    monkeypatch.delenv("DRAND_VERIFY_PIPELINE_DEPTH", raising=False)
+    svc = make_service(pad=0, device_groups=4)     # 8 devices -> 2 each
+    stub = PipelinedStub()
+    h = svc.handle(SCHEME, PK, backend=stub)
+    assert h.verify_batch(*beacons(range(1, 11))).all()
+    assert [len(c) for c in stub.calls] == [6, 4]  # the @2 pad drives
+    tun = next(iter(svc.stats()["tuning"].values()))
+    assert tun == {"pad": 6, "depth": 2}
+    svc.stop()
 
 
 def test_write_tuning_merges_platforms(tmp_path):

@@ -258,7 +258,11 @@ def test_chaos_background_scan_and_live_partials_contend():
     resolves, and live work is never starved behind the whole scan."""
     gate = threading.Event()
     stub = StubBackend(gate=gate)
-    svc = make_service(pad=8)
+    # ONE device group: the contention this test exercises only exists
+    # inside a single dispatch stream — with k groups the live calls
+    # round-robin onto sibling streams instead (test_multidevice covers
+    # that concurrency)
+    svc = make_service(pad=8, device_groups=1)
     h = svc.handle(SCHEME, PK, backend=stub)
 
     scan_futs = [h.submit(*beacons(range(100 * i, 100 * i + 24), bad={100 * i}))
@@ -417,13 +421,16 @@ def test_backend_exception_propagates_to_all_riders():
 # -- service-owned sharding (CPU mesh) ----------------------------------------
 
 
-def test_device_backend_gets_service_owned_sharding():
-    """The service builds ONE Mesh/NamedSharding over the 8 virtual CPU
-    devices (conftest) and hands it to every device backend — the
+def test_device_backend_gets_group_placement_and_pool_sharding():
+    """A device handle's backend is PINNED to its device group (1 of the
+    8 virtual CPU devices under the AUTO one-group-per-device layout),
+    while the pool-wide sharded backend spans every device — the
     promotion of __graft_entry__.dryrun_multichip's placement to the
-    serving path.  device_put only; no program compiles."""
+    serving path, now per ISSUE 11.  device_put only; no program
+    compiles."""
     jax = pytest.importorskip("jax")
-    if len(jax.devices()) < 2:
+    from drand_tpu.crypto.device_pool import jax_devices
+    if len(jax_devices()) < 2:
         pytest.skip("needs a multi-device (virtual CPU) mesh")
     from drand_tpu.crypto.schemes import scheme_from_name
 
@@ -435,14 +442,23 @@ def test_device_backend_gets_service_owned_sharding():
     assert h.kind == "device"
     ver = h.backend
     assert ver.pad_to == 512
-    assert ver.sharding is not None
-    # a second handle for the same chain is the SAME handle (and the
-    # service's one mesh backs every device backend)
-    h2 = svc.handle(scheme, pk, device=True)
-    assert h2 is h
+    # group placement: exactly the group's one device
+    group = svc._pool.group(h.gid)
+    assert group.n_devices == 1
     arr = jax.numpy.asarray(np.zeros((512, 24), np.uint32))
     placed = ver._shard_round_axis((arr,))[0]
-    assert dict(placed.sharding.mesh.shape)["round"] == len(jax.devices())
+    assert placed.sharding.device_set == set(group.devices)
+    # a second handle for the same chain is the SAME handle
+    h2 = svc.handle(scheme, pk, device=True)
+    assert h2 is h
+    # the pool-wide sharded backend spans the FULL pool
+    slot = svc._slots[h.key]
+    assert svc._ensure_pool_backend(slot)
+    pool_ver = slot.pool_backend
+    assert pool_ver.pad_to == 512 * len(jax_devices())
+    wide = jax.numpy.asarray(np.zeros((pool_ver.pad_to, 24), np.uint32))
+    placed = pool_ver._shard_round_axis((wide,))[0]
+    assert dict(placed.sharding.mesh.shape)["round"] == len(jax_devices())
     svc.stop()
 
 
@@ -587,9 +603,12 @@ def test_probe_repromotes_after_recovery():
     slot = svc._slots[h.key]
     assert slot.state == "degraded"
     dev.healed.set()                                # the device is back
-    svc.clock.advance(6.0)                          # past the probe interval
+    # advance the fake clock INSIDE the wait loop (the chaos-scenario
+    # pattern): a single up-front advance races the probe thread
+    # computing its wait target, parking it on the 60 s real cap
     deadline = time.monotonic() + 10
     while slot.state != "healthy" and time.monotonic() < deadline:
+        svc.clock.advance(svc.probe_interval + 1.0)
         time.sleep(0.02)
     assert slot.state == "healthy"
     before = len(dev.calls)
@@ -659,8 +678,9 @@ def test_service_threads_are_named_and_reaped():
     svc = make_service()
     h = svc.handle(SCHEME, PK, backend=StubBackend())
     assert h.verify_batch(*beacons([1])).all()
-    sched, wd = svc._thread, svc._watchdog_thread
-    assert sched.name == "verify-scheduler"
+    sched = svc._streams[h.gid].thread
+    wd = svc._watchdog_thread
+    assert sched.name == f"verify-scheduler-g{h.gid}"
     assert wd.name == "verify-watchdog"
     svc.stop()
     sched.join(5)

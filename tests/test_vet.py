@@ -199,18 +199,30 @@ def test_verifier_checker_catches_fixture():
     report = _fixture_report("verifier")
     codes = _codes(report, "verifier_bad.py")
     assert ("verifier_bad.py", "verifier-direct-construction") in codes
+    assert ("verifier_bad.py", "verifier-device-enumeration") in codes
     lines = {f.line for f in report.findings
              if f.path == "verifier_bad.py"}
-    # direct, module-attr and aliased constructions are all caught
-    assert len(lines) == 3, sorted(lines)
+    # direct, module-attr and aliased constructions + the three raw
+    # device enumerations (jax.devices/local_devices/from-import alias)
+    # are all caught
+    assert len(lines) == 6, sorted(lines)
     msgs = "\n".join(f.message for f in report.findings)
-    # the service route and the host fallback are NOT flagged
+    # the service route, the host fallback and the pool route are NOT
+    # flagged
     assert "get_service" not in msgs
     assert "HostBatchVerifier" not in msgs
     assert len([f for f in report.suppressed
-                if f.path == "verifier_bad.py"]) == 1
-    # crypto/-prefixed modules own the pipelines: exempt
-    assert not any(f.path.startswith("crypto/") for f in report.findings)
+                if f.path == "verifier_bad.py"]) == 2
+    # crypto/-prefixed modules own the pipelines: construction exempt
+    assert not any(f.path.startswith("crypto/")
+                   and f.code == "verifier-direct-construction"
+                   for f in report.findings)
+    # ... but device ENUMERATION is only sanctioned in the pool module
+    # itself: a crypto/ sibling is flagged, crypto/device_pool.py is not
+    assert ("crypto/pool_bad.py", "verifier-device-enumeration") \
+        in _codes(report)
+    assert not any(f.path == "crypto/device_pool.py"
+                   for f in report.findings)
 
 
 def test_wait_checker_catches_fixture():

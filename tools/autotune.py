@@ -51,11 +51,26 @@ def _fixture(kind, n):
     return sch, sch.public_bytes(pub), beacons
 
 
-def _measure(sch, pub, beacons, pad, depth):
-    """Warm rounds/s of one streamed pass at (pad, depth)."""
+def _group_devices(group_size):
+    """The first `group_size` devices of the pool inventory — the device
+    group a sweep at that size measures on (None = default placement)."""
+    if group_size <= 1:
+        return None
+    from drand_tpu.crypto.device_pool import jax_devices
+
+    devs = jax_devices()
+    if len(devs) < group_size:
+        return None
+    return devs[:group_size]
+
+
+def _measure(sch, pub, beacons, pad, depth, group_size=1):
+    """Warm rounds/s of one streamed pass at (pad, depth) on a
+    `group_size`-device group."""
     from drand_tpu.crypto import batch
 
-    ver = batch.BatchBeaconVerifier(sch, pub, pad_to=pad)
+    ver = batch.BatchBeaconVerifier(sch, pub, pad_to=pad,
+                                    devices=_group_devices(group_size))
 
     def replay():
         n = 0
@@ -72,28 +87,42 @@ def _measure(sch, pub, beacons, pad, depth):
     return n / dt, ver.pipeline_depth(depth, pad)
 
 
-def sweep(kinds, pads, depths, n, progress=lambda m: None):
-    """-> (winners {kind: entry}, rows [sweep table])."""
+def sweep(kinds, pads, depths, n, progress=lambda m: None,
+          group_sizes=(1,)):
+    """-> (winners {tuning key: entry}, rows [sweep table]).
+
+    Winners are keyed per GROUP SIZE (ISSUE 11): the bare kind for a
+    1-device group (the legacy spelling crypto/tuning.py falls back to)
+    and `<kind>@<n>` for an n-device group, so a 1-device and a 4-device
+    group never share a TUNING.json winner."""
     rows = []
     winners = {}
     for kind in kinds:
         nn = max(n, 2 * max(pads))            # >= 2 chunks at the widest pad
         progress(f"fixture {kind}: signing {nn} rounds")
         sch, pub, beacons = _fixture(kind, nn)
-        best = None
-        for pad in pads:
-            for depth in depths:
-                progress(f"{kind} pad={pad} depth={depth}")
-                rps, eff_depth = _measure(sch, pub, beacons, pad, depth)
-                row = {"kind": kind, "pad": pad, "depth": depth,
-                       "effective_depth": eff_depth,
-                       "rounds_per_s": round(rps, 1)}
-                rows.append(row)
-                progress(f"{kind} pad={pad} depth={depth}: {rps:.1f} r/s")
-                if best is None or rps > best["rounds_per_s"]:
-                    best = row
-        winners[kind] = {"pad": best["pad"], "depth": best["depth"],
-                         "rounds_per_s": best["rounds_per_s"]}
+        for gs in group_sizes:
+            if gs > 1 and _group_devices(gs) is None:
+                progress(f"{kind}@{gs}: fewer than {gs} devices, skipped")
+                continue
+            best = None
+            for pad in pads:
+                for depth in depths:
+                    progress(f"{kind}@{gs} pad={pad} depth={depth}")
+                    rps, eff_depth = _measure(sch, pub, beacons, pad,
+                                              depth, group_size=gs)
+                    row = {"kind": kind, "group_size": gs, "pad": pad,
+                           "depth": depth, "effective_depth": eff_depth,
+                           "rounds_per_s": round(rps, 1)}
+                    rows.append(row)
+                    progress(f"{kind}@{gs} pad={pad} depth={depth}: "
+                             f"{rps:.1f} r/s")
+                    if best is None or rps > best["rounds_per_s"]:
+                        best = row
+            entry_key = kind if gs == 1 else f"{kind}@{gs}"
+            winners[entry_key] = {"pad": best["pad"],
+                                  "depth": best["depth"],
+                                  "rounds_per_s": best["rounds_per_s"]}
     return winners, rows
 
 
@@ -143,6 +172,10 @@ def main(argv=None):
     ap.add_argument("--pads", default="8192,16384,32768")
     ap.add_argument("--depths", default="1,2,4")
     ap.add_argument("--kinds", default="g1,g2")
+    ap.add_argument("--group-sizes", default="",
+                    help="device-group sizes to sweep (comma list; "
+                         "default: 1, plus the full pool when more than "
+                         "one device is visible)")
     ap.add_argument("--n", type=int, default=0,
                     help="fixture rounds (default: 2x the widest pad)")
     ap.add_argument("--out", default=None,
@@ -163,12 +196,21 @@ def main(argv=None):
     for k in kinds:
         if k not in KIND_SCHEMES:
             ap.error(f"unknown kind {k!r} (have {sorted(KIND_SCHEMES)})")
+    if args.group_sizes.strip():
+        group_sizes = [int(x) for x in args.group_sizes.split(",")
+                       if x.strip()]
+    else:
+        from drand_tpu.crypto.device_pool import jax_devices
+
+        n_devs = len(jax_devices())
+        group_sizes = [1] + ([n_devs] if n_devs > 1 else [])
     out = args.out or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "TUNING.json")
     winners, rows = sweep(kinds, pads, depths, args.n,
                           progress=lambda m: print(f"# {m}", file=sys.stderr,
-                                                   flush=True))
+                                                   flush=True),
+                          group_sizes=group_sizes)
     from drand_tpu.crypto import tuning
     tuning.write_tuning(out, platform, winners)
     print(json.dumps({"ok": True, "platform": platform, "out": out,

@@ -85,6 +85,15 @@ def device_main(args) -> int:
           f"(round period {sync.period:.0f}s)")
     print(f"sync degraded   : {sync.degraded}")
 
+    from chaos import GroupIsolationScenario
+
+    iso = GroupIsolationScenario(seed=args.seed).run()
+    print(f"group isolation : victim g{iso.victim_group} "
+          f"faulted={iso.faulted_groups} "
+          f"migrations={iso.migrations} failovers={iso.failovers}")
+    print(f"siblings        : {iso.sibling_states} "
+          f"untouched={iso.siblings_untouched}")
+
     from drand_tpu.metrics import scrape
     lines = [l for l in scrape("private").decode().splitlines()
              if l.startswith(("verify_service_failovers",
@@ -93,7 +102,7 @@ def device_main(args) -> int:
     print("failover series :")
     for line in lines:
         print(f"  {line}")
-    return 0 if result.ok and sync.ok else 1
+    return 0 if result.ok and sync.ok and iso.ok else 1
 
 
 def overload_main(args) -> int:
