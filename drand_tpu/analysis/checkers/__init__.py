@@ -9,9 +9,11 @@ from .store import StoreChecker
 from .verifier import VerifierChecker
 from .wait import WaitChecker
 from .bounds import BoundsChecker
+from .atomicwrite import AtomicWriteChecker
 
 ALL_CHECKERS = (ClockChecker, LockChecker, SecretChecker, TraceChecker,
-                StoreChecker, VerifierChecker, WaitChecker, BoundsChecker)
+                StoreChecker, VerifierChecker, WaitChecker, BoundsChecker,
+                AtomicWriteChecker)
 
 
 def checker_names():

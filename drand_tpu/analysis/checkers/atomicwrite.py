@@ -1,0 +1,119 @@
+"""atomic-write: group/share/journal persistence must be temp+rename.
+
+A truncate-in-place write (`open(path, "w")`, `os.open(..., O_TRUNC)`)
+leaves a torn file if the process dies between the truncate and the last
+byte — and under `key/` + `core/dkg_journal.py` the files being written
+are the node's group, its irreplaceable DKG share, and the crash-recovery
+journal itself: exactly the state a restart must be able to trust
+(arXiv:2109.11677's non-atomic key/state persistence hazard).  Every
+write in scope must either go through `fs.write_atomic` or spell out the
+same discipline itself: write a sibling temp file, then `os.replace`/
+`os.rename` it over the target.
+
+Scope: `key/` and `core/dkg_journal.py` (the persistent-identity plane).
+Read-mode opens are untouched.  A deliberate in-place write carries a
+`# tpu-vet: disable=atomic` suppression WITH a justification.
+
+Flagged (per enclosing function; module-level writes count too):
+  * ``open(path, "w"/"wb"/"a"...)`` — any create/truncate/append mode —
+    in a scope that never calls ``os.replace``/``os.rename``.
+  * ``os.open`` with ``O_TRUNC`` or ``O_CREAT`` under the same condition.
+"""
+
+import ast
+from typing import Iterator, List, Tuple
+
+from ..core import Finding
+from ..symbols import ModuleInfo, dotted
+
+SCOPE_PREFIXES = ("key/",)
+SCOPE_FILES = ("core/dkg_journal.py",)
+
+WRITE_MODES = ("w", "a", "x", "+")
+RENAMES = {"os.replace", "os.rename", "replace", "rename"}
+ATOMIC_HELPERS = {"fs.write_atomic", "write_atomic"}
+
+
+def _in_scope(rel: str) -> bool:
+    return any(rel.startswith(p) for p in SCOPE_PREFIXES) \
+        or rel in SCOPE_FILES
+
+
+def _open_write_mode(node: ast.Call) -> bool:
+    """True when this is builtins.open with a create/truncate mode."""
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return False            # default "r"
+    if not isinstance(mode, ast.Constant) or not isinstance(mode.value, str):
+        return True             # computed mode: assume the worst in scope
+    return any(ch in mode.value for ch in WRITE_MODES)
+
+
+def _os_open_truncates(node: ast.Call, module: ModuleInfo) -> bool:
+    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+        for n in ast.walk(arg):
+            d = dotted(n) or ""
+            if d.split(".")[-1] in ("O_TRUNC", "O_CREAT"):
+                return True
+    return False
+
+
+class AtomicWriteChecker:
+    name = "atomic"
+    description = ("truncate-in-place writes of group/share/journal state "
+                   "(key/, core/dkg_journal.py) that skip the "
+                   "temp+fsync+rename discipline (fs.write_atomic)")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if not _in_scope(module.rel):
+            return
+        # walk each function scope once; module level is its own scope
+        scopes: List[Tuple[str, ast.AST]] = [("<module>", module.tree)]
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append((node.name, node))
+        for name, scope in scopes:
+            yield from self._check_scope(module, name, scope)
+
+    @staticmethod
+    def _scope_nodes(scope: ast.AST):
+        """Walk one scope WITHOUT descending into nested functions (they
+        are separate scopes with their own visit)."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _check_scope(self, module: ModuleInfo, name: str,
+                     scope: ast.AST) -> Iterator[Finding]:
+        writes: List[Tuple[ast.Call, str]] = []
+        renames = False
+        for node in self._scope_nodes(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = module.resolve(dotted(node.func) or "")
+            if qual in RENAMES or qual in ATOMIC_HELPERS:
+                renames = True
+            elif qual == "open" and _open_write_mode(node):
+                writes.append((node, "open"))
+            elif qual == "os.open" and _os_open_truncates(node, module):
+                writes.append((node, "os.open"))
+        if renames:
+            return              # temp+rename discipline present in scope
+        for node, kind in writes:
+            yield Finding(
+                checker=self.name, code="atomic-write-in-place",
+                message=(f"{name} writes persistent key/journal state via "
+                         f"{kind} with no os.replace/os.rename in scope: a "
+                         "crash mid-write leaves a torn file where the "
+                         "node expects its group/share/journal — use "
+                         "fs.write_atomic (temp + fsync + rename)"),
+                path=module.rel, line=node.lineno, col=node.col_offset)

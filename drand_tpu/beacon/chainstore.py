@@ -206,7 +206,11 @@ class ChainStore:
                     rc.checked[p] = True
                 else:
                     rc.mark_bad(p)
-                    rc.partials.pop(tbls.index_of(p), None)
+                    # drop the slot only if it still holds THESE bytes —
+                    # popping by index alone could evict a good partial
+                    # that re-occupied the slot while this one verified
+                    if rc.partials.get(tbls.index_of(p)) == p:
+                        rc.partials.pop(tbls.index_of(p), None)
         good = [p for p in rc.partials.values() if rc.checked.get(p)]
         if len(good) < thr:
             return

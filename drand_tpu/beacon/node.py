@@ -141,11 +141,18 @@ class Handler:
         self._sync_needed(self.ticker.current_round())
         self._launch()
 
-    def transition(self, new_group, new_share) -> None:
+    def transition(self, new_group, new_share, on_commit=None) -> None:
         """Arm a reshare transition: at the group's transition time the vault
-        swaps to the new share/group atomically (node.go:257-281)."""
+        swaps to the new share/group atomically (node.go:257-281).
+
+        `on_commit` is the durability hook (core/dkg_journal.py): invoked
+        exactly once, at the moment the swap commits, so the staged
+        group/share files are promoted over the active ones only when the
+        chain no longer needs the old share.  A crash before this point
+        restarts with the old state + the pending ledger; a crash after
+        it restarts already transitioned."""
         with self._lock:
-            self._transition_group = (new_group, new_share)
+            self._transition_group = (new_group, new_share, on_commit)
 
     def _launch(self) -> None:
         if self._thread is not None:
@@ -222,7 +229,7 @@ class Handler:
             pending = self._transition_group
             if pending is None:
                 return
-            new_group, new_share = pending
+            new_group, new_share, on_commit = pending
             transition_round = current_round(
                 new_group.transition_time, new_group.period,
                 new_group.genesis_time)
@@ -234,17 +241,37 @@ class Handler:
                     or next_to_sign < transition_round:
                 return
             self._transition_group = None
-        if new_share is None:
-            # we are not part of the new group: leave the network
-            threading.Thread(target=self.stop, daemon=True).start()
-            return
-        self.vault.set_info(new_group, new_share)
-        self.group = new_group
-        self.chain.group = new_group
-        self.chain.partial_verifier = self.cfg.verifier_factory(
-            self.scheme, self.vault.get_pub(), len(new_group))
-        self.index = new_share.private.index
-        self.catchup_period = new_group.catchup_period or new_group.period
+            # The swap happens INSIDE the lock: every signing path calls
+            # _maybe_transition before signing, so a concurrent caller
+            # blocks here until the vault/verifier swap is complete
+            # instead of seeing `pending is None` mid-swap and signing
+            # the transition round with the OLD share (a stray old-share
+            # partial does not just fail — it poisons the partial cache's
+            # slot for this index, and the rebroadcast-once transport
+            # never re-delivers the good one).
+            # Promote the staged on-disk state BEFORE the in-memory
+            # swap: if the commit lands and we crash, the restart is
+            # simply already transitioned; disk failures must not block
+            # the live swap.
+            if on_commit is not None:
+                try:
+                    on_commit()
+                except Exception:
+                    pass        # reported by the owner's own logging
+            if new_share is not None:
+                self.vault.set_info(new_group, new_share)
+                self.group = new_group
+                self.chain.group = new_group
+                self.chain.partial_verifier = self.cfg.verifier_factory(
+                    self.scheme, self.vault.get_pub(), len(new_group))
+                self.index = new_share.private.index
+                self.catchup_period = new_group.catchup_period \
+                    or new_group.period
+                return
+        # we are not part of the new group: leave the network (outside
+        # the lock — stop() joins the very threads that may be parked on
+        # _maybe_transition's lock right now)
+        threading.Thread(target=self.stop, daemon=True).start()
 
     def broadcast_next_partial(self, last: Beacon) -> None:
         """Sign our partial for last.round+1 and fan it out

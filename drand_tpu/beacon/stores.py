@@ -52,6 +52,17 @@ class _Decorator(Store):
     def delete(self, round_: int) -> None:
         self.inner.delete(round_)
 
+    # two-phase quarantine (chain/store.py contract): delegate so the
+    # side table lives with the BACKEND, not per decorator layer
+    def tombstone(self, round_: int) -> bool:
+        return self.inner.tombstone(round_)
+
+    def tombstoned(self, round_: int):
+        return self.inner.tombstoned(round_)
+
+    def drop_tombstone(self, round_: int) -> None:
+        self.inner.drop_tombstone(round_)
+
     def save_to(self, fileobj) -> None:
         self.inner.save_to(fileobj)
 

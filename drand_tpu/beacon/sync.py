@@ -415,21 +415,40 @@ class SyncManager:
 
     def heal(self, raw_store, report_or_rounds, peers=None,
              beacon_id: str = "default") -> List[int]:
-        """Quarantine + re-fetch rounds flagged by an integrity scan
-        (chain/integrity.py): corrupt rows are deleted first so this node
-        stops serving them, then the union of corrupt + missing rounds is
-        re-fetched from breaker-ranked peers (correct_past_beacons — the
-        existing repair machinery with its peer accounting), verified in
-        device batches, and written back through the RAW store.
+        """Quarantine + repair rounds flagged by an integrity scan
+        (chain/integrity.py): corrupt rows are tombstoned to the
+        quarantine side table first so this node stops serving them, then
+        repair runs in two phases:
 
-        Accepts a ScanReport or a plain round list.  Returns the rounds
-        that could not be repaired (every peer failed or served forgeries);
-        those stay quarantined rather than corrupt."""
-        from ..chain.integrity import IntegrityScanner, ScanReport
+          1. provably-bad rounds (invalid signature, malformed, missing)
+             are re-fetched from breaker-ranked peers
+             (correct_past_beacons — the existing repair machinery with
+             its peer accounting), verified in device batches, and
+             written back through the RAW store;
+          2. rounds that were merely UNPROVABLE (their anchor rotted, not
+             their own bytes) get a PROMOTE pass: the tombstoned bytes
+             are re-verified against the now-restored anchor and put back
+             without touching the network (ROADMAP item 6 two-phase
+             quarantine).  Only the rounds promotion cannot prove fall
+             through to a peer fetch.
+
+        Accepts a ScanReport or a plain round list (list = no kind
+        information, everything is treated as provably bad).  Returns the
+        rounds that could not be repaired (every peer failed or served
+        forgeries); those stay quarantined rather than corrupt."""
+        from ..chain.integrity import (UNLINKED, IntegrityScanner,
+                                       ScanReport)
         from ..metrics import integrity_repaired
+        unprovable: set = set()
         if isinstance(report_or_rounds, ScanReport):
             bad_rows = report_or_rounds.quarantinable_rounds
             faulty = report_or_rounds.faulty_rounds
+            # promotable = rounds whose EVERY finding is UNLINKED: their
+            # own bytes were never proven bad, only unprovable
+            kinds: dict = {}
+            for f in report_or_rounds.findings:
+                kinds.setdefault(f.round, set()).add(f.kind)
+            unprovable = {r for r, ks in kinds.items() if ks == {UNLINKED}}
         else:
             faulty = sorted(set(report_or_rounds))
             bad_rows = faulty
@@ -437,11 +456,71 @@ class SyncManager:
             return []
         IntegrityScanner(raw_store, self.scheme,
                          beacon_id=beacon_id).quarantine(bad_rows)
-        remaining = self.correct_past_beacons(raw_store, faulty, peers)
+        fetch_first = [r for r in faulty if r not in unprovable]
+        remaining = self.correct_past_beacons(raw_store, fetch_first, peers) \
+            if fetch_first else []
+        if unprovable:
+            promoted = self._promote_tombstoned(raw_store,
+                                                sorted(unprovable),
+                                                beacon_id=beacon_id)
+            leftover = [r for r in sorted(unprovable) if r not in promoted]
+            if leftover:
+                remaining += self.correct_past_beacons(raw_store, leftover,
+                                                       peers)
+        remaining = sorted(set(remaining))
+        # a repaired round's stale tombstone must not linger (a later
+        # promote pass could resurrect pre-repair bytes)
+        drop = getattr(raw_store, "drop_tombstone", None)
+        if drop is not None:
+            for r in faulty:
+                if r not in remaining:
+                    try:
+                        drop(r)
+                    except Exception:
+                        pass
         healed = len(faulty) - len(remaining)
         if healed > 0:
             integrity_repaired.labels(beacon_id).inc(healed)
         return remaining
+
+    def _promote_tombstoned(self, raw_store, rounds: List[int],
+                            beacon_id: str = "default") -> set:
+        """Phase-2 repair: re-verify each tombstoned row against its (now
+        hopefully restored) anchor and promote it back into the chain.
+        Ascending order on purpose — a promoted round is the anchor of
+        the next one, so a whole unprovable RUN above one corrupt row
+        heals from a single peer-fetched anchor."""
+        from ..metrics import integrity_promoted
+        promoted: set = set()
+        tombstoned = getattr(raw_store, "tombstoned", None)
+        if tombstoned is None:
+            return promoted
+        for r in rounds:
+            try:
+                row = tombstoned(r)
+            except Exception:
+                row = None
+            if row is None:
+                continue
+            prev = None
+            if self.scheme.chained:
+                try:
+                    prev = raw_store.get(r - 1).signature
+                except Exception:
+                    continue        # anchor still missing: cannot prove
+            try:
+                ok = self.verifier.verify_batch([r], [row.signature], [prev])
+            except Exception:
+                continue
+            if not bool(ok[0]):
+                continue
+            raw_store.put(Beacon(round=r, signature=row.signature,
+                                 previous_sig=prev))
+            raw_store.drop_tombstone(r)
+            promoted.add(r)
+        if promoted:
+            integrity_promoted.labels(beacon_id).inc(len(promoted))
+        return promoted
 
     def _fetch_one(self, peer, round_: int) -> Optional[Beacon]:
         """Single-round fetch.  Lets `BreakerOpen` propagate (client-side
@@ -485,8 +564,9 @@ class SyncChainServer:
         cb_id = f"sync-{remote_addr}"
         self.chain.cbstore.add_callback(cb_id, lambda b: _offer(q, b))
         sent = from_round - 1
+        last = [None]       # previous STORE row yielded (the walk anchor)
         try:
-            sent = yield from self._replay(from_round, sent)
+            sent = yield from self._replay(from_round, sent, last)
             while not stop.is_set():
                 try:
                     b = q.get(timeout=0.1)
@@ -497,23 +577,46 @@ class SyncChainServer:
                 if b.round > sent + 1:
                     # the bounded queue dropped beacons (slow consumer):
                     # re-replay the hole from the store before following on
-                    sent = yield from self._replay(sent + 1, sent)
+                    sent = yield from self._replay(sent + 1, sent, last)
                 if b.round > sent:
-                    yield b
+                    yield self._fill_prev(b, last[0])
+                    last[0] = b
                     sent = b.round
         finally:
             self.chain.cbstore.remove_callback(cb_id)
 
-    def _replay(self, from_round: int, sent: int):
+    def _replay(self, from_round: int, sent: int, last: list):
         """Cursor replay of stored rounds >= from_round; returns new `sent`."""
         cur = self.chain.store.cursor()
         b = cur.seek(from_round) if from_round > 0 else cur.first()
         while b is not None:
             if b.round > sent:
-                yield b
+                yield self._fill_prev(b, last[0])
+                last[0] = b
                 sent = b.round
             b = cur.next()
         return sent
+
+    def _fill_prev(self, b: Beacon, last: Optional[Beacon]) -> Beacon:
+        """Trimmed stores (sqlite/postgres) materialize rows WITHOUT
+        previous_sig, but a chained-scheme peer cannot link or verify a
+        stream that omits it — fill it on the serving side from the walk
+        itself (or one point read at the stream head).  Rounds whose
+        anchor genuinely isn't stored (round 1, a hole) stream as-is and
+        the peer anchors on its own head."""
+        scheme = getattr(getattr(self.chain, "group", None), "scheme", None)
+        if scheme is None or not scheme.chained \
+                or b.previous_sig is not None:
+            return b
+        if last is not None and last.round == b.round - 1:
+            prev_sig = last.signature
+        else:
+            try:
+                prev_sig = self.chain.store.get(b.round - 1).signature
+            except Exception:
+                return b
+        return Beacon(round=b.round, signature=b.signature,
+                      previous_sig=prev_sig)
 
 
 def _offer(q: queue.Queue, item) -> None:

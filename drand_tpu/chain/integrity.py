@@ -391,18 +391,30 @@ class IntegrityScanner:
     # -- quarantine ----------------------------------------------------------
 
     def quarantine(self, report_or_rounds) -> List[int]:
-        """Delete the corrupt rows so the node stops serving them; returns
-        the deleted rounds.  Missing rounds are skipped (nothing on disk),
-        everything else is removed through the RAW store — the repair path
-        (`SyncManager.heal` / chain_doctor repair) re-fetches the union of
-        quarantined + missing."""
+        """Remove the corrupt rows from serving; returns the rounds
+        acted on.  Two-phase (ROADMAP item 6): rows are TOMBSTONED to the
+        store's quarantine side table when the backend supports it — the
+        bytes survive, so an intact-but-unprovable successor can be
+        promoted back once its anchor is restored (`SyncManager.heal`'s
+        promote pass) instead of re-downloaded.  Backends without a side
+        table fall back to the old destructive delete.  Missing rounds
+        are skipped (nothing on disk); the repair path re-fetches the
+        union of quarantined + missing."""
         from ..metrics import integrity_quarantined
         if isinstance(report_or_rounds, ScanReport):
             rounds = report_or_rounds.quarantinable_rounds
         else:
             rounds = sorted(set(report_or_rounds))
         deleted = []
+        tomb = getattr(self.store, "tombstone", None)
         for r in rounds:
+            if tomb is not None:
+                try:
+                    if tomb(r):
+                        deleted.append(r)
+                        continue
+                except Exception:
+                    pass    # side table unavailable: destructive fallback
             try:
                 self.store.get(r)
             except (ErrNoBeaconSaved, ErrNoBeaconStored):

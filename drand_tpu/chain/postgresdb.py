@@ -27,6 +27,12 @@ CREATE TABLE IF NOT EXISTS beacon_ids (
     id   SERIAL PRIMARY KEY,
     name TEXT UNIQUE NOT NULL
 );
+CREATE TABLE IF NOT EXISTS beacons_quarantine (
+    beacon_id INT NOT NULL,
+    round     BIGINT NOT NULL,
+    signature BYTEA NOT NULL,
+    PRIMARY KEY (beacon_id, round)
+);
 """
 
 
@@ -141,6 +147,59 @@ class PostgresStore(Store):
         with self._write_lock, self.conn, self.conn.cursor() as cur:
             cur.execute("DELETE FROM beacons WHERE beacon_id=%s AND round=%s",
                         (self.bid, round_))
+
+    def tombstone(self, round_: int) -> bool:
+        """Two-phase quarantine (chain/store.py contract): move the row
+        to the side table so its bytes survive for a later promotion.
+        The move runs in ONE real transaction — the connection normally
+        runs autocommit (see __init__), under which `with self.conn` is
+        a no-op, so like put_many it is dropped into transactional mode:
+        a crash mid-move must never leave the corrupt row BOTH served
+        from beacons and parked in quarantine."""
+        with self._write_lock:
+            auto = self.conn.autocommit
+            self.conn.autocommit = False
+            try:
+                with self.conn, self.conn.cursor() as cur:
+                    cur.execute("SELECT 1 FROM beacons WHERE beacon_id=%s "
+                                "AND round=%s", (self.bid, round_))
+                    if cur.fetchone() is None:
+                        return False
+                    # replace, not keep: a stale side-table row from an
+                    # earlier quarantine must not shadow the bytes being
+                    # moved now (sqlite's INSERT OR REPLACE, portably)
+                    cur.execute(
+                        "DELETE FROM beacons_quarantine"
+                        " WHERE beacon_id=%s AND round=%s",
+                        (self.bid, round_))
+                    cur.execute(
+                        "INSERT INTO beacons_quarantine"
+                        " (beacon_id, round, signature)"
+                        " SELECT beacon_id, round, signature FROM beacons"
+                        " WHERE beacon_id=%s AND round=%s",
+                        (self.bid, round_))
+                    cur.execute("DELETE FROM beacons WHERE beacon_id=%s "
+                                "AND round=%s", (self.bid, round_))
+                    return True
+            finally:
+                self.conn.autocommit = auto
+
+    def tombstoned(self, round_: int) -> Optional[Beacon]:
+        with self.conn.cursor() as cur:
+            cur.execute(
+                "SELECT signature FROM beacons_quarantine"
+                " WHERE beacon_id=%s AND round=%s", (self.bid, round_))
+            row = cur.fetchone()
+        if row is None:
+            return None
+        return Beacon(round=round_, signature=bytes(row[0]),
+                      previous_sig=None)
+
+    def drop_tombstone(self, round_: int) -> None:
+        with self._write_lock, self.conn, self.conn.cursor() as cur:
+            cur.execute(
+                "DELETE FROM beacons_quarantine"
+                " WHERE beacon_id=%s AND round=%s", (self.bid, round_))
 
     def close(self) -> None:
         self.conn.close()

@@ -49,6 +49,14 @@ class SqliteStore(Store):
                 "CREATE TABLE IF NOT EXISTS beacons ("
                 " round INTEGER PRIMARY KEY,"
                 " signature BLOB NOT NULL)")
+            # two-phase quarantine side table (chain/store.py contract):
+            # corrupt rows are MOVED here, not destroyed, so an
+            # unprovable-but-intact row can be promoted back once its
+            # anchor is restored instead of re-downloaded
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS quarantine ("
+                " round INTEGER PRIMARY KEY,"
+                " signature BLOB NOT NULL)")
             self._conn.commit()
 
     def __len__(self) -> int:
@@ -120,6 +128,42 @@ class SqliteStore(Store):
     def delete(self, round_: int) -> None:
         with self._lock:
             self._conn.execute("DELETE FROM beacons WHERE round = ?", (round_,))
+            self._conn.commit()
+
+    def tombstone(self, round_: int) -> bool:
+        """Move the row to the quarantine table in ONE transaction — raw
+        SQL on purpose: a strict-previous get() would refuse to
+        materialize exactly the torn rows quarantine exists for."""
+        with self._lock:
+            try:
+                cur = self._conn.execute(
+                    "INSERT OR REPLACE INTO quarantine (round, signature)"
+                    " SELECT round, signature FROM beacons WHERE round = ?",
+                    (round_,))
+                moved = cur.rowcount > 0
+                if moved:
+                    self._conn.execute(
+                        "DELETE FROM beacons WHERE round = ?", (round_,))
+            except BaseException:
+                self._conn.rollback()
+                raise
+            self._conn.commit()
+            return moved
+
+    def tombstoned(self, round_: int) -> Optional[Beacon]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT signature FROM quarantine WHERE round = ?",
+                (round_,)).fetchone()
+        if row is None:
+            return None
+        return Beacon(round=round_, signature=bytes(row[0]),
+                      previous_sig=None)
+
+    def drop_tombstone(self, round_: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM quarantine WHERE round = ?", (round_,))
             self._conn.commit()
 
     def close(self) -> None:

@@ -41,6 +41,17 @@ suite enforces them):
     absent they raise `ErrMissingPrevious` instead of fabricating a
     beacon that cannot re-verify.  Round 1 is exempt — its anchor is the
     genesis seed (chain metadata), not a stored row.
+  * **Two-phase quarantine** (`tombstone`/`tombstoned`/`drop_tombstone`):
+    a row flagged by the integrity scan is MOVED to a quarantine side
+    table, not destroyed — it disappears from every normal read
+    (`get`/`last`/cursors/`len`) but its bytes are retained, so an
+    intact-but-unPROVABLE row (UNLINKED: its anchor rotted, not its own
+    bytes) can be promoted back once the anchor is restored, instead of
+    re-downloaded from peers.  Durable engines keep the side table on
+    disk; the base implementation keeps it in process memory (volatile
+    backends lose tombstones with the process, which costs at most a
+    re-fetch).  `tombstone` of an absent round returns False;
+    `drop_tombstone` is idempotent.
 """
 
 import struct
@@ -117,6 +128,37 @@ class Store(ABC):
 
     @abstractmethod
     def delete(self, round_: int) -> None: ...
+
+    # -- two-phase quarantine (see the module contract) ----------------------
+
+    def tombstone(self, round_: int) -> bool:
+        """Move `round_` to the quarantine side table; True when a row
+        was moved.  Base implementation: in-memory side dict over
+        get+delete (durable engines override with a real side table that
+        also captures rows a strict `get` refuses to materialize)."""
+        try:
+            b = self.get(round_)
+        except Exception:
+            return False
+        self.delete(round_)
+        self._tombs()[round_] = Beacon(round=b.round, signature=b.signature,
+                                       previous_sig=b.previous_sig)
+        return True
+
+    def tombstoned(self, round_: int) -> Optional[Beacon]:
+        """The quarantined row's retained bytes, or None."""
+        return self._tombs().get(round_)
+
+    def drop_tombstone(self, round_: int) -> None:
+        self._tombs().pop(round_, None)
+
+    def _tombs(self) -> dict:
+        # lazily attached: Store is an ABC whose subclasses don't all
+        # call super().__init__()
+        t = getattr(self, "_tombstone_rows", None)
+        if t is None:
+            t = self._tombstone_rows = {}
+        return t
 
     def save_to(self, fileobj) -> None:
         """Stream a backup of the full store (chain/store.go:24).

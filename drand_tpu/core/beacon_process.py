@@ -25,19 +25,30 @@ from ..key.keys import Pair, Share
 from ..key.store import FileStore
 from ..log import Logger
 from ..metrics import (ThresholdMonitor, beacon_discrepancy_latency,
-                       group_size, group_threshold, last_beacon_round)
+                       dkg_phase_gauge, dkg_sessions, group_size,
+                       group_threshold, last_beacon_round,
+                       reshare_transition_pending)
 from ..chain.timing import time_of_round
 from ..net import Peer, ProtocolClient
 from ..net import convert
 from ..net.resilience import BreakerOpen, Deadline, DeadlineExceeded
 from ..protos import drand_pb2 as pb
+from . import dkg_journal as J
 from .broadcast import EchoBroadcast
 from .config import CALL_MAX_TIMEOUT, Config
-from .dkg_runner import run_dkg
+from .dkg_journal import DKGJournal
+from .dkg_runner import run_dkg_bounded
 from .setup import (SetupManager, SetupReceiver, hash_secret, sign_group)
 
-# DKG status enum (core/drand_status.go:36-101)
+# DKG status enum (core/drand_status.go:36-101).  DKG_FAILED is the
+# crash-hygiene terminal state: every aborted/failed session must land
+# here — a beacon wedged at IN_PROGRESS can never accept a fresh InitDKG.
 DKG_NOT_STARTED, DKG_WAITING, DKG_IN_PROGRESS, DKG_DONE = 0, 1, 2, 3
+DKG_FAILED = 4
+
+DKG_STATUS_NAMES = {DKG_NOT_STARTED: "not_started", DKG_WAITING: "waiting",
+                    DKG_IN_PROGRESS: "in_progress", DKG_DONE: "done",
+                    DKG_FAILED: "failed"}
 
 
 class BeaconProcess:
@@ -71,6 +82,16 @@ class BeaconProcess:
         # bundles that raced ahead of board creation (a peer can start
         # dealing the instant it has the group, before our board is up)
         self._pending_dkg: List[pb.DKGPacket] = []
+        # crash-safe session lifecycle (core/dkg_journal.py): the on-disk
+        # session journal + pending-transition ledger, the nonces of
+        # aborted epochs (their late bundles are rejected, not parked),
+        # and the staged (group, share) a restart re-arms at start_beacon
+        self.journal = DKGJournal(file_store, clock=self.clock)
+        self._failed_nonces: set = set()
+        self._armed_transition = None      # (group, share) from recovery
+        # transition waiters park on this instead of a never-set Event so
+        # daemon stop() reaps them (the leaked transition-<id> thread fix)
+        self._transition_stop = threading.Event()
         # scheduled background integrity scans (cfg.integrity_scan_interval)
         self._scan_stop: Optional[threading.Event] = None
         self._scan_thread: Optional[threading.Thread] = None
@@ -84,12 +105,63 @@ class BeaconProcess:
     # -- persistence (drand_beacon.go:110-162) ------------------------------
 
     def load(self) -> bool:
-        """Restore group + share from disk; True when this beacon has state."""
+        """Restore group + share from disk; True when this beacon has
+        state to serve NOW.
+
+        Crash recovery runs first (core/dkg_journal.py): a session the
+        previous process died inside is finished as aborted (status
+        DKG_FAILED, staged output discarded unless a complete ledger
+        exists), and a pending reshare transition is resolved — committed
+        immediately when the transition time has passed, re-armed for the
+        handler swap (running member) or the transition waiter (newcomer)
+        when it has not, discarded when the staged files are missing or
+        tampered."""
+        rec = J.recover(self.journal, self.clock, self.log)
+        if rec.aborted_session is not None:
+            ab = rec.aborted_session
+            if ab.kind == "reshare":
+                self.reshare_status = DKG_FAILED
+            else:
+                self.dkg_status = DKG_FAILED
+            if ab.nonce:
+                with self._lock:
+                    self._failed_nonces.add(bytes.fromhex(ab.nonce))
+            dkg_sessions.labels(self.beacon_id, ab.kind, J.ABORTED).inc()
+            if rec.action == "none":
+                # no ledger survived the crash: any staged partials are
+                # unaccounted for — remove them so a later session cannot
+                # confuse them with its own output
+                self.fs.discard_staged()
         self.group = self.fs.load_group()
+        if rec.action == "rearm":
+            reshare_transition_pending.labels(self.beacon_id).set(1)
+            self.reshare_status = DKG_DONE
+            if self.fs.load_share() is not None and self.group is not None:
+                # running member: serve the old state now, swap at the
+                # transition round (armed by start_beacon)
+                with self._lock:
+                    self._armed_transition = (rec.group, rec.share)
+            else:
+                # newcomer: no old state to serve — adopt the staged
+                # state in memory and join at the transition, committing
+                # the ledger the moment the waiter fires
+                self.group = rec.group
+                self.share = rec.share
+                self._start_at_transition(rec.group, commit=True)
+                return False
+        elif rec.action == "committed":
+            # newcomer fast path: recover() promoted the active files
+            # BEFORE the load_group() above, which therefore already read
+            # the new epoch — nothing to re-read
+            reshare_transition_pending.labels(self.beacon_id).set(0)
+            self.reshare_status = DKG_DONE
         if self.group is None:
             return False
         self.share = self.fs.load_share()
-        self.dkg_status = DKG_DONE if self.share is not None else DKG_NOT_STARTED
+        if self.share is not None:
+            self.dkg_status = DKG_DONE
+        elif self.dkg_status != DKG_FAILED:
+            self.dkg_status = DKG_NOT_STARTED
         return self.share is not None
 
     # -- store / handler plumbing -------------------------------------------
@@ -117,6 +189,24 @@ class BeaconProcess:
                     genesis_seed=self.group.get_genesis_seed(),
                     scheme=self.group.scheme.id,
                     beacon_id=self.beacon_id)
+
+    def dkg_lifecycle(self) -> dict:
+        """The /health `dkg` block: statuses by name, the live session's
+        phase, and whether a staged reshare awaits its transition."""
+        out = {
+            "status": DKG_STATUS_NAMES.get(self.dkg_status, "unknown"),
+            "reshare": DKG_STATUS_NAMES.get(self.reshare_status, "unknown"),
+        }
+        rec = self.journal.load_session()
+        if rec is not None and rec.outcome == J.RUNNING:
+            out["phase"] = rec.phase
+            out["kind"] = rec.kind
+        pending = self.journal.load_pending()
+        out["transition_pending"] = pending is not None
+        if pending is not None:
+            out["transition_time"] = pending.transition_time
+            out["new_group"] = pending.new_group_hash[:16]
+        return out
 
     def _peers(self, group: Optional[Group] = None) -> List[Peer]:
         g = group or self.group
@@ -237,6 +327,14 @@ class BeaconProcess:
                 "metrics", self._metrics_callback)
             group_size.labels(self.beacon_id).set(len(self.group))
             group_threshold.labels(self.beacon_id).set(self.group.threshold)
+            if self._armed_transition is not None:
+                # restart recovery (load): a reshare output staged before
+                # the crash still awaits its transition round — re-arm
+                # the swap exactly as the original session would have
+                g, s = self._armed_transition
+                self._armed_transition = None
+                self.handler.transition(
+                    g, s, on_commit=self._commit_closure(g, s))
         if self.cfg.startup_integrity not in ("off", "linkage", "full"):
             # fail fast: a typo'd value must not silently degrade the scan
             raise ValueError(
@@ -408,15 +506,11 @@ class BeaconProcess:
         path = self._scan_checkpoint_path()
         if path is None:
             return
-        import tempfile
+        from .. import fs as _fs
         try:
-            # temp + rename: a crash mid-write must leave the old (or no)
-            # watermark, never a torn one (worst case = full rescan)
-            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
-                                       prefix=".scan_ckpt.")
-            with os.fdopen(fd, "w", encoding="utf-8") as f:
-                f.write(ckpt.to_json())
-            os.replace(tmp, path)
+            # temp + fsync + rename: a crash mid-write must leave the old
+            # (or no) watermark, never a torn one (worst = full rescan)
+            _fs.write_atomic(path, ckpt.to_json().encode())
         except OSError:
             pass
 
@@ -477,6 +571,11 @@ class BeaconProcess:
             self.syncm.send_sync_request(target_round)
 
     def stop(self) -> None:
+        # reap any parked transition waiter (it must not outlive the
+        # daemon); a later restart re-creates the event, so a stopped
+        # process can still be started again by the control plane
+        self._transition_stop.set()
+        self._transition_stop = threading.Event()
         with self._lock:
             if self._scan_stop is not None:
                 self._scan_stop.set()
@@ -518,15 +617,46 @@ class BeaconProcess:
             return self.handler.chain.last()
         return self.handler.chain.store.get(round_)
 
+    # -- DKG failure hygiene -------------------------------------------------
+
+    def _fail_session(self, kind: str, nonce: Optional[bytes] = None) -> None:
+        """Every abort path lands here: status DKG_FAILED (never a wedged
+        IN_PROGRESS), staged output gone, the epoch's nonce blacklisted so
+        stragglers' bundles are rejected, the journal closed, the outcome
+        counted.  After this the beacon is immediately serveable and a
+        fresh InitDKG/InitReshare on the same id succeeds."""
+        if kind == "reshare":
+            self.reshare_status = DKG_FAILED
+        else:
+            self.dkg_status = DKG_FAILED
+        if nonce:
+            with self._lock:
+                self._failed_nonces.add(nonce)
+        # staged cleanup, scoped to THIS epoch: a pending ledger staged by
+        # an earlier successful reshare (still awaiting its transition)
+        # must survive an unrelated later session's failure
+        pending = self.journal.load_pending()
+        if pending is not None and nonce is not None \
+                and pending.new_group_hash == nonce.hex():
+            self.journal.discard_pending()
+            reshare_transition_pending.labels(self.beacon_id).set(0)
+        self.journal.finish(J.FAILED)
+        dkg_sessions.labels(self.beacon_id, kind, J.FAILED).inc()
+        dkg_phase_gauge.labels(self.beacon_id).set(0)
+
     # -- DKG: leader path (drand_beacon_control.go:41-117,275-411) ----------
 
     def init_dkg_leader(self, n_nodes: int, threshold: int, period: int,
                         catchup_period: int, secret: bytes,
                         setup_timeout: float, scheme) -> Group:
         self.dkg_status = DKG_WAITING
+        self.journal.begin("dkg", "leader")
+        dkg_phase_gauge.labels(self.beacon_id).set(
+            J.phase_index(J.PHASE_SETUP))
         self._setup_manager = SetupManager(
             self.log, scheme, self.beacon_id, n_nodes, secret,
             self.pair.public)
+        group = None
         try:
             self._setup_manager.wait_participants(setup_timeout)
             group = self._setup_manager.create_group(
@@ -534,6 +664,10 @@ class BeaconProcess:
                 self.cfg.dkg_timeout)
             self._push_dkg_info(group)
             out_group = self._run_dkg_session(group, leader=True)
+        except BaseException:
+            self._fail_session("dkg",
+                               group.hash() if group is not None else None)
+            raise
         finally:
             self._setup_manager = None
         return out_group
@@ -541,7 +675,16 @@ class BeaconProcess:
     def _push_dkg_info(self, group: Group,
                        secret_proof: bytes = b"") -> None:
         """Signed group to every participant (drand_beacon_control.go:
-        988-1083); all pushes must succeed for a fresh DKG."""
+        988-1083); all pushes must succeed for a fresh DKG.
+
+        Partial-push arming: when only a SUBSET of followers accepted the
+        group, the leader raises here — but the armed followers are
+        already sitting in a session that will never run.  There is no
+        abort RPC in the protocol, so the contract is deadline-unwind:
+        the armed followers' deal/response phases expire on their own
+        clocks, the too-few-bundles DkgError surfaces, and their failure
+        hygiene lands them at DKG_FAILED (never a wedged WAITING) ready
+        for the retry — pinned by the partial-push lifecycle test."""
         sig = sign_group(group, group.scheme, self.pair.key)
         packet = pb.DKGInfoPacket(
             new_group=convert.group_to_proto(group, self.beacon_id),
@@ -565,9 +708,13 @@ class BeaconProcess:
     def join_dkg(self, leader: Peer, secret: bytes,
                  setup_timeout: float) -> Group:
         self.dkg_status = DKG_WAITING
-        self._setup_receiver = SetupReceiver(
-            self.log, self._fetch_leader_identity(leader))
+        self.journal.begin("dkg", "follower")
+        dkg_phase_gauge.labels(self.beacon_id).set(
+            J.phase_index(J.PHASE_SETUP))
+        group = None
         try:
+            self._setup_receiver = SetupReceiver(
+                self.log, self._fetch_leader_identity(leader))
             sig_packet = pb.SignalDKGPacket(
                 node=convert.identity_to_proto(self.pair.public),
                 secret_proof=hash_secret(secret),
@@ -578,6 +725,10 @@ class BeaconProcess:
             return self._run_dkg_session(
                 group, leader=False, phase_timeout=timeout_s,
                 first_phase_extra=grace_s + 1.0)
+        except BaseException:
+            self._fail_session("dkg",
+                               group.hash() if group is not None else None)
+            raise
         finally:
             self._setup_receiver = None
 
@@ -628,11 +779,24 @@ class BeaconProcess:
     def _dkg_nodes(self, group: Group) -> List[D.DkgNode]:
         return [D.DkgNode(n.index, n.identity.key) for n in group.nodes]
 
+    def _journal_phase(self, phase: str) -> None:
+        """run_dkg's on_phase hook: persist the phase reached (a restart
+        reports how far the dead session got) + the live gauge."""
+        self.journal.phase(phase)
+        dkg_phase_gauge.labels(self.beacon_id).set(J.phase_index(phase))
+
     def _run_dkg_session(self, group: Group, leader: bool,
                          phase_timeout: int = 0,
                          first_phase_extra: float = 0.0) -> Group:
         self.dkg_status = DKG_IN_PROGRESS
         nonce = group.hash()
+        self.journal.set_nonce(nonce)
+        # a RETRY of a failed epoch can legitimately reuse the same group
+        # hash (same membership/threshold/transition round): the nonce is
+        # live again the moment a local session adopts it — un-blacklist,
+        # or this node would reject every bundle of its own retry
+        with self._lock:
+            self._failed_nonces.discard(nonce)
         nodes = self._dkg_nodes(group)
         board = EchoBroadcast(
             self.client, self.log, self.beacon_id,
@@ -653,17 +817,24 @@ class BeaconProcess:
             gen = D.DistKeyGenerator(D.DkgConfig(
                 scheme=group.scheme, longterm=self.pair.key, nonce=nonce,
                 new_nodes=nodes, threshold=group.threshold))
-            out = run_dkg(gen, board, self.clock,
-                          phase_timeout or self.cfg.dkg_timeout, self.log,
-                          first_phase_extra=first_phase_extra)
+            out = run_dkg_bounded(
+                gen, board, self.clock,
+                phase_timeout or self.cfg.dkg_timeout, self.log,
+                first_phase_extra=first_phase_extra,
+                on_phase=self._journal_phase)
         finally:
             self._clear_board(board)
         return self._adopt_dkg_output(group, out)
 
     def _adopt_dkg_output(self, group: Group, out: D.DkgOutput) -> Group:
         """Filter QUAL, persist share + completed group, start the chain
-        (WaitDKG, core/drand_beacon.go:167-236)."""
+        (WaitDKG, core/drand_beacon.go:167-236).  A fresh DKG has no old
+        state to protect, so the output lands in the ACTIVE files
+        directly — atomically (key/store.py temp+fsync+rename), so a
+        crash mid-adopt leaves either no state (retry the DKG) or
+        complete state, never a torn TOML."""
         from ..key.keys import DistPublic
+        self._journal_phase(J.PHASE_ADOPT)
         group.public_key = DistPublic(list(out.commits))
         self.group = group
         self.share = (Share(scheme=group.scheme, private=out.share,
@@ -673,6 +844,9 @@ class BeaconProcess:
         if self.share is not None:
             self.fs.save_share(self.share)
         self.dkg_status = DKG_DONE
+        self.journal.finish(J.SUCCESS)
+        dkg_sessions.labels(self.beacon_id, "dkg", J.SUCCESS).inc()
+        dkg_phase_gauge.labels(self.beacon_id).set(0)
         if self.cfg.dkg_callback is not None:
             self.cfg.dkg_callback(self.beacon_id, group)
         return group
@@ -683,9 +857,13 @@ class BeaconProcess:
                             threshold: int, secret: bytes,
                             setup_timeout: float) -> Group:
         self.reshare_status = DKG_IN_PROGRESS
+        self.journal.begin("reshare", "leader")
+        dkg_phase_gauge.labels(self.beacon_id).set(
+            J.phase_index(J.PHASE_SETUP))
         self._setup_manager = SetupManager(
             self.log, old_group.scheme, self.beacon_id, n_nodes, secret,
             self.pair.public)
+        new_group = None
         try:
             self._setup_manager.wait_participants(setup_timeout)
             new_group = self._setup_manager.create_reshare_group(
@@ -693,15 +871,24 @@ class BeaconProcess:
                 reshare_offset=self.cfg.reshare_offset)
             self._push_dkg_info(new_group)
             return self._run_reshare_session(old_group, new_group)
+        except BaseException:
+            self._fail_session(
+                "reshare",
+                new_group.hash() if new_group is not None else None)
+            raise
         finally:
             self._setup_manager = None
 
     def join_reshare(self, leader: Peer, old_group: Group, secret: bytes,
                      setup_timeout: float) -> Group:
         self.reshare_status = DKG_IN_PROGRESS
-        self._setup_receiver = SetupReceiver(
-            self.log, self._fetch_leader_identity(leader))
+        self.journal.begin("reshare", "follower")
+        dkg_phase_gauge.labels(self.beacon_id).set(
+            J.phase_index(J.PHASE_SETUP))
+        new_group = None
         try:
+            self._setup_receiver = SetupReceiver(
+                self.log, self._fetch_leader_identity(leader))
             sig_packet = pb.SignalDKGPacket(
                 node=convert.identity_to_proto(self.pair.public),
                 secret_proof=hash_secret(secret),
@@ -715,6 +902,11 @@ class BeaconProcess:
             return self._run_reshare_session(
                 old_group, new_group, phase_timeout=timeout_s,
                 first_phase_extra=grace_s + 1.0)
+        except BaseException:
+            self._fail_session(
+                "reshare",
+                new_group.hash() if new_group is not None else None)
+            raise
         finally:
             self._setup_receiver = None
 
@@ -722,6 +914,10 @@ class BeaconProcess:
                              phase_timeout: int = 0,
                              first_phase_extra: float = 0.0) -> Group:
         nonce = new_group.hash()
+        self.journal.set_nonce(nonce)
+        # same-epoch retry: see _run_dkg_session
+        with self._lock:
+            self._failed_nonces.discard(nonce)
         old_nodes = self._dkg_nodes(old_group)
         new_nodes = self._dkg_nodes(new_group)
         union_peers = {n.identity.addr: Peer(n.identity.addr, n.identity.tls)
@@ -744,9 +940,11 @@ class BeaconProcess:
                 share=self.share.private if self.share else None,
                 public_coeffs=(list(old_group.public_key.coefficients)
                                if old_group.public_key else None)))
-            out = run_dkg(gen, board, self.clock,
-                          phase_timeout or self.cfg.dkg_timeout, self.log,
-                          first_phase_extra=first_phase_extra)
+            out = run_dkg_bounded(
+                gen, board, self.clock,
+                phase_timeout or self.cfg.dkg_timeout, self.log,
+                first_phase_extra=first_phase_extra,
+                on_phase=self._journal_phase)
         finally:
             self._clear_board(board)
         new_group = self._adopt_reshare_output(old_group, new_group, out)
@@ -754,33 +952,83 @@ class BeaconProcess:
 
     def _adopt_reshare_output(self, old_group: Group, new_group: Group,
                               out: D.DkgOutput) -> Group:
+        """STAGED adoption (the crash-safety core of this plane): the
+        reshare output lands in the staged files + the pending-transition
+        ledger, and the ACTIVE group/share stay untouched until the
+        handler's transition commits at the transition round.  The old
+        share therefore survives exactly as long as the chain still needs
+        it — a crash in the success→transition window restarts with the
+        old state plus the ledger, re-arms the swap, and never signs a
+        pre-transition round with the new share (nor loses the old share
+        when pre-transition rounds still need signing)."""
         from ..key.keys import DistPublic
+        self._journal_phase(J.PHASE_ADOPT)
         new_group.public_key = DistPublic(list(out.commits))
         new_share = (Share(scheme=new_group.scheme, private=out.share,
                            commits=list(out.commits))
                      if out.share is not None else None)
-        self.fs.save_group(new_group)
-        if new_share is not None:
-            self.fs.save_share(new_share)
+        self.journal.stage_transition(old_group, new_group, new_share)
+        reshare_transition_pending.labels(self.beacon_id).set(1)
         self.reshare_status = DKG_DONE
+        self.journal.finish(J.SUCCESS)
+        dkg_sessions.labels(self.beacon_id, "reshare", J.SUCCESS).inc()
+        dkg_phase_gauge.labels(self.beacon_id).set(0)
+        commit = self._commit_closure(new_group, new_share)
         if self.handler is not None:
             # running member: swap shares at transition time
             # (node.go:257-281); leavers get (group, None) and stop.
-            self.handler.transition(new_group, new_share)
+            self.handler.transition(new_group, new_share, on_commit=commit)
             self.group = new_group if new_share is not None else self.group
             self.share = new_share or self.share
         elif new_share is not None:
             # newcomer: adopt state now, start syncing, join at transition
             self.group = new_group
             self.share = new_share
-            self._start_at_transition(new_group)
+            self._start_at_transition(new_group, commit=True)
         return new_group
 
-    def _start_at_transition(self, group: Group) -> None:
-        never = threading.Event()
+    def _commit_closure(self, new_group: Group, new_share: Optional[Share]):
+        """The on_commit hook for Handler.transition: promote the staged
+        files at the moment the handler swaps shares."""
+        def commit():
+            self._commit_pending_transition(new_group, new_share)
+        return commit
+
+    def _commit_pending_transition(self, new_group: Group,
+                                   new_share: Optional[Share]) -> None:
+        """Promote the staged reshare output over the active files and
+        retire the ledger.  Idempotent (a replay after a crashed commit
+        finishes the promotion); failures are logged, never raised — the
+        in-memory transition must proceed regardless, and load-time
+        recovery will re-commit from the ledger if the disk swap was
+        lost."""
+        try:
+            committed = self.journal.commit_pending()
+        except Exception as e:
+            self.log.error("pending-transition commit failed; ledger "
+                           "kept for load-time recovery", err=str(e))
+            return
+        reshare_transition_pending.labels(self.beacon_id).set(0)
+        if committed:
+            self.log.info("reshare transition committed",
+                          transition_time=new_group.transition_time)
+        self.group = new_group if new_share is not None else self.group
+        self.share = new_share if new_share is not None else self.share
+
+    def _start_at_transition(self, group: Group, commit: bool = False)\
+            -> None:
+        """Newcomer path: park until the transition time, then commit the
+        staged state (when `commit`) and start the beacon with catchup.
+        The waiter parks on the process stop event — NOT a never-set
+        Event — so a daemon stop reaps it instead of leaking a
+        transition-<id> thread past the process lifecycle."""
+        stop = self._transition_stop
 
         def waiter():
-            self.clock.wait_until(group.transition_time, never)
+            if not self.clock.wait_until(group.transition_time, stop):
+                return      # daemon stopped before the transition
+            if commit:
+                self._commit_pending_transition(group, self.share)
             self.start_beacon(catchup=True)
         threading.Thread(target=waiter, daemon=True,
                          name=f"transition-{self.beacon_id}").start()
@@ -802,8 +1050,30 @@ class BeaconProcess:
             group, req.signature, req.dkg_timeout,
             kickoff_grace_s=req.kickoff_grace_ms / 1000.0)
 
+    @staticmethod
+    def _packet_nonce(req: pb.DKGPacket) -> bytes:
+        """The session nonce a DKG packet claims, without full bundle
+        decoding (cheap enough for the reject-before-park check)."""
+        dkg = req.dkg
+        which = dkg.WhichOneof("bundle")
+        if which == "deal":
+            return dkg.deal.session_id
+        if which == "response":
+            return dkg.response.session_id
+        if which == "justification":
+            return dkg.justification.session_id
+        return b""
+
     def broadcast_dkg(self, req: pb.DKGPacket) -> None:
         with self._lock:
+            # stale-epoch rejection: bundles from an aborted/failed
+            # session must not park in the pending buffer waiting for the
+            # NEXT board (they would be dropped there too, but an
+            # explicit error tells the straggling peer its epoch is dead)
+            nonce = self._packet_nonce(req)
+            if nonce and nonce in self._failed_nonces:
+                raise ValueError("stale DKG bundle: session "
+                                 f"{nonce.hex()[:16]} was aborted")
             if self._board is None:
                 # board not up yet (setup still finishing): park the packet;
                 # _install_board replays it.  Bad/stale packets are dropped

@@ -122,6 +122,13 @@ class DrandDaemon:
                 bp.start_beacon(catchup=True)
                 self._register_chain_hash(bp)
                 self.log.info("beacon loaded from disk", beacon_id=beacon_id)
+            elif bp.journal.load_pending() is not None:
+                # newcomer restart with a staged reshare still pending:
+                # load() armed the transition waiter — the beacon starts
+                # itself (with catchup + ledger commit) at the handover
+                self._register_chain_hash(bp)
+                self.log.info("beacon pending reshare transition; will "
+                              "start at handover", beacon_id=beacon_id)
             else:
                 self.log.info("beacon has no share yet; waiting for DKG",
                               beacon_id=beacon_id)

@@ -371,6 +371,18 @@ class RestServer:
                 status = 200
         payload = {"status": status == 200, "current": head,
                    "expected": expected}
+        # DKG/reshare lifecycle (core/dkg_journal.py): session statuses by
+        # name, the live phase, and whether a staged reshare output is
+        # waiting for its transition round — a wedged or failed session
+        # (and a pending handover) must be visible without a metrics
+        # scrape.  getattr: shim daemons in tests carry no journal.
+        if bp is not None:
+            lifecycle = getattr(bp, "dkg_lifecycle", None)
+            if callable(lifecycle):
+                try:
+                    payload["dkg"] = lifecycle()
+                except Exception:
+                    pass
         # one-line verify-service summary: the daemon-owned service when
         # one exists, else the process default (never create one here)
         svc = None

@@ -33,6 +33,11 @@ class FileStore:
     GROUP_FILE = "drand_group.toml"
     SHARE_FILE = "dist_key.private"
 
+    # staged reshare output (core/dkg_journal.py pending-transition
+    # ledger): the files a successful reshare lands in UNTIL the
+    # transition round commits them over the active pair
+    STAGED_SUFFIX = ".staged"
+
     def __init__(self, base_folder: str, beacon_id: str = ""):
         self.beacon_id = beacon_id or DEFAULT_BEACON_ID
         self.base = os.path.join(base_folder, MULTI_BEACON_FOLDER, self.beacon_id)
@@ -43,6 +48,8 @@ class FileStore:
         self.public_key_file = os.path.join(self.key_dir, self.KEY_FILE + ".public")
         self.group_file = os.path.join(self.group_dir, self.GROUP_FILE)
         self.share_file = os.path.join(self.share_dir, self.SHARE_FILE)
+        self.staged_group_file = self.group_file + self.STAGED_SUFFIX
+        self.staged_share_file = self.share_file + self.STAGED_SUFFIX
 
     # -- keypair ------------------------------------------------------------
 
@@ -50,9 +57,9 @@ class FileStore:
         ident = pair.public
         priv = (f'Key = "{pair.key:064x}"\n'
                 f'SchemeName = "{ident.scheme.id}"\n')
-        fs.write_secure_file(self.private_key_file, priv.encode())
-        with open(self.public_key_file, "w") as f:
-            f.write(self._identity_toml(ident))
+        fs.write_atomic(self.private_key_file, priv.encode(), secure=True)
+        fs.write_atomic(self.public_key_file,
+                        self._identity_toml(ident).encode())
 
     @staticmethod
     def _identity_toml(ident: Identity) -> str:
@@ -79,31 +86,41 @@ class FileStore:
 
     # -- group --------------------------------------------------------------
 
-    def save_group(self, group: Group) -> None:
-        with open(self.group_file, "w") as f:
-            f.write(group.to_toml())
+    def save_group(self, group: Group, staged: bool = False) -> None:
+        """Atomic (temp + fsync + rename): a crash mid-save leaves the old
+        group intact instead of a torn TOML that bricks the node on the
+        next load.  `staged=True` writes the reshare staging slot instead
+        of the active file (the pending-transition ledger commits it)."""
+        path = self.staged_group_file if staged else self.group_file
+        fs.write_atomic(path, group.to_toml().encode())
 
-    def load_group(self) -> Optional[Group]:
-        if not os.path.exists(self.group_file):
+    def load_group(self, staged: bool = False) -> Optional[Group]:
+        path = self.staged_group_file if staged else self.group_file
+        if not os.path.exists(path):
             return None
-        with open(self.group_file) as f:
+        with open(path) as f:
             return Group.from_toml(f.read())
 
     # -- DKG share ----------------------------------------------------------
 
-    def save_share(self, share: Share) -> None:
+    def save_share(self, share: Share, staged: bool = False) -> None:
+        """Atomic + owner-only, like save_group: the share is the one
+        secret whose loss is unrecoverable without a reshare, so the old
+        bytes must survive until the new bytes are durably in place."""
         lines = [f"Index = {share.private.index}",
                  f'Share = "{share.private.value:064x}"',
                  f'SchemeName = "{share.scheme.id}"',
                  "Commits = ["]
         lines += [f'  "{c.hex()}",' for c in share.commits]
         lines += ["]"]
-        fs.write_secure_file(self.share_file, ("\n".join(lines) + "\n").encode())
+        path = self.staged_share_file if staged else self.share_file
+        fs.write_atomic(path, ("\n".join(lines) + "\n").encode(), secure=True)
 
-    def load_share(self) -> Optional[Share]:
-        if not os.path.exists(self.share_file):
+    def load_share(self, staged: bool = False) -> Optional[Share]:
+        path = self.staged_share_file if staged else self.share_file
+        if not os.path.exists(path):
             return None
-        with open(self.share_file, "rb") as f:
+        with open(path, "rb") as f:
             doc = tomllib.load(f)
         scheme = get_scheme_by_id_with_default(doc.get("SchemeName", ""))
         return Share(
@@ -111,9 +128,33 @@ class FileStore:
             private=PriShare(index=int(doc["Index"]), value=int(doc["Share"], 16)),
             commits=[bytes.fromhex(c) for c in doc["Commits"]])
 
+    # -- staged reshare output (pending-transition ledger) -------------------
+
+    def promote_staged_group(self) -> bool:
+        """Atomically swap the staged group over the active one.  True
+        when a staged file was promoted (False = nothing staged, e.g. a
+        commit replayed after a crash that already promoted it)."""
+        if not os.path.exists(self.staged_group_file):
+            return False
+        os.replace(self.staged_group_file, self.group_file)
+        return True
+
+    def promote_staged_share(self) -> bool:
+        if not os.path.exists(self.staged_share_file):
+            return False
+        os.replace(self.staged_share_file, self.share_file)
+        return True
+
+    def discard_staged(self) -> None:
+        """Drop any staged reshare output (aborted/tampered session)."""
+        for p in (self.staged_group_file, self.staged_share_file):
+            if os.path.exists(p):
+                os.remove(p)
+
     def reset(self) -> None:
         """Remove group + share state (CLI `util reset` / `util del-beacon`)."""
-        for p in (self.group_file, self.share_file):
+        for p in (self.group_file, self.share_file,
+                  self.staged_group_file, self.staged_share_file):
             if os.path.exists(p):
                 os.remove(p)
 

@@ -214,6 +214,32 @@ integrity_scan_resumed_from = Gauge(
     "chain_integrity_scan_resumed_from",
     "Round the latest integrity scan resumed from (0 = full rescan)",
     ["beacon_id"], registry=GROUP)
+# Two-phase quarantine (chain/store.py tombstones): rows whose corrupt
+# anchor was restored and whose own bytes then re-verified — promoted
+# back from the quarantine side table instead of re-downloaded.
+integrity_promoted = Counter(
+    "chain_integrity_promoted_total",
+    "Tombstoned rows re-verified against a restored anchor and promoted "
+    "back without a peer re-fetch",
+    ["beacon_id"], registry=GROUP)
+# DKG/reshare lifecycle (core/dkg_journal.py): session outcomes, the
+# live session's phase, and whether a reshare output sits staged on disk
+# awaiting its transition round.  `result` is success|failed|aborted
+# (aborted = a crash-restart found the session mid-flight).
+dkg_sessions = Counter(
+    "dkg_sessions_total",
+    "DKG/reshare sessions by outcome",
+    ["beacon_id", "kind", "result"], registry=GROUP)
+dkg_phase_gauge = Gauge(
+    "dkg_phase",
+    "Live DKG session phase (0 idle, 1 setup, 2 deal, 3 response, "
+    "4 justification, 5 adopt)",
+    ["beacon_id"], registry=GROUP)
+reshare_transition_pending = Gauge(
+    "reshare_transition_pending",
+    "1 while a reshare output is staged on disk awaiting its transition "
+    "round (the pending-transition ledger is non-empty)",
+    ["beacon_id"], registry=GROUP)
 
 
 def scrape(which: str = "group") -> bytes:

@@ -44,6 +44,7 @@ Building blocks:
 """
 
 import hashlib
+import os
 import random
 import threading
 import types
@@ -1311,3 +1312,634 @@ class OverloadScenario:
                 bg_resumed=bool(self.bg_events)
                 and self.bg_events[-1][1] is False,
                 final_level=snap["level"])
+
+
+# ---------------------------------------------------------------------------
+# DKG/reshare lifecycle chaos (ISSUE 12): crash-safety of the one plane the
+# earlier robustness passes never covered.  `_LocalDkgNet` is an in-process
+# ProtocolClient stub routing the full DKG/beacon RPC surface between REAL
+# BeaconProcesses by address — real setup plane, real EchoBroadcast boards,
+# real session journal and pending-transition ledger on real (tmpdir)
+# FileStores, zero gRPC.  `DkgLifecycleHarness` runs an n-node network of
+# them on one FakeClock; the scenarios below crash/restart nodes at the
+# nastiest points of the lifecycle.
+# ---------------------------------------------------------------------------
+
+
+class _LocalDkgNet:
+    """ProtocolClient stand-in: routes by address with kill switches, an
+    inbound-DKG drop gate (a node that can send but not receive — the
+    hang that turns into a mid-deal crash), and a tap recording every
+    routed DKG packet (stale-bundle tests replay from it)."""
+
+    resilience = None           # BeaconProcess falls back to cfg's policy
+
+    def __init__(self):
+        self.procs: Dict[str, object] = {}
+        self.down: set = set()
+        self.drop_dkg_to: set = set()
+        self.fail_push_to: set = set()    # push_dkg_info raises (partial
+                                          # group arming, ISSUE 12)
+        self.taps: List[tuple] = []       # (dest addr, DKGPacket)
+        self._lock = threading.Lock()
+
+    def register(self, bp) -> None:
+        with self._lock:
+            self.procs[bp.pair.public.addr] = bp
+            self.down.discard(bp.pair.public.addr)
+
+    def kill(self, addr: str) -> None:
+        with self._lock:
+            self.down.add(addr)
+
+    def _bp(self, peer):
+        addr = getattr(peer, "address", None) or str(peer)
+        with self._lock:
+            if addr in self.down:
+                raise ConnectionError(f"{addr} is down")
+            bp = self.procs.get(addr)
+        if bp is None:
+            raise ConnectionError(f"no node at {addr}")
+        return bp
+
+    # -- the ProtocolClient surface BeaconProcess consumes -------------------
+
+    def get_identity(self, peer, beacon_id: str = "", deadline=None,
+                     timeout=None):
+        from drand_tpu.net import convert
+        from drand_tpu.protos import drand_pb2 as pb
+        ident = self._bp(peer).pair.public
+        return pb.IdentityResponse(
+            address=ident.addr, key=ident.key, tls=ident.tls,
+            signature=ident.signature or b"",
+            metadata=convert.metadata(beacon_id),
+            schemeName=ident.scheme.id)
+
+    def signal_dkg_participant(self, peer, packet, timeout=None,
+                               deadline=None):
+        self._bp(peer).signal_dkg_participant(packet)
+
+    def push_dkg_info(self, peer, packet, timeout=None):
+        bp = self._bp(peer)
+        with self._lock:
+            if bp.pair.public.addr in self.fail_push_to:
+                raise ConnectionError(
+                    f"{bp.pair.public.addr} refused the group push")
+        bp.push_dkg_info(packet)
+
+    def broadcast_dkg(self, peer, packet):
+        bp = self._bp(peer)
+        addr = bp.pair.public.addr
+        with self._lock:
+            self.taps.append((addr, packet))
+            if addr in self.drop_dkg_to:
+                return          # delivered nowhere: inbound partition
+        bp.broadcast_dkg(packet)
+
+    def partial_beacon(self, peer, packet, deadline=None, timeout=None):
+        bp = self._bp(peer)
+        try:
+            bp.process_partial(packet)
+        except ValueError:
+            pass                # stale/window rejections are per-protocol
+
+    def sync_chain(self, peer, from_round: int, beacon_id: str = ""):
+        # peers serve nothing: the lifecycle scenarios run thr == n, so
+        # the chain only advances in lockstep and nobody ever NEEDS sync
+        self._bp(peer)
+        return iter(())
+
+
+class DkgLifecycleHarness:
+    """n real BeaconProcesses over one _LocalDkgNet + shared FakeClock,
+    each with its own tmpdir FileStore (journal, staged files, sqlite
+    chain).  thr == n, so every node's partial is load-bearing: a node
+    signing any round with the wrong share stalls the chain — 'no
+    invalid partials' is asserted by progress itself."""
+
+    SECRET = b"lifecycle-secret"
+
+    def __init__(self, root: str, n: int = 3, period: int = 30,
+                 clock=None, dkg_timeout: int = 4, reshare_offset: int = 45,
+                 db_engine: str = "sqlite"):
+        self.root = str(root)
+        self.n = n
+        self.period = period
+        self.dkg_timeout = dkg_timeout
+        self.reshare_offset = reshare_offset
+        self.db_engine = db_engine
+        self.clock = clock if clock is not None \
+            else FakeClock(start=1_700_000_000.0)
+        self.net = _LocalDkgNet()
+        self.addrs = [f"127.0.0.1:{7100 + i}" for i in range(n)]
+        self.bps: Dict[int, object] = {}
+        self.cfgs: Dict[int, object] = {}
+        for i in range(n):
+            self.build_process(i)
+
+    def build_process(self, i: int):
+        """(Re)create node i's BeaconProcess over its on-disk state —
+        construction + load() IS the restart path under test."""
+        from drand_tpu.core.beacon_process import BeaconProcess
+        from drand_tpu.core.config import Config
+        from drand_tpu.crypto.schemes import get_scheme_by_id_with_default
+        from drand_tpu.key.keys import new_keypair
+        from drand_tpu.key.store import FileStore
+        from drand_tpu.log import Logger
+
+        folder = os.path.join(self.root, f"n{i}")
+        cfg = Config(folder=folder, clock=self.clock,
+                     db_engine=self.db_engine, use_device_verifier=False,
+                     dkg_timeout=self.dkg_timeout, dkg_kickoff_grace=0.0,
+                     reshare_offset=self.reshare_offset, sync_budget=5.0,
+                     insecure=True)
+        fstore = FileStore(folder, "default")
+        try:
+            pair = fstore.load_keypair()
+        except FileNotFoundError:
+            pair = new_keypair(self.addrs[i],
+                               get_scheme_by_id_with_default(""),
+                               tls=False, seed=b"lifecycle-%d" % i)
+            fstore.save_keypair(pair)
+        bp = BeaconProcess(cfg, fstore, "default", pair, self.net,
+                           Logger(f"n{i}"))
+        self.net.register(bp)
+        self.bps[i] = bp
+        self.cfgs[i] = cfg
+        return bp
+
+    # -- sessions ------------------------------------------------------------
+
+    def run_dkg(self, threshold: Optional[int] = None, secret: bytes = b"",
+                setup_timeout: float = 30.0, leader: int = 0,
+                start_beacons: bool = True, timeout: float = 120.0):
+        """Full networked DKG through the real control-plane entry points
+        (leader thread + follower threads, like the daemon's InitDKG)."""
+        from drand_tpu.crypto.schemes import get_scheme_by_id_with_default
+        from drand_tpu.net import Peer as NetPeer
+
+        secret = secret or self.SECRET
+        thr = threshold if threshold is not None else self.n
+        results: Dict[int, object] = {}
+        errors: List[tuple] = []
+
+        def lead():
+            try:
+                results[leader] = self.bps[leader].init_dkg_leader(
+                    n_nodes=self.n, threshold=thr, period=self.period,
+                    catchup_period=5, secret=secret,
+                    setup_timeout=setup_timeout,
+                    scheme=get_scheme_by_id_with_default(""))
+            except Exception as e:
+                errors.append((leader, e))
+
+        def follow(i):
+            try:
+                results[i] = self.bps[i].join_dkg(
+                    leader=NetPeer(self.addrs[leader]), secret=secret,
+                    setup_timeout=setup_timeout)
+            except Exception as e:
+                errors.append((i, e))
+
+        lt = threading.Thread(target=lead, daemon=True, name="dkg-leader")
+        lt.start()
+        self._await_setup(self.bps[leader])
+        fts = [threading.Thread(target=follow, args=(i,), daemon=True,
+                                name=f"dkg-follow-{i}")
+               for i in range(self.n) if i != leader]
+        for t in fts:
+            t.start()
+        for t in [lt] + fts:
+            t.join(timeout=timeout)
+        if errors:
+            raise RuntimeError(f"dkg failed: {errors}")
+        group = results[leader]
+        if start_beacons:
+            for i in range(self.n):
+                self.bps[i].start_beacon(catchup=False)
+        return group
+
+    def run_reshare(self, old_group, threshold: Optional[int] = None,
+                    secret: bytes = b"", setup_timeout: float = 30.0,
+                    leader: int = 0, timeout: float = 120.0):
+        from drand_tpu.net import Peer as NetPeer
+
+        secret = secret or self.SECRET
+        thr = threshold if threshold is not None else self.n
+        results: Dict[int, object] = {}
+        errors: List[tuple] = []
+
+        def lead():
+            try:
+                results[leader] = self.bps[leader].init_reshare_leader(
+                    old_group, n_nodes=self.n, threshold=thr,
+                    secret=secret, setup_timeout=setup_timeout)
+            except Exception as e:
+                errors.append((leader, e))
+
+        def follow(i):
+            try:
+                results[i] = self.bps[i].join_reshare(
+                    leader=NetPeer(self.addrs[leader]),
+                    old_group=self.bps[i].group or old_group,
+                    secret=secret, setup_timeout=setup_timeout)
+            except Exception as e:
+                errors.append((i, e))
+
+        lt = threading.Thread(target=lead, daemon=True, name="resh-leader")
+        lt.start()
+        self._await_setup(self.bps[leader])
+        fts = [threading.Thread(target=follow, args=(i,), daemon=True,
+                                name=f"resh-follow-{i}")
+               for i in range(self.n) if i != leader]
+        for t in fts:
+            t.start()
+        for t in [lt] + fts:
+            t.join(timeout=timeout)
+        if errors:
+            raise RuntimeError(f"reshare failed: {errors}")
+        return results[leader]
+
+    @staticmethod
+    def _await_setup(bp, timeout: float = 30.0) -> None:
+        """Block (real time) until the leader's setup manager is up, so
+        follower signals never hit the retry/backoff path (whose sleeps
+        ride the frozen fake clock)."""
+        import time as _t
+        deadline = _t.monotonic() + timeout
+        while bp._setup_manager is None:
+            if _t.monotonic() >= deadline:
+                raise TimeoutError("leader setup never came up")
+            _t.sleep(0.01)
+
+    # -- round production ----------------------------------------------------
+
+    def set_genesis(self, group) -> None:
+        self.clock.set_time(group.genesis_time)
+
+    def advance_round(self) -> None:
+        self.clock.advance(self.period)
+
+    def wait_all(self, round_: int, timeout: float = 120.0) -> List[object]:
+        out = []
+        for i in sorted(self.bps):
+            bp = self.bps[i]
+            if bp.handler is None:
+                continue
+            b = bp.handler.chain.wait_for_round(round_, timeout,
+                                                scheduled_time=True)
+            assert b is not None, f"node {i} never reached round {round_}"
+            out.append(b)
+        return out
+
+    # -- crash / restart -----------------------------------------------------
+
+    def crash(self, i: int, hard: bool = False):
+        """Process death.  `hard=False` also runs bp.stop() to reap the
+        beacon/sync threads (we share one interpreter with the 'dead'
+        process) — stop() never touches the journal/ledger/key files, so
+        the DISK is exactly what the dead process last wrote.  `hard=True`
+        skips stop() entirely: required when the victim dies MID-SESSION,
+        where stop()'s board teardown would let the session thread unwind
+        and overwrite the journal a real crash leaves behind."""
+        bp = self.bps.pop(i)
+        self.net.kill(self.addrs[i])
+        if not hard:
+            bp.stop()
+            self.cfgs[i].stop_verify_service()
+        return bp
+
+    def restart(self, i: int, start: bool = True):
+        bp = self.build_process(i)
+        loaded = bp.load()
+        if loaded and start:
+            bp.start_beacon(catchup=True)
+        return bp, loaded
+
+    def stop_all(self) -> None:
+        for i in list(self.bps):
+            try:
+                self.bps[i].stop()
+            except Exception:
+                pass
+        for cfg in self.cfgs.values():
+            try:
+                cfg.stop_verify_service()
+            except Exception:
+                pass
+
+
+@dataclass
+class ReshareCrashResult:
+    converged: bool                  # chain advanced through the handover
+    same_public_key: bool            # collective key byte-identical
+    all_rounds_verify: bool          # every beacon verifies under that key
+    old_state_served_after_restart: bool   # active files untouched by crash
+    rearm_action: str                # recovery verdict at restart ("rearm")
+    pending_before_transition: bool  # ledger present after restart
+    committed_after_transition: bool  # ledger gone + active == staged
+    head: int
+
+    @property
+    def ok(self) -> bool:
+        return (self.converged and self.same_public_key
+                and self.all_rounds_verify
+                and self.old_state_served_after_restart
+                and self.rearm_action == "rearm"
+                and self.pending_before_transition
+                and self.committed_after_transition)
+
+
+class ReshareCrashScenario:
+    """THE headline: crash between reshare success and the transition
+    round, restart, commit from the ledger, chain continues under the
+    SAME collective public key with no invalid partials.
+
+    3 nodes, thr = 3 (every partial load-bearing).  Rounds 1-2 under the
+    old group; reshare lands (staged files + ledger everywhere); the
+    victim crashes in the success→transition window; restart recovery
+    re-arms the swap from the ledger; rounds 3 (old shares — proof the
+    old share survived), 4 (the transition round: handler swap + ledger
+    commit) and 5 (steady state under the new shares) must all form."""
+
+    def __init__(self, seed: int, root: str, victim: Optional[int] = None):
+        self.seed = seed
+        self.root = root
+        dice = random.Random(stable_seed(seed, "reshare-crash"))
+        # any node but the reshare leader (0) can be the victim; the
+        # leader's session thread would die with it mid-protocol
+        self.victim = victim if victim is not None \
+            else dice.randrange(1, 3)
+
+    def run(self) -> ReshareCrashResult:
+        h = DkgLifecycleHarness(self.root, n=3, period=30,
+                                reshare_offset=45)
+        try:
+            old_group = h.run_dkg()
+            old_key = old_group.public_key.key()
+            h.set_genesis(old_group)
+            h.wait_all(1)
+            h.advance_round()
+            h.wait_all(2)
+
+            new_group = h.run_reshare(old_group)
+            same_key = new_group.public_key.key() == old_key
+            transition_round = (
+                (new_group.transition_time - new_group.genesis_time)
+                // new_group.period + 1)
+
+            # ---- the crash window: reshare succeeded, transition not yet
+            victim_fs = h.bps[self.victim].fs
+            staged_group = victim_fs.load_group(staged=True)
+            h.crash(self.victim)
+            # the dead node's ACTIVE state must still be the old epoch
+            old_served = (victim_fs.load_group().hash() == old_group.hash()
+                          and staged_group is not None
+                          and staged_group.hash() == new_group.hash())
+
+            # ---- restart: recovery must re-arm the swap from the ledger
+            bp, loaded = h.restart(self.victim, start=False)
+            pending_before = bp.journal.load_pending() is not None
+            rearm = "rearm" if (loaded and pending_before
+                                and bp._armed_transition is not None) \
+                else "other"
+            if loaded:
+                bp.start_beacon(catchup=True)
+
+            # ---- pre-transition round: old shares must still sign
+            h.advance_round()
+            h.wait_all(3)
+            # ---- the transition round: swap + ledger commit
+            h.advance_round()
+            h.wait_all(4)
+            # ---- steady state under the new shares
+            h.advance_round()
+            h.wait_all(5)
+
+            committed = (bp.journal.load_pending() is None
+                         and victim_fs.load_group().hash()
+                         == new_group.hash()
+                         and victim_fs.load_group(staged=True) is None)
+
+            # every stored round verifies under the (unchanged) key
+            scheme = old_group.scheme
+            pub = scheme.key_group.from_bytes(old_key)
+            store = h.bps[self.victim].handler.chain.store
+            all_ok = True
+            prev = old_group.get_genesis_seed() if scheme.chained else None
+            for r in range(1, 6):
+                b = store.get(r)
+                msg = scheme.digest_beacon(r, prev if scheme.chained
+                                           else None)
+                if not scheme.verify(pub, msg, b.signature):
+                    all_ok = False
+                prev = b.signature
+            head = store.last().round
+            assert transition_round == 4, transition_round
+            return ReshareCrashResult(
+                converged=head >= 5,
+                same_public_key=same_key,
+                all_rounds_verify=all_ok,
+                old_state_served_after_restart=old_served,
+                rearm_action=rearm,
+                pending_before_transition=pending_before,
+                committed_after_transition=committed,
+                head=head)
+        finally:
+            h.stop_all()
+
+
+@dataclass
+class DkgFailureResult:
+    first_attempt_failed: bool
+    status_failed_not_wedged: bool   # DKG_FAILED, not IN_PROGRESS/WAITING
+    stale_bundle_rejected: bool
+    staged_clean: bool               # no staged files left behind
+    retry_succeeded: bool
+    detail: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return (self.first_attempt_failed and self.status_failed_not_wedged
+                and self.stale_bundle_rejected and self.staged_clean
+                and self.retry_succeeded)
+
+
+class LeaderCrashSetupScenario:
+    """Leader crash DURING setup: followers' signal/identity fetches time
+    out on their budget, unwind to DKG_FAILED (steady, serveable state —
+    never a wedged WAITING), and a retry against a live leader
+    succeeds."""
+
+    def __init__(self, seed: int, root: str):
+        self.seed = seed
+        self.root = root
+
+    def run(self) -> DkgFailureResult:
+        from drand_tpu.core.beacon_process import (DKG_DONE, DKG_FAILED)
+        from drand_tpu.net import Peer as NetPeer
+
+        h = DkgLifecycleHarness(self.root, n=3,
+                                clock=AutoClock(start=1_700_000_000.0))
+        try:
+            # the leader is down before anyone signals
+            h.net.kill(h.addrs[0])
+            failed = []
+            for i in (1, 2):
+                try:
+                    h.bps[i].join_dkg(leader=NetPeer(h.addrs[0]),
+                                      secret=h.SECRET, setup_timeout=10.0)
+                except Exception:
+                    failed.append(i)
+            status_ok = all(h.bps[i].dkg_status == DKG_FAILED
+                            for i in (1, 2))
+            staged_clean = all(
+                h.bps[i].fs.load_group(staged=True) is None for i in (1, 2))
+            # leader comes back: the SAME follower processes retry
+            h.net.register(h.bps[0])
+            group = h.run_dkg(start_beacons=False)
+            retry_ok = (group is not None
+                        and all(h.bps[i].dkg_status == DKG_DONE
+                                for i in range(3)))
+            return DkgFailureResult(
+                first_attempt_failed=failed == [1, 2],
+                status_failed_not_wedged=status_ok,
+                stale_bundle_rejected=True,   # n/a: no session ever started
+                staged_clean=staged_clean,
+                retry_succeeded=retry_ok)
+        finally:
+            h.stop_all()
+
+
+class DealCrashRestartScenario:
+    """Node crash-restart mid-deal-phase: the victim's inbound DKG path
+    is partitioned (it deals, then hangs collecting), the process dies
+    there, and the restart must (a) finish the journaled session as
+    aborted → DKG_FAILED, (b) reject the dead epoch's bundles by nonce,
+    and (c) complete a fresh DKG with everyone restarted."""
+
+    def __init__(self, seed: int, root: str):
+        self.seed = seed
+        self.root = root
+
+    def run(self) -> DkgFailureResult:
+        import time as _t
+
+        from drand_tpu.core import dkg_journal as J
+        from drand_tpu.core.beacon_process import (DKG_DONE, DKG_FAILED)
+        from drand_tpu.net import Peer as NetPeer
+
+        h = DkgLifecycleHarness(self.root, n=3)
+        victim = 2
+        try:
+            h.net.drop_dkg_to.add(h.addrs[victim])
+            errors: List[tuple] = []
+
+            def lead():
+                try:
+                    from drand_tpu.crypto.schemes import \
+                        get_scheme_by_id_with_default
+                    h.bps[0].init_dkg_leader(
+                        n_nodes=3, threshold=2, period=30,
+                        catchup_period=5, secret=h.SECRET,
+                        setup_timeout=30.0,
+                        scheme=get_scheme_by_id_with_default(""))
+                except Exception as e:
+                    errors.append((0, e))
+
+            def follow(i):
+                try:
+                    h.bps[i].join_dkg(leader=NetPeer(h.addrs[0]),
+                                      secret=h.SECRET, setup_timeout=30.0)
+                except Exception as e:
+                    errors.append((i, e))
+
+            threads = [threading.Thread(target=lead, daemon=True)]
+            lt = threads[0]
+            lt.start()
+            h._await_setup(h.bps[0])
+            for i in (1, victim):
+                t = threading.Thread(target=follow, args=(i,), daemon=True)
+                threads.append(t)
+                t.start()
+
+            # wait (real time) until the victim's journal shows the deal
+            # phase — the exact point the "process" dies
+            deadline = _t.monotonic() + 60
+            vic_journal = h.bps[victim].journal
+            while True:
+                rec = vic_journal.load_session()
+                if rec is not None and rec.phase == J.PHASE_DEAL:
+                    break
+                if _t.monotonic() >= deadline:
+                    raise TimeoutError("victim never reached deal phase")
+                _t.sleep(0.02)
+            dead_nonce = bytes.fromhex(rec.nonce)
+            # HARD crash: the session thread must stay parked exactly
+            # where the process died — bp.stop() would tear the board
+            # down and let it unwind/overwrite the journal
+            h.crash(victim, hard=True)
+
+            # ---- restart the victim FIRST (the journal still says
+            # outcome=running, the honest crash artifact): recovery must
+            # finish the session as aborted → DKG_FAILED, not a wedge
+            from drand_tpu.core.beacon_process import BeaconProcess
+            bp2, loaded = h.restart(victim, start=False)
+            rec2 = bp2.journal.load_session()
+            status_ok = (not loaded
+                         and bp2.dkg_status == DKG_FAILED
+                         and rec2 is not None
+                         and rec2.outcome == J.ABORTED)
+
+            # a straggler replays a bundle from the dead epoch.  The tap
+            # may not have caught one yet (the crash races the first deal
+            # fan-out), but the SURVIVORS' sessions keep broadcasting the
+            # dead epoch — poll for a tapped packet before replaying.
+            stale = None
+            poll_deadline = _t.monotonic() + 30
+            while stale is None and _t.monotonic() < poll_deadline:
+                with h.net._lock:
+                    stale = next(
+                        (p for a, p in h.net.taps
+                         if BeaconProcess._packet_nonce(p) == dead_nonce),
+                        None)
+                if stale is None:
+                    _t.sleep(0.05)
+            rejected = False
+            if stale is not None:
+                try:
+                    bp2.broadcast_dkg(stale)
+                except ValueError:
+                    rejected = True
+
+            # unwind the survivors (and the abandoned victim thread):
+            # jump fake time past every phase window
+            for _ in range(8):
+                h.clock.advance(h.dkg_timeout + 5)
+                _t.sleep(0.05)
+            for t in threads:
+                t.join(timeout=90)
+
+            # ---- everyone restarts; a fresh session must succeed
+            h.net.drop_dkg_to.clear()
+            for i in (0, 1):
+                if i in h.bps:
+                    h.crash(i)
+                h.restart(i, start=False)
+            group = h.run_dkg(threshold=2, secret=b"fresh-after-crash",
+                              start_beacons=False)
+            retry_ok = (group is not None
+                        and all(h.bps[i].dkg_status == DKG_DONE
+                                for i in range(3)))
+            staged_clean = all(
+                h.bps[i].fs.load_group(staged=True) is None
+                for i in range(3))
+            return DkgFailureResult(
+                first_attempt_failed=True,
+                status_failed_not_wedged=status_ok,
+                stale_bundle_rejected=rejected,
+                staged_clean=staged_clean,
+                retry_succeeded=retry_ok,
+                detail=f"dead epoch {dead_nonce.hex()[:16]}")
+        finally:
+            h.stop_all()

@@ -15,9 +15,14 @@ from drand_tpu.key import DistPublic, Share, new_group, new_keypair
 
 
 # every thread the verify service owns carries one of these names
-# (crypto/verify_service.py); a daemon stop() must reap them all
+# (crypto/verify_service.py); a daemon stop() must reap them all.
+# "transition-" is the reshare transition waiter (core/beacon_process.py
+# _start_at_transition): it parks on the process stop event, so a daemon
+# stop must reap it too — it used to wait on a never-set Event and
+# outlive the daemon (the leaked transition-<id> thread bug).
 SERVICE_THREAD_PREFIXES = ("verify-scheduler", "verify-packer",
-                           "verify-watchdog", "verify-probe")
+                           "verify-watchdog", "verify-probe",
+                           "transition-")
 
 # the REST edge's threads (http_server.py): ONE acceptor + a FIXED worker
 # pool — request traffic must never grow this set (the unbounded

@@ -1,0 +1,61 @@
+"""Seeded atomic-write violations (checker: atomic).
+
+Persistent group/share/journal writes under key/ must be temp+rename
+(fs.write_atomic); every in-place truncate below is a finding, the
+tempfile+os.replace and read-mode cases are negatives.
+"""
+
+import json
+import os
+import tempfile
+
+from drand_tpu import fs
+
+
+def save_group_torn(path, group):
+    # VIOLATION: open-for-write truncates in place; a crash mid-write
+    # leaves an unparseable TOML exactly where load_group looks
+    with open(path, "w") as f:
+        f.write(group.to_toml())
+
+
+def save_share_torn(path, data: bytes):
+    # VIOLATION: os.open with O_CREAT|O_TRUNC is the same in-place
+    # truncate with extra steps
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        os.write(fd, data)
+    finally:
+        os.close(fd)
+
+
+def save_journal_appended(path, record):
+    # VIOLATION: append mode still mutates the live file in place
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def save_group_atomic(path, group):
+    # negative: spells out the discipline itself — temp sibling + rename
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+    with os.fdopen(fd, "w") as f:
+        f.write(group.to_toml())
+    os.replace(tmp, path)
+
+
+def save_share_atomic(path, data: bytes):
+    # negative: routes through the sanctioned helper
+    fs.write_atomic(path, data, secure=True)
+
+
+def load_group(path):
+    # negative: read-mode open is not a write
+    with open(path) as f:
+        return f.read()
+
+
+def save_lockfile_inplace(path):
+    # justified in-place write: a lockfile's CONTENT is meaningless,
+    # only its existence matters — torn bytes are fine
+    with open(path, "w") as f:  # tpu-vet: disable=atomic — existence-only file
+        f.write("locked")

@@ -303,6 +303,88 @@ def test_store_gap_contract(store):
         assert store.last().round == 8
 
 
+def test_store_tombstone_contract(store):
+    """Two-phase quarantine (chain/store.py): a tombstoned row leaves
+    every normal read but keeps its bytes in the side table for a later
+    promotion; dropping the tombstone (or promoting via put) retires it."""
+    chain = _mk_chain(8)
+    store.put_many(chain)
+    assert store.tombstone(5) is True
+    # gone from every normal read path…
+    with pytest.raises(ErrNoBeaconSaved):
+        store.get(5)
+    assert len(store) == 7
+    if getattr(store, "require_previous", False):
+        # strict stores treat the quarantined round as the hole it is
+        with pytest.raises(ErrMissingPrevious):
+            store.get(6)
+    else:
+        assert 5 not in [b.round for b in store.cursor()]
+    # …but the bytes survive in quarantine
+    row = store.tombstoned(5)
+    assert row is not None and row.signature == chain[5].signature
+    # tombstoning an absent round is a no-op, not an error
+    assert store.tombstone(5) is False
+    assert store.tombstone(99) is False
+    # promotion = put the verified bytes back + drop the tombstone
+    store.put(chain[5])
+    store.drop_tombstone(5)
+    assert store.get(5).signature == chain[5].signature
+    assert store.tombstoned(5) is None
+    store.drop_tombstone(5)     # idempotent
+
+
+def test_store_tombstone_survives_torn_row(store):
+    """The side table must capture the row even when its signature is a
+    torn stub a strict reader would refuse — quarantine exists exactly
+    for rows like that."""
+    chain = _mk_chain(4)
+    store.put_many(chain)
+    store.delete(2)
+    store.put(Beacon(round=2, signature=b"\x01\x02\x03",
+                     previous_sig=chain[1].signature))
+    assert store.tombstone(2) is True
+    row = store.tombstoned(2)
+    assert row is not None and row.signature == b"\x01\x02\x03"
+    with pytest.raises(ErrNoBeaconSaved):
+        store.get(2)
+
+
+def test_store_tombstone_replaces_stale_side_row(store):
+    """Re-quarantining a round must REPLACE a stale side-table row left
+    by an earlier quarantine — promotion must never resurrect old bytes
+    (sqlite INSERT OR REPLACE; postgres delete+insert; memdb dict)."""
+    chain = _mk_chain(4)
+    store.put_many(chain)
+    assert store.tombstone(2) is True         # old bytes parked
+    store.put(chain[2])                       # repaired...
+    # ...but the stale tombstone was never dropped (crash before cleanup)
+    store.delete(2)
+    fresh = Beacon(round=2, signature=b"\x42" * 96,
+                   previous_sig=chain[1].signature)
+    store.put(fresh)
+    assert store.tombstone(2) is True
+    row = store.tombstoned(2)
+    assert row is not None and row.signature == fresh.signature
+
+
+def test_sqlite_tombstone_persists(tmp_path):
+    """The sqlite side table is durable: a tombstoned row's bytes survive
+    a process restart (reopen), unlike the in-memory fallback."""
+    path = str(tmp_path / "tomb.db")
+    s = SqliteStore(path)
+    chain = _mk_chain(6)
+    s.put_many(chain)
+    assert s.tombstone(3) is True
+    s.close()
+    s2 = SqliteStore(path)
+    row = s2.tombstoned(3)
+    assert row is not None and row.signature == chain[3].signature
+    with pytest.raises(ErrNoBeaconSaved):
+        s2.get(3)
+    s2.close()
+
+
 def test_sqlite_durability_pragmas(tmp_path):
     """WAL + synchronous=NORMAL + busy_timeout on every connect (the
     crash-safe half of the store contract)."""

@@ -1,0 +1,692 @@
+"""Crash-safe DKG/reshare lifecycle (core/dkg_journal.py + the
+beacon_process staging/recovery paths): the tier-1 recovery matrix.
+
+Everything here is CPU-fast — FakeClock, tmpdir FileStores, the
+in-process `_LocalDkgNet` from tests/chaos.py instead of gRPC.  The live
+crash-during-rounds scenarios (fake-time beacon production across a
+restart) live in tests/chaos.py and run via `tools/chaos_smoke.py
+--reshare`.
+"""
+
+import json
+import os
+import queue
+import threading
+import time
+
+import pytest
+
+from drand_tpu.beacon.clock import FakeClock
+from drand_tpu.core import dkg_journal as J
+from drand_tpu.core.beacon_process import (DKG_DONE, DKG_FAILED,
+                                           DKG_IN_PROGRESS)
+from drand_tpu.core.dkg_journal import DKGJournal, recover
+from drand_tpu.core.dkg_runner import run_dkg_bounded
+from drand_tpu.crypto import tbls
+from drand_tpu.crypto.schemes import scheme_from_name
+from drand_tpu.key import DistPublic, Share, new_group, new_keypair
+from drand_tpu.key.store import FileStore
+from drand_tpu.log import Logger
+from drand_tpu.protos import drand_pb2 as pb
+
+from chaos import AutoClock, DkgLifecycleHarness
+
+SCHEME = scheme_from_name("pedersen-bls-chained")
+
+
+# ---------------------------------------------------------------------------
+# fixtures: a synthetic old/new group pair sharing one collective key
+# ---------------------------------------------------------------------------
+
+
+def _mini_state(tmp_path, n=3, thr=2, transition_offset=120):
+    """FileStore + (old group with share) + (reshare group + new share)
+    — shares fabricated from one polynomial (the harness pattern), so no
+    DKG is needed to exercise the journal/ledger machinery."""
+    pairs = [new_keypair(f"127.0.0.1:{9200 + i}", SCHEME,
+                         seed=b"lifecycle%d" % i) for i in range(n)]
+    genesis = 1_700_000_000
+    old = new_group([p.public for p in pairs], thr, genesis=genesis,
+                    period=30, catchup_period=5, scheme=SCHEME)
+    poly = tbls.PriPoly.random(thr, secret=424242)
+    commits = [SCHEME.key_group.to_bytes(c)
+               for c in poly.commit(SCHEME.key_group).commits]
+    old.public_key = DistPublic(commits)
+    old_share = Share(scheme=SCHEME, private=poly.eval(0), commits=commits)
+
+    new = new_group([p.public for p in pairs], thr, genesis=genesis,
+                    period=30, catchup_period=5, scheme=SCHEME)
+    new.genesis_seed = old.get_genesis_seed()
+    new.transition_time = genesis + transition_offset
+    # a reshare keeps commits[0] (the collective key); higher coefficients
+    # change — a distinct polynomial with the same constant term
+    poly2 = tbls.PriPoly.random(thr, secret=424242)
+    commits2 = [SCHEME.key_group.to_bytes(c)
+                for c in poly2.commit(SCHEME.key_group).commits]
+    new.public_key = DistPublic(commits2)
+    new_share = Share(scheme=SCHEME, private=poly2.eval(0), commits=commits2)
+
+    fs = FileStore(str(tmp_path), "default")
+    fs.save_group(old)
+    fs.save_share(old_share)
+    return fs, old, old_share, new, new_share
+
+
+def _journal(fs, now=1_700_000_000):
+    return DKGJournal(fs, clock=FakeClock(start=now))
+
+
+# ---------------------------------------------------------------------------
+# journal + ledger round-trips
+# ---------------------------------------------------------------------------
+
+
+def test_session_record_roundtrip(tmp_path):
+    fs, *_ = _mini_state(tmp_path)
+    j = _journal(fs)
+    j.begin("reshare", "leader")
+    j.set_nonce(b"\xaa" * 32)
+    j.phase(J.PHASE_DEAL)
+    rec = DKGJournal(fs).load_session()       # fresh instance: from disk
+    assert rec.kind == "reshare" and rec.role == "leader"
+    assert rec.nonce == "aa" * 32
+    assert rec.phase == J.PHASE_DEAL and rec.outcome == J.RUNNING
+    j.finish(J.SUCCESS)
+    assert DKGJournal(fs).load_session().outcome == J.SUCCESS
+
+
+def test_journal_tolerates_torn_session_file(tmp_path):
+    fs, *_ = _mini_state(tmp_path)
+    j = _journal(fs)
+    j.begin("dkg", "follower")
+    with open(j.session_path, "w") as f:
+        f.write('{"beacon_id": "defau')       # torn JSON
+    assert j.load_session() is None           # discarded, not trusted
+
+
+def test_stage_leaves_active_untouched_then_commit_promotes(tmp_path):
+    fs, old, old_share, new, new_share = _mini_state(tmp_path)
+    j = _journal(fs)
+    pending = j.stage_transition(old, new, new_share)
+    # the crash window's invariant: active files still the OLD epoch
+    assert fs.load_group().hash() == old.hash()
+    assert fs.load_share().private.value == old_share.private.value
+    assert fs.load_group(staged=True).hash() == new.hash()
+    assert pending.transition_time == new.transition_time
+    assert j.load_pending() is not None
+    # commit: staged -> active, ledger retired
+    assert j.commit_pending() is True
+    assert fs.load_group().hash() == new.hash()
+    assert fs.load_share().private.value == new_share.private.value
+    assert fs.load_group(staged=True) is None
+    assert j.load_pending() is None
+    assert j.commit_pending() is False        # idempotent replay
+
+
+def test_recover_rearm_before_transition(tmp_path):
+    fs, old, old_share, new, new_share = _mini_state(tmp_path)
+    j = _journal(fs)
+    j.stage_transition(old, new, new_share)
+    clock = FakeClock(start=new.transition_time - 50)
+    rec = recover(j, clock, Logger("t"))
+    assert rec.action == "rearm"
+    assert rec.group.hash() == new.hash()
+    assert rec.share.private.value == new_share.private.value
+    # nothing moved: old state still active, ledger still armed
+    assert fs.load_group().hash() == old.hash()
+    assert j.load_pending() is not None
+
+
+def test_recover_member_rearms_even_past_transition(tmp_path):
+    """A running member NEVER commits on wall-clock time alone: its chain
+    head may still sit below the transition round (a stalled old-key
+    segment needs OLD shares), so recovery re-arms and the handler's
+    time+round dual gate commits.  Old share intact until then."""
+    fs, old, old_share, new, new_share = _mini_state(tmp_path)
+    j = _journal(fs)
+    j.stage_transition(old, new, new_share)
+    clock = FakeClock(start=new.transition_time + 1000)
+    rec = recover(j, clock, Logger("t"))
+    assert rec.action == "rearm"
+    assert fs.load_group().hash() == old.hash()
+    assert fs.load_share().private.value == old_share.private.value
+    assert j.load_pending() is not None
+
+
+def test_recover_newcomer_commits_past_transition(tmp_path):
+    """A newcomer has no old share to protect: past the transition time
+    the staged state is committed immediately (start with catchup)."""
+    fs, old, old_share, new, new_share = _mini_state(tmp_path)
+    fs.reset()                                # newcomer: no active state
+    j = _journal(fs)
+    j.stage_transition(old, new, new_share)
+    clock = FakeClock(start=new.transition_time + 1)
+    rec = recover(j, clock, Logger("t"))
+    assert rec.action == "committed"
+    assert fs.load_group().hash() == new.hash()
+    assert fs.load_share().private.value == new_share.private.value
+    assert j.load_pending() is None
+
+
+def test_recover_discards_tampered_staged_share(tmp_path):
+    fs, old, old_share, new, new_share = _mini_state(tmp_path)
+    j = _journal(fs)
+    j.stage_transition(old, new, new_share)
+    # flip one byte of the staged share: the ledger digest must catch it
+    with open(fs.staged_share_file, "r+b") as f:
+        b = bytearray(f.read())
+        b[len(b) // 2] ^= 0x01
+        f.seek(0)
+        f.write(bytes(b))
+    rec = recover(j, FakeClock(start=new.transition_time - 50), Logger("t"))
+    assert rec.action == "discarded"
+    # old state intact, staged garbage + ledger gone
+    assert fs.load_group().hash() == old.hash()
+    assert fs.load_share().private.value == old_share.private.value
+    assert j.load_pending() is None
+    assert not os.path.exists(fs.staged_share_file)
+
+
+def test_recover_discards_when_staged_group_missing(tmp_path):
+    fs, old, old_share, new, new_share = _mini_state(tmp_path)
+    j = _journal(fs)
+    j.stage_transition(old, new, new_share)
+    os.remove(fs.staged_group_file)
+    rec = recover(j, FakeClock(start=new.transition_time - 50), Logger("t"))
+    assert rec.action == "discarded"
+    assert fs.load_group().hash() == old.hash()
+    assert j.load_pending() is None
+
+
+def test_recover_finishes_half_committed_swap(tmp_path):
+    """Crash in the middle of commit itself (newcomer: share promoted,
+    group still staged, ledger present) — the replayed commit must finish
+    the promotion, not discard it as tampered."""
+    fs, old, old_share, new, new_share = _mini_state(tmp_path)
+    fs.reset()                                # newcomer: no active state
+    j = _journal(fs)
+    j.stage_transition(old, new, new_share)
+    os.replace(fs.staged_share_file, fs.share_file)   # half-done commit
+    rec = recover(j, FakeClock(start=new.transition_time + 1), Logger("t"))
+    assert rec.action == "committed"
+    assert fs.load_group().hash() == new.hash()
+    assert fs.load_share().private.value == new_share.private.value
+    assert j.load_pending() is None
+
+
+def test_recover_member_half_committed_rearms_and_commit_replays(tmp_path):
+    """A MEMBER crashed mid-commit (possible only after the handler's
+    time+round gate passed): recovery re-arms with the staged pair and a
+    replayed commit_pending finishes the promotion idempotently."""
+    fs, old, old_share, new, new_share = _mini_state(tmp_path)
+    j = _journal(fs)
+    j.stage_transition(old, new, new_share)
+    os.replace(fs.staged_share_file, fs.share_file)   # half-done commit
+    rec = recover(j, FakeClock(start=new.transition_time + 1), Logger("t"))
+    assert rec.action == "rearm"
+    assert rec.group.hash() == new.hash()             # staged pair intact
+    assert j.commit_pending() is True                 # the replay finishes
+    assert fs.load_group().hash() == new.hash()
+    assert j.load_pending() is None
+
+
+def test_leaver_commit_promotes_group_and_drops_share(tmp_path):
+    fs, old, old_share, new, _ = _mini_state(tmp_path)
+    j = _journal(fs)
+    j.stage_transition(old, new, None)        # not in the new group
+    assert j.load_pending().has_share is False
+    assert j.commit_pending() is True
+    assert fs.load_group().hash() == new.hash()
+    assert fs.load_share() is None            # old share retired with exit
+
+
+def test_recover_marks_crashed_session_aborted(tmp_path):
+    fs, *_ = _mini_state(tmp_path)
+    j = _journal(fs)
+    j.begin("dkg", "follower", nonce=b"\xcd" * 32)
+    j.phase(J.PHASE_DEAL)                     # ...and the process dies here
+    rec = recover(j, FakeClock(start=1), Logger("t"))
+    assert rec.action == "none"
+    assert rec.aborted_session is not None
+    assert rec.aborted_session.phase == J.PHASE_DEAL
+    assert DKGJournal(fs).load_session().outcome == J.ABORTED
+
+
+# ---------------------------------------------------------------------------
+# atomic persistence (key/store.py via fs.write_atomic)
+# ---------------------------------------------------------------------------
+
+
+def test_write_atomic_no_residue_and_secure_mode(tmp_path):
+    from drand_tpu import fs as F
+    p = str(tmp_path / "x.toml")
+    F.write_atomic(p, b"one")
+    F.write_atomic(p, b"two", secure=True)
+    assert open(p, "rb").read() == b"two"
+    assert os.stat(p).st_mode & 0o077 == 0    # owner-only
+    # no temp siblings left behind
+    assert [f for f in os.listdir(tmp_path) if f != "x.toml"] == []
+
+
+def test_share_file_is_owner_only(tmp_path):
+    fs, old, old_share, new, new_share = _mini_state(tmp_path)
+    assert os.stat(fs.share_file).st_mode & 0o077 == 0
+    fs.save_share(new_share, staged=True)
+    assert os.stat(fs.staged_share_file).st_mode & 0o077 == 0
+
+
+# ---------------------------------------------------------------------------
+# failure hygiene at the BeaconProcess level (no network, no beacons)
+# ---------------------------------------------------------------------------
+
+
+def test_leader_setup_timeout_sets_dkg_failed_then_retry_succeeds(tmp_path):
+    h = DkgLifecycleHarness(str(tmp_path), n=3)
+    try:
+        from drand_tpu.crypto.schemes import get_scheme_by_id_with_default
+        with pytest.raises(TimeoutError):
+            # nobody signals: wait_participants expires (real seconds)
+            h.bps[0].init_dkg_leader(
+                n_nodes=3, threshold=2, period=30, catchup_period=5,
+                secret=b"s", setup_timeout=0.2,
+                scheme=get_scheme_by_id_with_default(""))
+        assert h.bps[0].dkg_status == DKG_FAILED
+        assert h.bps[0].journal.load_session().outcome == J.FAILED
+        # the beacon is immediately serveable for a fresh session
+        group = h.run_dkg(threshold=2, start_beacons=False)
+        assert group is not None
+        assert all(h.bps[i].dkg_status == DKG_DONE for i in range(3))
+    finally:
+        h.stop_all()
+
+
+def test_join_unreachable_leader_sets_dkg_failed(tmp_path):
+    from drand_tpu.net import Peer
+    h = DkgLifecycleHarness(str(tmp_path), n=2,
+                            clock=AutoClock(start=1_700_000_000.0))
+    try:
+        h.net.kill(h.addrs[0])
+        with pytest.raises(Exception):
+            h.bps[1].join_dkg(leader=Peer(h.addrs[0]), secret=b"s",
+                              setup_timeout=5.0)
+        assert h.bps[1].dkg_status == DKG_FAILED
+        assert h.bps[1].fs.load_group(staged=True) is None
+    finally:
+        h.stop_all()
+
+
+def test_partial_push_arming_unwinds_to_dkg_failed(tmp_path):
+    """ISSUE 12 satellite: the leader's group push fails against a SUBSET
+    of followers.  The leader fails immediately; the follower that WAS
+    armed must unwind via its phase deadlines to DKG_FAILED — never a
+    wedged WAITING/IN_PROGRESS — and a fresh session on the same beacons
+    must succeed."""
+    from drand_tpu.crypto.schemes import get_scheme_by_id_with_default
+    from drand_tpu.net import Peer
+
+    h = DkgLifecycleHarness(str(tmp_path), n=3)
+    try:
+        h.net.fail_push_to.add(h.addrs[2])    # bp2 refuses the group push
+        errors = []
+
+        def lead():
+            try:
+                h.bps[0].init_dkg_leader(
+                    n_nodes=3, threshold=2, period=30, catchup_period=5,
+                    secret=b"s", setup_timeout=20.0,
+                    scheme=get_scheme_by_id_with_default(""))
+            except Exception as e:
+                errors.append(e)
+
+        def follow(i, timeout):
+            try:
+                h.bps[i].join_dkg(leader=Peer(h.addrs[0]), secret=b"s",
+                                  setup_timeout=timeout)
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=lead, daemon=True),
+                   threading.Thread(target=follow, args=(1, 20.0),
+                                    daemon=True),
+                   threading.Thread(target=follow, args=(2, 2.0),
+                                    daemon=True)]
+        threads[0].start()
+        h._await_setup(h.bps[0])
+        for t in threads[1:]:
+            t.start()
+        # bp1 got the group and armed a session that will never run:
+        # advance fake time until its phase deadlines unwind it
+        deadline = time.monotonic() + 60
+        while any(t.is_alive() for t in threads):
+            h.clock.advance(10)
+            time.sleep(0.05)
+            assert time.monotonic() < deadline, "sessions never unwound"
+        assert len(errors) == 3               # all three attempts failed
+        assert h.bps[0].dkg_status == DKG_FAILED
+        assert h.bps[1].dkg_status == DKG_FAILED, \
+            "armed follower wedged instead of unwinding to DKG_FAILED"
+        assert h.bps[1].dkg_status != DKG_IN_PROGRESS
+        assert h.bps[2].dkg_status == DKG_FAILED
+        # retry with the push fixed: same processes, fresh session
+        h.net.fail_push_to.clear()
+        group = h.run_dkg(threshold=2, secret=b"retry",
+                          start_beacons=False)
+        assert group is not None
+        assert all(h.bps[i].dkg_status == DKG_DONE for i in range(3))
+    finally:
+        h.stop_all()
+
+
+def test_stale_epoch_bundle_rejected_by_nonce(tmp_path):
+    h = DkgLifecycleHarness(str(tmp_path), n=2)
+    try:
+        bp = h.bps[0]
+        dead = b"\xee" * 32
+        bp._fail_session("dkg", dead)
+        stale = pb.DKGPacket(dkg=pb.DKGBundle(
+            deal=pb.DealBundle(dealer_index=1, session_id=dead)))
+        with pytest.raises(ValueError, match="stale"):
+            bp.broadcast_dkg(stale)
+        # an unrelated epoch's early bundle still parks for the next board
+        fresh = pb.DKGPacket(dkg=pb.DKGBundle(
+            deal=pb.DealBundle(dealer_index=1, session_id=b"\x01" * 32)))
+        bp.broadcast_dkg(fresh)
+        assert len(bp._pending_dkg) == 1
+    finally:
+        h.stop_all()
+
+
+def test_retry_with_identical_group_hash_unblacklists_nonce(tmp_path):
+    """A reshare retry can legitimately reuse the failed attempt's group
+    hash (same membership/threshold/transition round): the moment a local
+    session re-adopts the nonce it leaves the blacklist, or the node
+    would reject every bundle of its own retry."""
+    h = DkgLifecycleHarness(str(tmp_path), n=2)
+    try:
+        bp = h.bps[0]
+        dead = b"\xdd" * 32
+        bp._fail_session("dkg", dead)
+        stale = pb.DKGPacket(dkg=pb.DKGBundle(
+            deal=pb.DealBundle(dealer_index=1, session_id=dead)))
+        with pytest.raises(ValueError):
+            bp.broadcast_dkg(stale)
+        with bp._lock:
+            bp._failed_nonces.discard(dead)   # what _run_dkg_session does
+        bp.broadcast_dkg(stale)               # parks, no longer rejected
+        assert len(bp._pending_dkg) == 1
+    finally:
+        h.stop_all()
+
+
+def test_public_files_stay_world_readable(tmp_path):
+    """write_atomic must not silently tighten PUBLIC artifacts to 0600:
+    the group TOML and the public identity are read by sidecar tooling
+    (only secure=True files are owner-only)."""
+    import stat
+    fs, old, *_ = _mini_state(tmp_path)
+    pair = new_keypair("127.0.0.1:9999", SCHEME, seed=b"perm")
+    fs.save_keypair(pair)
+    um = os.umask(0)
+    os.umask(um)
+    want = 0o666 & ~um
+    assert os.stat(fs.group_file).st_mode & 0o777 == want
+    assert os.stat(fs.public_key_file).st_mode & 0o777 == want
+    assert stat.S_IMODE(os.stat(fs.private_key_file).st_mode) == 0o600
+
+
+def test_failed_session_cleans_staged_output_only_for_its_epoch(tmp_path):
+    """A pending ledger staged by an EARLIER successful reshare must
+    survive a later unrelated session's failure."""
+    fs, old, old_share, new, new_share = _mini_state(tmp_path / "state")
+    h = DkgLifecycleHarness(str(tmp_path / "net"), n=2)
+    try:
+        bp = h.bps[0]
+        bp.journal.stage_transition(old, new, new_share)
+        bp._fail_session("dkg", b"\x99" * 32)     # some other epoch
+        assert bp.journal.load_pending() is not None
+        # ...but the failing epoch's own staged output IS discarded
+        bp._fail_session("reshare", bytes.fromhex(
+            bp.journal.load_pending().new_group_hash))
+        assert bp.journal.load_pending() is None
+    finally:
+        h.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# the session deadline (run_dkg_bounded)
+# ---------------------------------------------------------------------------
+
+
+class _WedgedBoard:
+    """A board whose queues never fill — the wedged-collect hang."""
+
+    def __init__(self):
+        self.deals = queue.Queue()
+        self.responses = queue.Queue()
+        self.justifications = queue.Queue()
+        self._stop = threading.Event()
+
+    def to_network(self, bundle):
+        pass
+
+    def collect(self, q, want, deadline, clock):
+        # deliberately IGNORES the phase deadline — the wedged-collect
+        # bug class the session deadline exists to contain
+        out = []
+        while len(out) < want and not self._stop.is_set():
+            try:
+                out.append(q.get(timeout=0.05))
+            except queue.Empty:
+                continue
+        return out
+
+    def stop(self):
+        self._stop.set()
+
+
+class _IdleGen:
+    dealers = [1, 2]
+    holders = [1, 2]
+
+    def generate_deals(self):
+        return None
+
+    def process_deal_bundles(self, deals):
+        raise AssertionError("phase must never complete on a wedged board")
+
+
+def test_session_deadline_frees_wedged_collect_real_cap(tmp_path):
+    """A frozen injected clock must not wedge the control RPC: the
+    real-seconds cap abandons the session."""
+    board = _WedgedBoard()
+    clock = FakeClock(start=1000.0)           # frozen: fake deadline never
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(TimeoutError, match="budget"):
+            run_dkg_bounded(_IdleGen(), board, clock, phase_timeout=100,
+                            log=Logger("t"), real_cap=1.0)
+        assert time.monotonic() - t0 < 30
+    finally:
+        board.stop()
+
+
+class _QuietGen(_IdleGen):
+    """Tolerates empty phases, so the unwinding worker would reach every
+    later on_phase call if it were not muted."""
+
+    def process_deal_bundles(self, deals):
+        return None
+
+    def process_response_bundles(self, resps):
+        return None, None
+
+    def process_justification_bundles(self, justs):
+        raise RuntimeError("no justifications")
+
+
+def test_abandoned_session_worker_goes_mute(tmp_path):
+    """After the session deadline trips, the unwinding worker must not
+    keep firing on_phase — late phase writes would scribble over the
+    journal/gauge of the failed (or a retried) session."""
+    board = _WedgedBoard()
+    clock = FakeClock(start=1000.0)
+    phases = []
+    with pytest.raises(TimeoutError):
+        run_dkg_bounded(_QuietGen(), board, clock, phase_timeout=100,
+                        log=Logger("t"), real_cap=0.5,
+                        on_phase=phases.append)
+    seen_at_timeout = list(phases)
+    board.stop()   # the abandoned collect unwinds through later phases
+    time.sleep(0.5)
+    assert phases == seen_at_timeout, \
+        f"abandoned worker kept journaling: {phases[len(seen_at_timeout):]}"
+
+
+def test_session_deadline_trips_on_clock(tmp_path):
+    """The clock-based budget trips as fake time advances (the production
+    path under a real clock)."""
+    board = _WedgedBoard()
+    clock = FakeClock(start=1000.0)
+
+    def advance():
+        for _ in range(40):
+            clock.advance(5.0)
+            time.sleep(0.02)
+
+    t = threading.Thread(target=advance, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(TimeoutError):
+            run_dkg_bounded(_IdleGen(), board, clock, phase_timeout=10,
+                            log=Logger("t"), session_budget=30.0,
+                            real_cap=60.0)
+    finally:
+        board.stop()
+        t.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# restart recovery through BeaconProcess.load (no live rounds)
+# ---------------------------------------------------------------------------
+
+
+def _stage_on(bp, transition_offset=120):
+    """Give bp on-disk old state + a staged reshare, as a successful
+    session would have left them."""
+    fs, old, old_share, new, new_share = _mini_state(
+        bp.cfg.folder + "-src", transition_offset=transition_offset)
+    bp.fs.save_group(old)
+    bp.fs.save_share(old_share)
+    bp.journal.stage_transition(old, new, new_share)
+    return old, new
+
+
+def test_load_rearms_running_member_before_transition(tmp_path):
+    h = DkgLifecycleHarness(str(tmp_path), n=2)
+    try:
+        bp = h.bps[0]
+        old, new = _stage_on(bp)
+        h.clock.set_time(new.transition_time - 60)
+        assert bp.load() is True
+        # old epoch active, swap armed for start_beacon
+        assert bp.group.hash() == old.hash()
+        assert bp._armed_transition is not None
+        assert bp._armed_transition[0].hash() == new.hash()
+        assert bp.reshare_status == DKG_DONE
+        assert bp.journal.load_pending() is not None
+    finally:
+        h.stop_all()
+
+
+def test_load_member_rearms_past_transition_keeps_old_share(tmp_path):
+    """A member restarting AFTER the transition time still re-arms: the
+    old share must survive until the chain head provably crosses the
+    transition round (catch-up sync + the handler gate handle the rest)."""
+    h = DkgLifecycleHarness(str(tmp_path), n=2)
+    try:
+        bp = h.bps[0]
+        old, new = _stage_on(bp, transition_offset=-10)   # already past
+        assert bp.load() is True
+        assert bp.group.hash() == old.hash()              # old epoch serves
+        assert bp._armed_transition is not None
+        assert bp.journal.load_pending() is not None
+    finally:
+        h.stop_all()
+
+
+def test_load_newcomer_commits_immediately_past_transition(tmp_path):
+    h = DkgLifecycleHarness(str(tmp_path), n=2)
+    try:
+        bp = h.bps[0]
+        fs_src, old, osh, new, nsh = _mini_state(
+            bp.cfg.folder + "-src", transition_offset=-10)
+        bp.journal.stage_transition(old, new, nsh)        # no active state
+        assert bp.load() is True
+        assert bp.group.hash() == new.hash()
+        assert bp._armed_transition is None
+        assert bp.journal.load_pending() is None
+        assert bp.fs.load_group().hash() == new.hash()
+    finally:
+        h.stop_all()
+
+
+def test_load_discards_tampered_ledger_keeps_old_state(tmp_path):
+    h = DkgLifecycleHarness(str(tmp_path), n=2)
+    try:
+        bp = h.bps[0]
+        old, new = _stage_on(bp)
+        os.remove(bp.fs.staged_share_file)                # tamper
+        h.clock.set_time(new.transition_time - 60)
+        assert bp.load() is True
+        assert bp.group.hash() == old.hash()
+        assert bp._armed_transition is None
+        assert bp.journal.load_pending() is None
+    finally:
+        h.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# observability: metrics + the /health dkg block
+# ---------------------------------------------------------------------------
+
+
+def test_dkg_metrics_move_on_failure(tmp_path):
+    from drand_tpu.metrics import dkg_sessions
+    h = DkgLifecycleHarness(str(tmp_path), n=2)
+    try:
+        before = dkg_sessions.labels("default", "dkg",
+                                     J.FAILED)._value.get()
+        h.bps[0]._fail_session("dkg", b"\x10" * 32)
+        assert dkg_sessions.labels("default", "dkg",
+                                   J.FAILED)._value.get() == before + 1
+    finally:
+        h.stop_all()
+
+
+def test_health_carries_dkg_block(tmp_path):
+    from drand_tpu.http_server import RestServer
+
+    h = DkgLifecycleHarness(str(tmp_path), n=2)
+    server = None
+    try:
+        bp = h.bps[0]
+        old, new = _stage_on(bp)
+        h.clock.set_time(new.transition_time - 60)
+        bp.load()
+
+        class _ShimDaemon:
+            processes = {"default": bp}
+            chain_hashes = {}
+            log = Logger("t")
+
+        server = RestServer(_ShimDaemon(), "127.0.0.1:0", clock=h.clock)
+        code, body, _ = server._route("/health")
+        payload = json.loads(body)
+        assert "dkg" in payload
+        assert payload["dkg"]["reshare"] == "done"
+        assert payload["dkg"]["transition_pending"] is True
+        assert payload["dkg"]["transition_time"] == new.transition_time
+    finally:
+        if server is not None:
+            server.httpd.server_close()
+        h.stop_all()

@@ -474,6 +474,97 @@ def test_heal_with_scan_report_quarantines_and_repairs(chain):
     assert scanner.scan(mode="full", upto=N).clean
 
 
+def test_heal_promotes_unprovable_successor_without_refetch(chain):
+    """Two-phase quarantine (ROADMAP item 6): round 10 is bit-flipped, so
+    round 11 — whose own bytes are intact — becomes UNPROVABLE (its
+    anchor rotted).  heal must re-fetch ONLY round 10 from peers, then
+    promote round 11 back from the quarantine side table once the anchor
+    verifies, instead of re-downloading it."""
+    from drand_tpu.beacon.clock import FakeClock
+    from drand_tpu.beacon.sync import SyncManager
+    from drand_tpu.core.follow import FollowFacade
+    from drand_tpu.metrics import integrity_promoted
+
+    victim = _seeded_store(chain)
+    b10 = victim.get(10)
+    victim.delete(10)
+    sig = bytearray(b10.signature)
+    sig[4] ^= 0x01
+    victim.put(Beacon(round=10, signature=bytes(sig),
+                      previous_sig=b10.previous_sig))
+
+    scanner = _scanner(chain, victim)
+    report = scanner.scan(mode="full", upto=N)
+    assert 10 in report.rounds(INVALID_SIG)
+    # round 11 is unprovable, not provably bad: every finding UNLINKED
+    kinds_11 = {f.kind for f in report.findings if f.round == 11}
+    assert kinds_11 == {UNLINKED}
+    assert report.faulty_rounds == [10, 11]
+
+    fetched = []
+
+    def fetch(peer, from_round):
+        fetched.append(from_round)
+        for r in range(from_round, N + 1):
+            yield chain.beacons[r]
+
+    facade = FollowFacade(victim, chain.scheme.chained, chain.genesis_seed)
+    syncm = SyncManager(
+        chain=facade, scheme=chain.scheme, public_key_bytes=chain.public,
+        period=30, clock=FakeClock(1), fetch=fetch, peers=["peer0"],
+        chunk=8, verifier=HostBatchVerifier(chain.scheme, chain.public))
+    p_before = integrity_promoted.labels("test-promote")._value.get()
+    remaining = syncm.heal(victim, report, beacon_id="test-promote")
+    assert remaining == []
+    # only the provably-bad anchor hit the network
+    assert 10 in fetched and 11 not in fetched
+    assert integrity_promoted.labels("test-promote")._value.get() \
+        == p_before + 1
+    # promotion retired the tombstone and the chain re-verifies clean
+    assert victim.tombstoned(11) is None
+    assert victim.get(11).signature == chain.beacons[11].signature
+    assert scanner.scan(mode="full", upto=N).clean
+
+
+def test_heal_refetches_unprovable_when_promotion_fails(chain):
+    """A tombstoned 'unprovable' row whose bytes are ACTUALLY bad (flipped
+    after the anchor rotted) must fail promotion and fall through to the
+    peer fetch — promotion never vouches for unverified bytes."""
+    from drand_tpu.beacon.clock import FakeClock
+    from drand_tpu.beacon.sync import SyncManager
+    from drand_tpu.core.follow import FollowFacade
+
+    victim = _seeded_store(chain)
+    for r in (10, 11):      # flip BOTH: 11 reads unprovable but is forged
+        b = victim.get(r)
+        victim.delete(r)
+        sig = bytearray(b.signature)
+        sig[4] ^= 0x01
+        victim.put(Beacon(round=r, signature=bytes(sig),
+                          previous_sig=b.previous_sig))
+    scanner = _scanner(chain, victim)
+    report = scanner.scan(mode="full", upto=N)
+    kinds_11 = {f.kind for f in report.findings if f.round == 11}
+    assert kinds_11 == {UNLINKED}
+
+    fetched = []
+
+    def fetch(peer, from_round):
+        fetched.append(from_round)
+        for r in range(from_round, N + 1):
+            yield chain.beacons[r]
+
+    facade = FollowFacade(victim, chain.scheme.chained, chain.genesis_seed)
+    syncm = SyncManager(
+        chain=facade, scheme=chain.scheme, public_key_bytes=chain.public,
+        period=30, clock=FakeClock(1), fetch=fetch, peers=["peer0"],
+        chunk=8, verifier=HostBatchVerifier(chain.scheme, chain.public))
+    remaining = syncm.heal(victim, report, beacon_id="test-promote-fail")
+    assert remaining == []
+    assert 11 in fetched        # promotion refused the forged bytes
+    assert scanner.scan(mode="full", upto=N).clean
+
+
 # ---------------------------------------------------------------------------
 # scan resumability (ScanCheckpoint): scheduled scans resume at the clean
 # prefix instead of rescanning from genesis

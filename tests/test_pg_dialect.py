@@ -114,7 +114,9 @@ _GOLDEN = [
     ("CREATE TABLE IF NOT EXISTS beacons ( beacon_id INT NOT NULL, round "
      "BIGINT NOT NULL, signature BYTEA NOT NULL, PRIMARY KEY (beacon_id, "
      "round) ); CREATE TABLE IF NOT EXISTS beacon_ids ( id SERIAL PRIMARY "
-     "KEY, name TEXT UNIQUE NOT NULL );", ()),
+     "KEY, name TEXT UNIQUE NOT NULL ); CREATE TABLE IF NOT EXISTS "
+     "beacons_quarantine ( beacon_id INT NOT NULL, round BIGINT NOT NULL, "
+     "signature BYTEA NOT NULL, PRIMARY KEY (beacon_id, round) );", ()),
     ("INSERT INTO beacon_ids (name) VALUES (%s) ON CONFLICT (name) "
      "DO NOTHING", (str,)),
     ("SELECT id FROM beacon_ids WHERE name = %s", (str,)),
@@ -150,6 +152,19 @@ _GOLDEN = [
      (int, int)),
     # delete
     ("DELETE FROM beacons WHERE beacon_id=%s AND round=%s", (int, int)),
+    # tombstone (two-phase quarantine): probe, replace-move, delete
+    ("SELECT 1 FROM beacons WHERE beacon_id=%s AND round=%s", (int, int)),
+    ("DELETE FROM beacons_quarantine WHERE beacon_id=%s AND round=%s",
+     (int, int)),
+    ("INSERT INTO beacons_quarantine (beacon_id, round, signature) SELECT "
+     "beacon_id, round, signature FROM beacons WHERE beacon_id=%s AND "
+     "round=%s", (int, int)),
+    ("DELETE FROM beacons WHERE beacon_id=%s AND round=%s", (int, int)),
+    # tombstoned + drop_tombstone
+    ("SELECT signature FROM beacons_quarantine WHERE beacon_id=%s AND "
+     "round=%s", (int, int)),
+    ("DELETE FROM beacons_quarantine WHERE beacon_id=%s AND round=%s",
+     (int, int)),
 ]
 
 
@@ -168,6 +183,9 @@ def test_pg_transcript_golden(tmp_path):
     assert cur.next().round == 2
     assert cur.seek(2).round == 2
     s.delete(1)
+    assert s.tombstone(2) is True
+    assert s.tombstoned(2).signature == b"\x02" * 96
+    s.drop_tombstone(2)
     s.close()
 
     for sql, args in drv.transcript:

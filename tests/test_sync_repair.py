@@ -210,3 +210,42 @@ def test_chaos_store_faults_detected_and_repaired_through_raw(chain):
     assert syncm.check_past_beacons(N) == []   # healed rows re-verify
     for r in range(1, N + 1):
         assert raw.get(r).signature == chain.beacons[r].signature
+
+
+def test_sync_server_fills_previous_sig_from_trimmed_store(tmp_path, chain):
+    """A sqlite/postgres-backed daemon stores rows TRIMMED (no
+    previous_sig), but a chained-scheme peer cannot link or verify a
+    sync stream that omits it: the serving side must fill it from the
+    stream walk (regression: a restarted node could never catch up from
+    sqlite-backed peers — every chunk failed the linkage check)."""
+    import threading
+    import types
+
+    from drand_tpu.beacon.sync import SyncChainServer
+    from drand_tpu.chain.sqlitedb import SqliteStore
+
+    store = SqliteStore(str(tmp_path / "trimmed.db"))   # trimmed format
+    for b in chain.beacons.values():
+        store.put(b)
+    assert store.get(3).previous_sig is None            # really trimmed
+
+    class _NoCb:
+        def add_callback(self, *a):
+            pass
+
+        def remove_callback(self, *a):
+            pass
+
+    facade = types.SimpleNamespace(
+        store=store, cbstore=_NoCb(),
+        group=types.SimpleNamespace(scheme=chain.scheme))
+    stop = threading.Event()
+    gen = SyncChainServer(facade).stream("peer", 2, stop=stop)
+    got = [next(gen) for _ in range(N - 1)]             # rounds 2..N
+    stop.set()
+    gen.close()
+    assert [b.round for b in got] == list(range(2, N + 1))
+    for b in got:
+        assert b.previous_sig == chain.beacons[b.round - 1].signature, \
+            f"round {b.round} streamed without its walk anchor"
+    store.close()

@@ -264,6 +264,35 @@ def test_bounds_checker_catches_fixture():
                 if f.path == "net/bounds_bad.py"]) == 1
 
 
+def test_atomic_checker_catches_fixture():
+    report = _fixture_report("atomic")
+    codes = _codes(report, "key/atomic_bad.py")
+    assert ("key/atomic_bad.py", "atomic-write-in-place") in codes
+    lines = {f.line for f in report.findings
+             if f.path == "key/atomic_bad.py"}
+    # open("w"), os.open(O_CREAT|O_TRUNC), open("a") — all caught
+    assert len(lines) == 3, sorted(lines)
+    msgs = [f.message for f in report.findings
+            if f.path == "key/atomic_bad.py"]
+    # the tempfile+os.replace and fs.write_atomic routes stay silent
+    assert not any("save_group_atomic" in m or "save_share_atomic" in m
+                   or "load_group" in m for m in msgs)
+    # the justified lockfile write is a suppression, not a finding
+    assert len([f for f in report.suppressed
+                if f.path == "key/atomic_bad.py"]) == 1
+
+
+def test_atomic_checker_scoped_to_key_plane(tmp_path):
+    """An in-place write OUTSIDE key/ + core/dkg_journal.py is not this
+    checker's business (e.g. bench JSON dumps are not identity state)."""
+    src = tmp_path / "bench_out.py"
+    src.write_text("def dump(path, data):\n"
+                   "    with open(path, 'w') as f:\n"
+                   "        f.write(data)\n")
+    report = run_vet([str(src)], checkers=by_names(["atomic"]))
+    assert report.findings == []
+
+
 def test_bounds_checker_scoped_to_serving_paths(tmp_path):
     """An unbounded queue OUTSIDE net//http_server.py/relay.py is not
     this checker's business (internal planes are bounded upstream)."""
@@ -432,7 +461,7 @@ def test_cli_write_baseline_then_clean(tmp_path, capsys):
 
 def test_checker_registry_names_are_suppression_tokens():
     assert checker_names() == ["clock", "lock", "secret", "trace", "store",
-                               "verifier", "wait", "bounds"]
-    assert len(ALL_CHECKERS) == 8
+                               "verifier", "wait", "bounds", "atomic"]
+    assert len(ALL_CHECKERS) == 9
     with pytest.raises(KeyError):
         by_names(["not-a-checker"])

@@ -105,6 +105,59 @@ def device_main(args) -> int:
     return 0 if result.ok and sync.ok and iso.ok else 1
 
 
+def reshare_main(args) -> int:
+    """--reshare mode: the DKG/reshare lifecycle chaos suite.  The
+    headline — a node crashes BETWEEN reshare success and the transition
+    round, restarts, commits the pending transition from the ledger, and
+    the chain continues under the byte-identical collective public key
+    with no invalid partials — plus leader-crash-during-setup (followers
+    unwind to DKG_FAILED and the retry succeeds) and crash-restart
+    mid-deal-phase (aborted session reported, stale epoch bundles
+    rejected by nonce, fresh session succeeds)."""
+    import tempfile
+
+    from chaos import (DealCrashRestartScenario, LeaderCrashSetupScenario,
+                       ReshareCrashScenario)
+
+    with tempfile.TemporaryDirectory() as root:
+        r = ReshareCrashScenario(seed=args.seed,
+                                 root=os.path.join(root, "reshare")).run()
+        print(f"seed            : {args.seed}")
+        print(f"converged       : {r.converged} (head {r.head})")
+        print(f"same public key : {r.same_public_key}")
+        print(f"rounds verify   : {r.all_rounds_verify}")
+        print(f"old state kept  : {r.old_state_served_after_restart} "
+              "(crash window: active files untouched)")
+        print(f"recovery action : {r.rearm_action} "
+              f"(ledger pending={r.pending_before_transition})")
+        print(f"ledger committed: {r.committed_after_transition}")
+
+        lc = LeaderCrashSetupScenario(
+            seed=args.seed, root=os.path.join(root, "leader")).run()
+        print(f"leader crash    : failed->DKG_FAILED="
+              f"{lc.status_failed_not_wedged} "
+              f"retry={lc.retry_succeeded}")
+
+        dc = DealCrashRestartScenario(
+            seed=args.seed, root=os.path.join(root, "deal")).run()
+        print(f"mid-deal crash  : aborted->DKG_FAILED="
+              f"{dc.status_failed_not_wedged} "
+              f"stale-rejected={dc.stale_bundle_rejected} "
+              f"retry={dc.retry_succeeded} "
+              f"staged-clean={dc.staged_clean} ({dc.detail})")
+        if not (r.ok and lc.ok and dc.ok):
+            print(f"FAILED: reshare={r!r}\nleader={lc!r}\ndeal={dc!r}")
+
+    from drand_tpu.metrics import scrape
+    lines = [l for l in scrape("group").decode().splitlines()
+             if l.startswith(("dkg_sessions_total", "dkg_phase",
+                              "reshare_transition_pending"))]
+    print("dkg series      :")
+    for line in lines:
+        print(f"  {line}")
+    return 0 if r.ok and lc.ok and dc.ok else 1
+
+
 def overload_main(args) -> int:
     """--overload mode: the serving-plane overload scenario — a seeded
     public read flood plus one sync-hog peer during live rounds.  The
@@ -163,6 +216,12 @@ def main() -> int:
                          "(read flood + sync-hog peer; admission "
                          "control + degradation ladder) instead of the "
                          "network chaos scenario")
+    ap.add_argument("--reshare", action="store_true",
+                    help="run the DKG/reshare lifecycle chaos suite "
+                         "(crash between reshare success and transition "
+                         "+ leader crash in setup + crash-restart "
+                         "mid-deal) instead of the network chaos "
+                         "scenario")
     args = ap.parse_args()
 
     if args.storage:
@@ -171,6 +230,8 @@ def main() -> int:
         return device_main(args)
     if args.overload:
         return overload_main(args)
+    if args.reshare:
+        return reshare_main(args)
 
     from chaos import ChaosScenario
 
