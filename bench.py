@@ -85,6 +85,14 @@ N_MIXED = int(os.environ.get("DRAND_TPU_BENCH_N_MIXED", "4096"))
 N_MD = int(os.environ.get("DRAND_TPU_BENCH_N_MD", str(2 * PAD)))
 MD_MAX_CHAINS = int(os.environ.get("DRAND_TPU_BENCH_MD_CHAINS", "4"))
 CHUNK = int(os.environ.get("DRAND_TPU_BENCH_CHUNK", str(PAD)))
+# config 8 (ISSUE 13): committee size for the in-process Handel
+# aggregation + device-DKG measurements; rounds timed after warmup.
+# The signing-fixture and host-commit setup scale with COMMITTEE_N, so
+# CPU smokes should set DRAND_TPU_BENCH_COMMITTEE_N=64 or so.
+COMMITTEE_N = int(os.environ.get("DRAND_TPU_BENCH_COMMITTEE_N", "1024"))
+COMMITTEE_ROUNDS = int(os.environ.get("DRAND_TPU_BENCH_COMMITTEE_ROUNDS",
+                                      "4"))
+COMMITTEE_DKG_T = int(os.environ.get("DRAND_TPU_BENCH_COMMITTEE_T", "32"))
 
 
 def _progress(msg):
@@ -96,13 +104,13 @@ def _progress(msg):
 
 
 def _configs():
-    raw = os.environ.get("DRAND_TPU_BENCH_CONFIGS", "1,2,3,4,5,6,7")
+    raw = os.environ.get("DRAND_TPU_BENCH_CONFIGS", "1,2,3,4,5,6,7,8")
     out = set()
     for x in raw.split(","):
         x = x.strip()
-        if x.isdigit() and 1 <= int(x) <= 7:
+        if x.isdigit() and 1 <= int(x) <= 8:
             out.add(int(x))
-    return out or {1, 2, 3, 4, 5, 6, 7}
+    return out or {1, 2, 3, 4, 5, 6, 7, 8}
 
 
 def _jax_setup():
@@ -576,6 +584,112 @@ def bench_multidevice_scaleout(stats):
         svc.stop()
 
 
+def bench_committee_scale(stats):
+    """Config 8 (ISSUE 13): the committee-scale engine, in-process.
+
+    (a) n=COMMITTEE_N Handel aggregation: one observed node's session
+        per round is fed ideal-honest candidate aggregates for every
+        tree level, and the whole committee's partials verify in the
+        session's ONE windowed `verify` call per round — the
+        (1, n)-shaped partials RLC program, so aggregating a
+        thousand-signer round costs one dispatch, not n pairings.
+        Timed after a warmup round compiles the program; value =
+        aggregation rounds/s.
+    (b) device DKG share verification at the same n: dispatch count and
+        wall time for the full bundle-set check plus the reshare
+        constant-term pin (the <= 4 dispatch acceptance, self-reported
+        in stats).
+    """
+    from drand_tpu.beacon import handel as HD
+    from drand_tpu.beacon.chainstore import DevicePartialVerifier
+    from drand_tpu.crypto import dkg_device, schemes, tbls
+    from drand_tpu.crypto.host.params import R as _R
+    import random as _random
+
+    n, rounds = COMMITTEE_N, COMMITTEE_ROUNDS
+    rng = _random.Random(0xC0117EE)
+    sch = schemes.scheme_from_name(schemes.SHORT_SIG_SCHEME_ID)
+    # polynomial degree decoupled from the protocol threshold (recovery
+    # interpolates from any >= t shares); keeps host setup bounded
+    poly = tbls.PriPoly([rng.randrange(_R) for _ in range(8)])
+    pub_poly = poly.commit(sch.key_group)
+    thr = n // 2 + 1
+    _progress(f"committee fixture: signing {n} partials x "
+              f"{rounds + 1} rounds")
+    shares = [poly.eval(i).value for i in range(n)]
+    from drand_tpu.crypto import batch as _batch
+    per_round = []
+    for r in range(1, rounds + 2):      # +1 warmup round
+        msg = sch.digest_beacon(r, None)
+        # one msg under n different keys does not batch on-device; the
+        # host (native) signer is the fixture generator, not measured
+        per_round.append((msg, {i: i.to_bytes(2, "big")
+                                + sch.sign(shares[i], msg)
+                                for i in range(n)}))
+
+    verifier = DevicePartialVerifier(sch, pub_poly, n)
+    levels = HD.num_levels(n)
+    cfg = HD.HandelConfig(min_group=2, fanout=4, window=2 * levels + 2,
+                          bad_limit=3)
+
+    def one_round(r, msg, partials):
+        done = {}
+        sess = HD.HandelSession(cfg, n, 0, thr, r, None, msg, verifier,
+                                send=lambda *a: None,
+                                on_complete=lambda p: done.update(p))
+        sess.add_own(partials[0])
+        # ideal-honest peers: one full-side candidate per level, all
+        # delivered before the tick so the window coalesces the whole
+        # committee into one verify call
+        for level in range(1, levels + 1):
+            block = HD.level_block(n, 0, level)
+            sender = block[0]
+            side = HD.own_block(n, sender, level)
+            sess.receive(level, sender,
+                         HD.Aggregate({i: partials[i] for i in side}))
+        sess.tick()
+        assert len(sess.verified) == n and len(done) >= thr
+        return done
+
+    before_d = _batch.dispatch_count()
+    one_round(1, *per_round[0])                 # warmup/compile
+    _progress("committee aggregation program compiled")
+    warm_dispatches = _batch.dispatch_count() - before_d
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        one_round(r + 2, *per_round[r + 1])
+    dt = time.perf_counter() - t0
+    stats["committee_scale_n"] = n
+    stats["committee_scale_levels"] = levels
+    stats["committee_scale_dispatches_per_round"] = warm_dispatches
+    stats["committee_scale_agg_rounds_per_s"] = round(rounds / dt, 3)
+
+    # (b) device DKG share-verify at n
+    _progress(f"committee DKG fixture: {n} dealers x t={COMMITTEE_DKG_T}")
+    g = sch.key_group
+    t = COMMITTEE_DKG_T
+    dpolys = [tbls.PriPoly([rng.randrange(_R) for _ in range(t)])
+              for _ in range(n)]
+    dcommits = [[g.curve.mul(g.curve.gen, c) for c in p.coeffs]
+                for p in dpolys]
+    holder = 3
+    dshares = [p.eval(holder).value for p in dpolys]
+    before = dkg_device.dispatch_count()
+    t0 = time.perf_counter()
+    ok = dkg_device.verify_shares(g, dcommits, holder, dshares)
+    old = dcommits[0]
+    ctm = dkg_device.constant_terms_match(
+        g, old, range(n), [tbls.PubPoly(g, old).eval(d) for d in range(n)])
+    dkg_dt = time.perf_counter() - t0
+    assert all(ok) and all(ctm)
+    stats["committee_dkg_n"] = n
+    stats["committee_dkg_t"] = t
+    stats["committee_dkg_dispatches"] = \
+        dkg_device.dispatch_count() - before
+    stats["committee_dkg_wall_s"] = round(dkg_dt, 2)
+    return rounds / dt
+
+
 _RUNNERS = {
     1: "chained_catchup",
     2: "unchained_resident",
@@ -584,11 +698,13 @@ _RUNNERS = {
     5: "streamed_store",
     6: "coalesced_service",
     7: "multidevice_scaleout",
+    8: "committee_scale",
 }
 # Order: config 2 compiles/loads the shared G1@PAD program that 5, 6, 7,
 # 3 and 4 reuse; G2 (1, then 4) go after the G1 family so a G2 compile
-# overrun cannot starve the G1 numbers.
-_ORDER = [2, 5, 6, 7, 3, 1, 4]
+# overrun cannot starve the G1 numbers; 8 last (its (1, n) partials
+# program is unique to it).
+_ORDER = [2, 5, 6, 7, 3, 1, 4, 8]
 
 
 def _child(indices):
@@ -605,6 +721,7 @@ def _child(indices):
             5: lambda: bench_streamed_store(stats),
             6: lambda: bench_coalesced_service(stats),
             7: lambda: bench_multidevice_scaleout(stats),
+            8: lambda: bench_committee_scale(stats),
         }
         t0 = time.monotonic()
         try:
@@ -674,6 +791,7 @@ def _emit(configs, stats):
               "mixed_4chains": N_CHAINED + 3 * N_MIXED,
               "coalesced_service": N_STREAM,
               "multidevice_scaleout": N_MD,
+              "committee_scale": COMMITTEE_N,
               **stats},
     }
     print(json.dumps(out), flush=True)

@@ -76,17 +76,48 @@ class PartialCache:
             if rc is None:
                 rc = self._rounds[key] = _RoundCache(round_, prev_sig)
             if rc.append(partial):
-                seen = self._per_node.setdefault(idx, OrderedDict())
-                if key not in seen:
-                    seen[key] = True
-                    if len(seen) > self._max_per_node:
-                        evict_key, _ = seen.popitem(last=False)
-                        evicted = self._rounds.get(evict_key)
-                        if evicted is not None:
-                            evicted.partials.pop(idx, None)
-                            if not evicted.partials:
-                                del self._rounds[evict_key]
+                self._note_occupancy_locked(idx, key)
             return rc
+
+    def put_verified(self, round_: int, prev_sig: Optional[bytes],
+                     partial: bytes) -> "_RoundCache":
+        """Insert a partial KNOWN-GOOD for this (round, prev_sig) — the
+        Handel overlay batch-verified it against the same digest.  Unlike
+        `append`, it may EVICT an occupant of the signer slot whose bytes
+        are not themselves verified-good: an ingress forgery (valid index,
+        garbage sig — the cheap checks can't tell) must not squat the slot
+        of an honestly verified partial, or one packet per node per round
+        wedges aggregation at threshold-1.  A verified-good occupant is
+        never displaced, and bytes previously marked bad never re-enter."""
+        idx = index_of(partial)
+        key = self._key(round_, prev_sig)
+        with self._lock:
+            rc = self._rounds.get(key)
+            if rc is None:
+                rc = self._rounds[key] = _RoundCache(round_, prev_sig)
+            if rc.checked.get(partial) is False:
+                return rc       # an explicit bad verdict is final
+            rc.checked[partial] = True
+            cur = rc.partials.get(idx)
+            if cur is None or (cur != partial
+                               and rc.checked.get(cur) is not True):
+                rc.partials[idx] = partial
+                self._note_occupancy_locked(idx, key)
+            return rc
+
+    def _note_occupancy_locked(self, idx: int, key) -> None:
+        """Per-signer FIFO bookkeeping + eviction.  Caller holds _lock
+        (both call sites acquire it around the whole insert)."""
+        seen = self._per_node.setdefault(idx, OrderedDict())
+        if key not in seen:
+            seen[key] = True
+            if len(seen) > self._max_per_node:
+                evict_key, _ = seen.popitem(last=False)
+                evicted = self._rounds.get(evict_key)
+                if evicted is not None:
+                    evicted.partials.pop(idx, None)
+                    if not evicted.partials:
+                        del self._rounds[evict_key]  # tpu-vet: disable=lock
 
     def get(self, round_: int, prev_sig: Optional[bytes]) -> Optional[_RoundCache]:
         with self._lock:

@@ -166,6 +166,25 @@ class ChainStore:
         """Feed one ingress-validated partial (chainstore.go:106)."""
         self._partials.put((round_, prev_sig, partial))
 
+    def aggregate_verified(self, round_: int, prev_sig: Optional[bytes],
+                           partials) -> None:
+        """Handel delivery (beacon/handel.py): the overlay hands over a
+        set of partials it ALREADY batch-verified through the verify
+        service.  The verdicts are recorded in the round cache keyed by
+        exact bytes — the same structure the aggregator consults — so
+        recovery proceeds without re-verifying, and a partial the flat
+        path would have rejected can never sneak in (a pre-existing False
+        verdict for the same bytes is never overwritten).  Insertion uses
+        `put_verified`: a known-good partial may displace an UNVERIFIED
+        squatter in its signer slot (an ingress forgery with a valid
+        index would otherwise hold the slot until threshold-time
+        verification pops it — after the overlay's delivery was already
+        consumed, wedging the round at threshold-1).  Processing still
+        rides the single aggregator thread."""
+        for p in partials:
+            self.cache.put_verified(round_, prev_sig, p)
+            self._partials.put((round_, prev_sig, p))
+
     def _run_aggregator(self) -> None:
         while not self._stop.is_set():
             try:

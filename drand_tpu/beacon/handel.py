@@ -1,0 +1,661 @@
+"""Handel aggregation overlay: committee-scale partial aggregation
+(arXiv:1906.05132; ISSUE 13, ROADMAP item 3).
+
+The flat fan-out (`core/beacon_process._broadcast_partial`) is all-to-all:
+n² messages per round and one giant verification set at the aggregator.
+Handel replaces it above a committee-size threshold with a binomial-tree
+overlay — node i's *level l* partners are the ids whose bit (l-1) differs
+from i's (the mirror block of size 2^(l-1)) — so each node exchanges
+*candidate aggregates* per level and the full aggregate emerges in
+O(log n) hops.
+
+Adaptation to threshold BLS: Handel's multisigs add; tBLS partials are
+combined by Lagrange interpolation over the FINAL signer set, so an
+"aggregate" here is the partial *set* itself (bitmap + partial sigs) and
+merging is set union.  Verification cost is therefore per-partial, which
+is exactly the shape the batched device verifier is built for:
+
+  * **windowed verification** — each tick, the best-scored pending
+    candidates (up to `window`) contribute their unseen partials to ONE
+    `verifier.verify(msg, partials)` call.  In the daemon that verifier
+    is the verify service's `_PartialLaneVerifier` (`submit_call` on the
+    LIVE lane), so every level's scored window coalesces into the same
+    RLC device dispatch that flat aggregation uses — candidates ride one
+    dispatch, never one check each.
+  * **scoring-driven peer selection** — send targets are ranked by the
+    `net/resilience.py` score snapshot (the breaker/rank state the sync
+    and fan-out planes already maintain — READ-ONLY here; transport
+    failures feed it in the client, where they are actually observed)
+    plus local demotion state, with one rotating exploration slot per
+    level so every non-demoted peer is eventually polled.
+  * **Byzantine tolerance** — a candidate carrying an invalid partial,
+    out-of-block signers, or an oversized set *demotes* the contributor
+    SESSION-LOCALLY (sender_index is self-declared on the wire, so
+    content offences are never attributed into the shared transport
+    registry — a spoofed packet must not be able to open an honest
+    peer's breaker); its valid partials are still adopted and the level
+    never wedges.  After `bad_limit` offences the peer stops being
+    polled entirely — Handel's "stop paying for unresponsive peers".
+    A claimed sender OUTSIDE the level's block is dropped with no
+    penalty at all: that is the one violation an attacker can aim at an
+    arbitrary victim.
+
+`HandelSession` is a pure lock-guarded state machine (receive()/tick());
+`HandelCoordinator` is the daemon wrapper: per-round sessions, a tick
+thread on the injected clock, wire codec, and delivery of the verified
+set back to the aggregation plane (`ChainStore.aggregate_verified` — the
+partials arrive pre-verified, so the aggregator recovers without
+re-checking; verdicts are keyed by exact partial bytes, bit-identical to
+the flat path's).
+"""
+
+import os
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..crypto.tbls import index_of
+from ..log import Logger
+from .clock import Clock, RealClock
+
+# knobs (COMPONENTS.md "Committee-scale engine"; Config.handel_* pins)
+DEFAULT_MIN_GROUP = int(os.environ.get("DRAND_HANDEL_MIN_GROUP", "129"))
+DEFAULT_FANOUT = int(os.environ.get("DRAND_HANDEL_FANOUT", "4"))
+DEFAULT_WINDOW = int(os.environ.get("DRAND_HANDEL_WINDOW", "16"))
+DEFAULT_BAD_LIMIT = int(os.environ.get("DRAND_HANDEL_BAD_LIMIT", "3"))
+DEFAULT_LEVEL_TICKS = int(os.environ.get("DRAND_HANDEL_LEVEL_TICKS", "4"))
+DEFAULT_SESSION_CAP = 8         # concurrent per-round sessions kept
+
+
+# ---------------------------------------------------------------------------
+# tree layout
+# ---------------------------------------------------------------------------
+
+def num_levels(n: int) -> int:
+    """Height of the binomial tree over n ids (1 level for n=2)."""
+    return (n - 1).bit_length() if n > 1 else 0
+
+
+def level_block(n: int, me: int, level: int) -> List[int]:
+    """The mirror block node `me` exchanges with at `level`: ids agreeing
+    with me above bit (level-1), differing at it — size 2^(level-1),
+    clipped to the committee."""
+    size = 1 << (level - 1)
+    base = (me ^ size) & ~(size - 1)
+    return [i for i in range(base, base + size) if i < n]
+
+
+def own_block(n: int, me: int, level: int) -> List[int]:
+    """The ids my own candidate for `level` may cover (my side of the
+    split: the size-2^(level-1) block containing me)."""
+    size = 1 << (level - 1)
+    base = me & ~(size - 1)
+    return [i for i in range(base, base + size) if i < n]
+
+
+# ---------------------------------------------------------------------------
+# aggregates
+# ---------------------------------------------------------------------------
+
+class Aggregate:
+    """One candidate: a set of tBLS partials keyed by signer index."""
+
+    __slots__ = ("partials",)
+
+    def __init__(self, partials: Optional[Dict[int, bytes]] = None):
+        self.partials: Dict[int, bytes] = dict(partials or {})
+
+    @property
+    def weight(self) -> int:
+        return len(self.partials)
+
+    def indices(self):
+        return self.partials.keys()
+
+    def bitmask(self, n: int) -> bytes:
+        """Little-endian signer bitmap (the cheap wire summary)."""
+        mask = 0
+        for i in self.partials:
+            mask |= 1 << i
+        return mask.to_bytes((n + 7) // 8, "little")
+
+    @classmethod
+    def from_partials(cls, partials) -> "Aggregate":
+        out = {}
+        for p in partials:
+            if len(p) < 2:
+                continue
+            out.setdefault(index_of(p), p)
+        return cls(out)
+
+
+# ---------------------------------------------------------------------------
+# the per-round state machine
+# ---------------------------------------------------------------------------
+
+class HandelConfig:
+    def __init__(self, min_group: int = 0, fanout: int = 0, window: int = 0,
+                 bad_limit: int = 0, level_ticks: int = 0,
+                 tick: float = 0.0, session_cap: int = 0):
+        self.min_group = min_group or DEFAULT_MIN_GROUP
+        self.fanout = fanout or DEFAULT_FANOUT
+        self.window = window or DEFAULT_WINDOW
+        self.bad_limit = bad_limit or DEFAULT_BAD_LIMIT
+        self.level_ticks = level_ticks or DEFAULT_LEVEL_TICKS
+        self.tick = tick            # 0 = derive from the beacon period
+        self.session_cap = session_cap or DEFAULT_SESSION_CAP
+
+    def level_budget(self, n: int) -> int:
+        """Ticks a healthy committee gets to complete every level (the
+        chaos scenario's convergence bar)."""
+        return max(1, num_levels(n)) * self.level_ticks
+
+
+class HandelSession:
+    """One node's aggregation state for one (round, prev_sig).
+
+    Deterministic: all progress happens inside `receive()` (ingress) and
+    `tick()` (the verification window + the scored send pass), so a
+    FakeClock harness can single-step a thousand-signer committee."""
+
+    def __init__(self, cfg: HandelConfig, n: int, me: int, threshold: int,
+                 round_: int, prev_sig: Optional[bytes], msg: bytes,
+                 verifier, send: Callable[[int, int, Aggregate], None],
+                 scorer=None, score_key: Optional[Callable[[int], str]] = None,
+                 on_complete: Optional[Callable[[Dict[int, bytes]], None]]
+                 = None,
+                 on_demote: Optional[Callable[[int], None]] = None):
+        self.cfg = cfg
+        self.n = n
+        self.me = me
+        self.threshold = threshold
+        self.round = round_
+        self.prev_sig = prev_sig
+        self.msg = msg
+        self.verifier = verifier
+        self.send = send
+        self.scorer = scorer                 # BreakerRegistry (or None)
+        self.score_key = score_key or (lambda idx: f"handel-{idx}")
+        self.on_complete = on_complete
+        self.on_demote = on_demote
+        self.levels = num_levels(n)
+        self._lock = threading.Lock()
+        self.verified: Dict[int, bytes] = {}     # signer -> good partial
+        self.checked: Dict[bytes, bool] = {}     # exact bytes -> verdict
+        # latest candidate per (level, sender): equivocation costs a
+        # Byzantine sender its own slot, never extra memory
+        self._pending: Dict[Tuple[int, int], Aggregate] = {}
+        self._bad: Dict[int, int] = {}
+        self._rotate: Dict[int, int] = {}
+        # (tick, peer) audit log for the demotion assertions — BOUNDED:
+        # a session for a stuck round (halted chain) keeps ticking until
+        # flush, and an append-only log would grow for the outage's whole
+        # duration in exactly the degraded state that must stay stable
+        self._sends: deque = deque(maxlen=4096)
+        self._ticks = 0
+        self.complete = False
+        self.completed_at: Optional[int] = None
+        self.own_seeded = False     # add_own ran: this is OUR live round
+
+    # -- ingress -------------------------------------------------------------
+
+    def add_own(self, partial: bytes) -> None:
+        """Our own partial enters like any contribution (it is verified in
+        the next window — verdict parity with the flat path, which also
+        batch-checks its own partial at aggregation time)."""
+        with self._lock:
+            self._pending[(0, self.me)] = Aggregate({self.me: partial})
+            self.own_seeded = True
+
+    def receive(self, level: int, sender: int, agg: Aggregate) -> bool:
+        """One candidate from `sender` for our `level`.  Cheap structural
+        checks here; cryptographic verification waits for the window.
+        Returns False when the candidate was rejected outright.
+
+        A sender OUTSIDE the level's mirror block is dropped with no
+        penalty at all: `sender_index` is self-declared on the wire, so
+        a single forged packet could otherwise demote any honest peer of
+        the attacker's choosing (the one violation an attacker can aim
+        at an arbitrary victim).  In-block offences still demote — the
+        spoof there is confined to ids the level would accept anyway."""
+        if not (1 <= level <= self.levels) or not (0 <= sender < self.n) \
+                or sender == self.me:
+            return False
+        block = set(level_block(self.n, self.me, level))
+        if sender not in block:
+            return False
+        with self._lock:
+            if self._bad.get(sender, 0) >= self.cfg.bad_limit:
+                return False        # demoted: stop paying for this peer
+            structurally_ok = (0 < agg.weight <= len(block)
+                               and set(agg.indices()) <= block)
+        if not structurally_ok:
+            self._note_bad(sender)
+            return False
+        with self._lock:
+            self._pending[(level, sender)] = agg
+        return True
+
+    # -- scoring -------------------------------------------------------------
+
+    def _peer_score(self, idx: int) -> float:
+        """READ-ONLY view of the shared breaker/rank state
+        (net/resilience.py score_snapshot): the overlay ranks by the
+        transport evidence the client and sync planes already maintain.
+        Deliberately never WRITTEN from candidate content — sender_index
+        is self-declared, so a content offence attributed into the
+        shared registry would let a spoofed packet open an honest peer's
+        transport breaker (cutting its partial/sync traffic mesh-wide).
+        Content offences stay session-local (`_bad`/demotion); transport
+        failures feed the registry where they are observed — in the
+        CLIENT, per real dial."""
+        if self.scorer is None:
+            return 0.0
+        return self.scorer.score(self.score_key(idx))
+
+    def _note_bad(self, idx: int) -> None:
+        """One more session-local offence; fires the demotion hook on
+        the crossing."""
+        with self._lock:
+            before = self._bad.get(idx, 0)
+            self._bad[idx] = before + 1
+            crossed = before < self.cfg.bad_limit <= before + 1
+        if crossed and self.on_demote is not None:
+            self.on_demote(idx)
+
+    def demoted(self) -> List[int]:
+        with self._lock:
+            return sorted(i for i, c in self._bad.items()
+                          if c >= self.cfg.bad_limit)
+
+    # -- the tick ------------------------------------------------------------
+
+    def tick(self) -> None:
+        self._verify_window()
+        self._maybe_complete()
+        self._send_pass()
+        with self._lock:
+            self._ticks += 1
+
+    def _verify_window(self) -> None:
+        """Scored window: the best pending candidates contribute their
+        unseen partials to ONE batched verify call."""
+        with self._lock:
+            pending = list(self._pending.items())
+            known = dict(self.checked)
+        if not pending:
+            return
+
+        def novelty(item):
+            (_, _), agg = item
+            return sum(1 for i, p in agg.partials.items()
+                       if i not in self.verified and p not in known)
+
+        # most new information first, peer reliability as the tiebreak
+        pending.sort(key=lambda it: (novelty(it),
+                                     self._peer_score(it[0][1])),
+                     reverse=True)
+        window = pending[:self.cfg.window]
+        to_check: List[bytes] = []
+        seen = set()
+        for (_, _), agg in window:
+            for p in agg.partials.values():
+                if p not in known and p not in seen:
+                    seen.add(p)
+                    to_check.append(p)
+        if to_check:
+            # ONE call for the whole window — in the daemon this is the
+            # verify service's LIVE lane (submit_call), so candidates
+            # coalesce into a single RLC dispatch
+            verdicts = self.verifier.verify(self.msg, to_check)
+            with self._lock:
+                for p, ok in zip(to_check, verdicts):
+                    self.checked[p] = bool(ok)
+        offenders = set()
+        with self._lock:
+            for (level, sender), agg in window:
+                # consume the slot only if it still holds the snapshotted
+                # candidate: a FRESHER one that receive() stored while the
+                # (blocking) verify call ran must wait for its own window,
+                # not be silently discarded unverified
+                if self._pending.get((level, sender)) is agg:
+                    self._pending.pop((level, sender), None)
+                any_bad = False
+                for i, p in agg.partials.items():
+                    if self.checked.get(p):
+                        self.verified.setdefault(i, p)
+                    elif self.checked.get(p) is False:
+                        any_bad = True
+                if any_bad and sender != self.me:
+                    offenders.add(sender)
+        for s in offenders:
+            self._note_bad(s)
+
+    def _maybe_complete(self) -> None:
+        fire = False
+        with self._lock:
+            if not self.complete and len(self.verified) >= self.threshold:
+                self.complete = True
+                self.completed_at = self._ticks
+                fire = True
+            snapshot = dict(self.verified)
+        if fire and self.on_complete is not None:
+            self.on_complete(snapshot)
+
+    def _send_pass(self) -> None:
+        """Fast-start Handel: every level is live from tick 0; per level,
+        up to `fanout` targets ranked by score (demoted peers are never
+        polled), rotated each tick so the block is eventually covered."""
+        for level in range(1, self.levels + 1):
+            payload = self._payload(level)
+            if payload.weight == 0:
+                continue
+            targets = self._targets(level)
+            for peer in targets:
+                with self._lock:
+                    self._sends.append((self._ticks, peer))
+                self.send(peer, level, payload)
+
+    def _payload(self, level: int) -> Aggregate:
+        mine = set(own_block(self.n, self.me, level))
+        with self._lock:
+            out = {i: p for i, p in self.verified.items() if i in mine}
+            own = self._pending.get((0, self.me))
+        if own is not None and self.me in mine:
+            # our own partial travels before its first window verdict —
+            # receivers verify it like anything else
+            out.setdefault(self.me, own.partials[self.me])
+        return Aggregate(out)
+
+    def _targets(self, level: int) -> List[int]:
+        with self._lock:
+            bad = {i for i, c in self._bad.items()
+                   if c >= self.cfg.bad_limit}
+            rot = self._rotate.get(level, 0)
+            self._rotate[level] = rot + 1
+        block = [i for i in level_block(self.n, self.me, level)
+                 if i not in bad]
+        if not block:
+            return []
+        # top scorers lead, but the LAST fanout slot rotates through the
+        # remainder — once scores diverge a pure score sort would pin the
+        # same winners forever and never cover the rest of the block
+        # (the reachable-but-never-contacted tail); the exploration slot
+        # guarantees every non-demoted peer is eventually polled
+        ranked = sorted(block, key=self._peer_score, reverse=True)
+        if len(ranked) <= self.cfg.fanout:
+            return ranked
+        head = ranked[:self.cfg.fanout - 1]
+        rest = ranked[self.cfg.fanout - 1:]
+        return head + [rest[rot % len(rest)]]
+
+    # -- introspection ---------------------------------------------------------
+
+    def sends_to(self, peer: int) -> List[int]:
+        """Ticks at which we sent to `peer` (chaos assertions: a demoted
+        peer stops appearing here)."""
+        with self._lock:
+            return [t for t, p in self._sends if p == peer]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"round": self.round, "verified": len(self.verified),
+                    "threshold": self.threshold, "complete": self.complete,
+                    "completed_at_tick": self.completed_at,
+                    "ticks": self._ticks, "pending": len(self._pending),
+                    "demoted": sorted(
+                        i for i, c in self._bad.items()
+                        if c >= self.cfg.bad_limit)}
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------------
+
+def to_packet(round_: int, prev_sig: Optional[bytes], level: int,
+              sender_index: int, agg: Aggregate, n: int, beacon_id: str):
+    from ..net import convert
+    from ..protos import drand_pb2 as pb
+    return pb.HandelAggregatePacket(
+        round=round_, previous_signature=prev_sig or b"", level=level,
+        bitmask=agg.bitmask(n),
+        partial_sigs=list(agg.partials.values()),
+        sender_index=sender_index,
+        metadata=convert.metadata(beacon_id))
+
+
+def from_packet(pkt) -> Tuple[int, Optional[bytes], int, int, Aggregate]:
+    """-> (round, prev_sig, level, sender_index, Aggregate).  The bitmap
+    is advisory (weight preview); the partial bytes are authoritative."""
+    agg = Aggregate.from_partials(list(pkt.partial_sigs))
+    return (pkt.round, pkt.previous_signature or None, pkt.level,
+            pkt.sender_index, agg)
+
+
+class ChainVerifier:
+    """Late-bound view of a ChainStore's partial verifier: a reshare
+    transition swaps `chain.partial_verifier` for the new group's, and
+    the overlay must follow the swap instead of pinning the old one."""
+
+    def __init__(self, chain):
+        self._chain = chain
+
+    def verify(self, msg: bytes, partials):
+        return self._chain.partial_verifier.verify(msg, partials)
+
+
+# ---------------------------------------------------------------------------
+# the daemon coordinator
+# ---------------------------------------------------------------------------
+
+class HandelCoordinator:
+    """Per-chain overlay driver: owns the per-round sessions, the tick
+    thread on the injected clock, and the transport/aggregation glue.
+
+    `transport(node_index, pb_packet)` delivers one wire packet (the
+    daemon binds it to `ProtocolClient.handel_aggregate`; tests to a
+    loopback).  `on_complete(round, prev_sig, partials)` hands the
+    verified set to the aggregation plane."""
+
+    def __init__(self, group_n: int, me: int, threshold: int, scheme,
+                 verifier, transport: Callable[[int, object], None],
+                 on_complete: Callable[[int, Optional[bytes],
+                                        Dict[int, bytes]], None],
+                 clock: Optional[Clock] = None, scorer=None,
+                 score_key: Optional[Callable[[int], str]] = None,
+                 cfg: Optional[HandelConfig] = None, period: float = 30.0,
+                 beacon_id: str = "default",
+                 log: Optional[Logger] = None):
+        self.n = group_n
+        self.me = me
+        self.threshold = threshold
+        self.scheme = scheme
+        self.verifier = verifier
+        self.transport = transport
+        self.on_complete = on_complete
+        self.clock = clock or RealClock()
+        self.scorer = scorer
+        self.score_key = score_key
+        self.cfg = cfg or HandelConfig()
+        self.beacon_id = beacon_id
+        self.log = (log or Logger()).named(f"handel-{beacon_id}")
+        # tick cadence: a handful of hops must fit well inside one round
+        self.tick_s = self.cfg.tick or max(0.05, min(1.0, period / 20.0))
+        self._sessions: Dict[Tuple[int, bytes], HandelSession] = {}
+        self._flushed = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._completed = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"handel-{self.beacon_id}")
+            self._thread.start()
+
+    def stop(self) -> None:
+        # shutdown promptness is governed by the _stop event alone: the
+        # run loop parks in clock.wait_until(..., self._stop)
+        self._stop.set()
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if not self.clock.wait_until(self.clock.now() + self.tick_s,
+                                         self._stop):
+                return
+            try:
+                self.tick()
+            except Exception as e:      # a bad candidate must never stop
+                self.log.warn("handel tick failed", err=str(e))
+
+    # -- session plumbing ----------------------------------------------------
+
+    def _key(self, round_: int, prev_sig: Optional[bytes]):
+        return (round_, prev_sig or b"")
+
+    def _session(self, round_: int, prev_sig: Optional[bytes]
+                 ) -> Optional[HandelSession]:
+        from ..metrics import (handel_active_sessions, handel_demotions,
+                               handel_sessions)
+        key = self._key(round_, prev_sig)
+        with self._lock:
+            if round_ <= self._flushed:
+                return None
+            sess = self._sessions.get(key)
+        if sess is not None:
+            return sess
+        # build outside the coordinator lock (digest + closure wiring);
+        # the insert below re-checks under the lock, losers are discarded
+        msg = self.scheme.digest_beacon(
+            round_, prev_sig if self.scheme.chained else None)
+        fresh = HandelSession(
+            self.cfg, self.n, self.me, self.threshold, round_,
+            prev_sig, msg, self.verifier,
+            send=self._make_sender(round_, prev_sig),
+            scorer=self.scorer, score_key=self.score_key,
+            on_complete=self._make_completer(round_, prev_sig),
+            on_demote=lambda idx: handel_demotions.labels(
+                self.beacon_id).inc())
+        flushed_evictions = 0
+        with self._lock:
+            if round_ <= self._flushed:
+                return None
+            sess = self._sessions.get(key)
+            if sess is None:
+                if len(self._sessions) >= self.cfg.session_cap:
+                    # Bound memory WITHOUT sacrificing live aggregation:
+                    # prefer evicting a session we never seeded with our
+                    # own partial — those only exist because of ingress
+                    # (e.g. a flood of bogus prev_sig variants for the
+                    # current round, which would otherwise churn out the
+                    # REAL session's verified state); among candidates,
+                    # the oldest round goes (likeliest already served by
+                    # catch-up sync).  If every session is own-seeded,
+                    # evict the oldest of those.
+                    unseeded = [k for k, s in self._sessions.items()
+                                if not s.own_seeded]
+                    victim = min(unseeded) if unseeded \
+                        else min(self._sessions)
+                    self._sessions.pop(victim, None)
+                    flushed_evictions += 1
+                sess = self._sessions[key] = fresh
+            n_active = len(self._sessions)
+        handel_active_sessions.labels(self.beacon_id).set(n_active)
+        if flushed_evictions:
+            handel_sessions.labels(self.beacon_id, "flushed").inc(
+                flushed_evictions)
+        return sess
+
+    def _make_sender(self, round_: int, prev_sig: Optional[bytes]):
+        def send(peer: int, level: int, agg: Aggregate):
+            from ..metrics import handel_sends
+            pkt = to_packet(round_, prev_sig, level, self.me, agg,
+                            self.n, self.beacon_id)
+            handel_sends.labels(self.beacon_id).inc()
+            try:
+                self.transport(peer, pkt)
+            except Exception:
+                # transport failures feed the breaker through the shared
+                # registry (the client's policy does it per peer); the
+                # overlay itself just moves on to the next target
+                pass
+        return send
+
+    def _make_completer(self, round_: int, prev_sig: Optional[bytes]):
+        def complete(partials: Dict[int, bytes]):
+            from ..metrics import handel_sessions
+            with self._lock:
+                self._completed += 1
+            handel_sessions.labels(self.beacon_id, "complete").inc()
+            self.on_complete(round_, prev_sig, partials)
+        return complete
+
+    # -- ingress/egress ------------------------------------------------------
+
+    def submit_own(self, round_: int, prev_sig: Optional[bytes],
+                   partial: bytes) -> None:
+        """Our partial for a round: seeds the session and runs an
+        immediate SEND pass so level sends leave this round-trip, not a
+        tick later.  Verification deliberately waits for the next tick's
+        window — our lone partial must not burn a one-lane dispatch on
+        the handler thread when the window will batch it with incoming
+        candidates anyway."""
+        sess = self._session(round_, prev_sig)
+        if sess is None:
+            return
+        sess.add_own(partial)
+        sess._send_pass()
+
+    def receive(self, pkt) -> None:
+        """One wire candidate (daemon ingress).  Raises ValueError on
+        protocol violations (mapped to INVALID_ARGUMENT upstream)."""
+        from ..metrics import handel_candidates
+        round_, prev_sig, level, sender, agg = from_packet(pkt)
+        if not (0 <= sender < self.n):
+            raise ValueError(f"handel sender index {sender} out of range")
+        sess = self._session(round_, prev_sig)
+        if sess is None:
+            return                      # stale round: already aggregated
+        ok = sess.receive(level, sender, agg)
+        handel_candidates.labels(
+            self.beacon_id, "accepted" if ok else "rejected").inc()
+
+    def tick(self) -> None:
+        with self._lock:
+            sessions = list(self._sessions.values())
+        for sess in sessions:
+            sess.tick()
+
+    def flush(self, upto: int) -> None:
+        """Retire sessions for stored rounds (mirror of the partial
+        cache's flush_rounds)."""
+        from ..metrics import handel_active_sessions
+        with self._lock:
+            self._flushed = max(self._flushed, upto)
+            for key in [k for k in self._sessions if k[0] <= upto]:
+                del self._sessions[key]
+            handel_active_sessions.labels(self.beacon_id).set(
+                len(self._sessions))
+
+    # -- introspection -------------------------------------------------------
+
+    def summary(self) -> dict:
+        with self._lock:
+            sessions = {str(k[0]): s.stats()
+                        for k, s in sorted(self._sessions.items())}
+            return {"n": self.n, "levels": num_levels(self.n),
+                    "threshold": self.threshold,
+                    "tick_s": self.tick_s,
+                    "active_sessions": len(sessions),
+                    "completed": self._completed,
+                    "sessions": sessions}

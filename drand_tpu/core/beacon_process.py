@@ -72,6 +72,10 @@ class BeaconProcess:
         self.syncm: Optional[SyncManager] = None
         self.sync_server: Optional[SyncChainServer] = None
         self.store = None
+        # committee-scale aggregation overlay (beacon/handel.py): built by
+        # start_beacon when the group crosses cfg.handel_min_group
+        self.handel = None
+        self._handel_pool = None
         self.dkg_status = DKG_NOT_STARTED
         self.reshare_status = DKG_NOT_STARTED
         self.monitor: Optional[ThresholdMonitor] = None
@@ -213,6 +217,16 @@ class BeaconProcess:
         return [Peer(n.identity.addr, n.identity.tls) for n in g.nodes
                 if n.identity.addr != self.pair.public.addr]
 
+    def _broadcast_dispatch(self, packet: PartialBeaconPacket) -> None:
+        """Handler broadcast hook: the Handel overlay above the committee
+        threshold (our partial seeds the per-round session and travels up
+        the tree), the flat all-to-all fan-out below it."""
+        if self.handel is not None:
+            self.handel.submit_own(packet.round, packet.previous_signature,
+                                   packet.partial_sig)
+            return
+        self._broadcast_partial(packet)
+
     def _broadcast_partial(self, packet: PartialBeaconPacket) -> None:
         """Fan the partial out to every peer, one thread each
         (node.go:445-472); failures feed the threshold monitor.
@@ -269,6 +283,92 @@ class BeaconProcess:
         for peer in peers:
             threading.Thread(target=send, args=(peer,), daemon=True).start()
 
+    def _maybe_start_handel(self) -> None:
+        """Committee-scale selection (caller holds the lock, handler is
+        built): groups at or above cfg.handel_min_group aggregate over
+        the Handel overlay; the verifier is the handler chain's own
+        partial verifier, i.e. candidate windows batch-verify through the
+        verify service's LIVE lane exactly like flat aggregation."""
+        hcfg = self.cfg.handel_config()
+        if len(self.group) < hcfg.min_group or self.handel is not None:
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..beacon.handel import ChainVerifier, HandelCoordinator
+        peers_by_index = {n.index: Peer(n.identity.addr, n.identity.tls)
+                          for n in self.group.nodes}
+        me = self.share.private.index
+        # bounded sender pool (the gossip-relay discipline): a tick's
+        # fanout x levels sends queue here instead of spawning a thread
+        # per send; client timeouts bound each one.  Reused across a
+        # reshare's coordinator rebuild.
+        # single-writer: start_beacon holds self._lock; the reshare-commit
+        # rebuild is serialized by the handler's transition lock — the two
+        # call sites are never concurrent with themselves or each other
+        if self._handel_pool is None:
+            self._handel_pool = ThreadPoolExecutor(  # tpu-vet: disable=lock
+                max_workers=8,
+                thread_name_prefix=f"handel-send-{self.beacon_id}")
+
+        def transport(idx: int, pkt) -> None:
+            peer = peers_by_index.get(idx)
+            if peer is None or idx == me:
+                return
+            self._handel_pool.submit(self._handel_send, peer, pkt)
+
+        def complete(round_, prev_sig, partials):
+            self.handler.chain.aggregate_verified(
+                round_, prev_sig, list(partials.values()))
+
+        # tpu-vet: disable=lock  (single-writer, see pool note above)
+        self.handel = HandelCoordinator(
+            group_n=len(self.group), me=me,
+            threshold=self.group.threshold, scheme=self.group.scheme,
+            verifier=ChainVerifier(self.handler.chain),
+            transport=transport, on_complete=complete,
+            clock=self.clock, scorer=self.resilience.breakers,
+            score_key=lambda idx: (peers_by_index[idx].address
+                                   if idx in peers_by_index else str(idx)),
+            cfg=hcfg, period=self.group.period,
+            beacon_id=self.beacon_id, log=self.log)
+        # retire a round's session the moment its beacon is stored (the
+        # partial cache's flush_rounds discipline)
+        self.handler.chain.cbstore.add_callback(
+            f"handel-flush-{self.beacon_id}",
+            lambda b: self.handel.flush(b.round) if self.handel else None)
+        self.handel.start()
+        self.log.info("handel overlay active", n=len(self.group),
+                      threshold=self.group.threshold,
+                      tick=self.handel.tick_s)
+
+    def _handel_send(self, peer: Peer, pkt) -> None:
+        try:
+            self.client.handel_aggregate(peer, pkt, timeout=5)
+        except Exception as e:
+            # breaker accounting happened inside the client; the overlay
+            # re-targets by score on the next tick
+            self.log.debug("handel send failed", dest=peer.address,
+                           err=str(e))
+
+    def handel_summary(self):
+        """The /health `handel` block (None when the overlay is off)."""
+        return self.handel.summary() if self.handel is not None else None
+
+    def process_handel(self, req) -> None:
+        """RPC ingress for drand.Protocol/HandelAggregate.  The future-
+        round window check mirrors process_partial: without it a flood
+        of far-future rounds would churn the coordinator's session cap
+        and evict the LIVE round's aggregation state."""
+        if self.handel is None:
+            raise ValueError("handel overlay not active")
+        if self.handler is not None:
+            next_round = self.handler.ticker.current_round() + 1
+            if req.round > next_round:
+                raise ValueError(
+                    f"handel aggregate for future round {req.round} "
+                    f"(next {next_round})")
+        self.handel.receive(req)
+
     def start_beacon(self, catchup: bool) -> None:
         """Create store + handler + sync plane and start the round loop
         (drand_beacon.go:240-268, newBeacon :375)."""
@@ -301,10 +401,11 @@ class BeaconProcess:
                 store=self.store,
                 clock=self.clock,
                 verifier_factory=verifier_factory,
-                broadcast=self._broadcast_partial,
+                broadcast=self._broadcast_dispatch,
                 on_sync_needed=self._on_sync_needed,
                 beacon_id=self.beacon_id)
             self.handler = Handler(handler_cfg)
+            self._maybe_start_handel()
             self.sync_server = SyncChainServer(self.handler.chain)
             sync_verifier = verify_svc.handle(
                 self.group.scheme, self.group.public_key.key(),
@@ -580,6 +681,12 @@ class BeaconProcess:
             if self._scan_stop is not None:
                 self._scan_stop.set()
                 self._scan_thread = None
+            if self.handel is not None:
+                self.handel.stop()
+                self.handel = None
+            if self._handel_pool is not None:
+                self._handel_pool.shutdown(wait=False, cancel_futures=True)
+                self._handel_pool = None
             if self.syncm is not None:
                 self.syncm.stop()
             if self.handler is not None:
@@ -1014,6 +1121,15 @@ class BeaconProcess:
                           transition_time=new_group.transition_time)
         self.group = new_group if new_share is not None else self.group
         self.share = new_share if new_share is not None else self.share
+        # committee-scale overlay follows the membership change: the tree
+        # layout, threshold and peer map are all group-shaped, so the old
+        # coordinator retires and (when the new group still qualifies) a
+        # fresh one starts against the swapped verifier/group
+        if new_share is not None and self.handler is not None:
+            old, self.handel = self.handel, None
+            if old is not None:
+                old.stop()
+            self._maybe_start_handel()
 
     def _start_at_transition(self, group: Group, commit: bool = False)\
             -> None:

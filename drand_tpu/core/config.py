@@ -80,6 +80,19 @@ class Config:
     verify_probe_interval: float = 0.0
     _verify_service: Optional[object] = field(default=None, init=False,
                                               repr=False, compare=False)
+    # Committee-scale aggregation (beacon/handel.py, ISSUE 13): groups of
+    # at least handel_min_group members aggregate partials over the
+    # Handel binomial-tree overlay instead of the flat all-to-all fan-out
+    # (0 = module default, env DRAND_HANDEL_MIN_GROUP, itself defaulting
+    # to 129 so every existing small-committee deployment is unchanged).
+    # fanout/window/bad_limit tune per-level peer selection, the scored
+    # verification window, and Byzantine demotion; tick is the overlay
+    # cadence in seconds (0 = derived from the beacon period).
+    handel_min_group: int = 0
+    handel_fanout: int = 0
+    handel_window: int = 0
+    handel_bad_limit: int = 0
+    handel_tick: float = 0.0
     # serving-plane admission control (net/admission.py): one controller
     # per daemon, consulted by the gRPC listener, the REST edge and the
     # SyncChain streams.  0 = module default (env-overridable there via
@@ -160,6 +173,15 @@ class Config:
             if adm is not None and adm.background_paused():
                 self._verify_service.set_background_paused(True)
         return self._verify_service
+
+    def handel_config(self):
+        """The overlay knob bundle (beacon/handel.py HandelConfig); zeros
+        defer to the module's env-overridable defaults."""
+        from ..beacon.handel import HandelConfig
+        return HandelConfig(
+            min_group=self.handel_min_group, fanout=self.handel_fanout,
+            window=self.handel_window, bad_limit=self.handel_bad_limit,
+            tick=self.handel_tick)
 
     def admission(self):
         """The daemon-owned serving-plane admission controller

@@ -228,6 +228,14 @@ class ProtocolService:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         return pb.Empty()
 
+    def handel_aggregate(self, req, context):
+        bp = _route(self.daemon, context, req.metadata)
+        try:
+            bp.process_handel(req)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return pb.Empty()
+
     def sync_chain(self, req, context):
         bp = _route(self.daemon, context, req.metadata)
         stop = threading.Event()

@@ -291,10 +291,22 @@ class DistKeyGenerator:
     def process_deal_bundles(self, bundles: Sequence[DealBundle]
                              ) -> Optional[ResponseBundle]:
         """Verify every dealer's bundle; produce our FastSync response bundle
-        (a status per dealer).  Returns None if we hold no share."""
+        (a status per dealer).  Returns None if we hold no share.
+
+        Committee scale: the two O(n·t) scalar-mul loops — the reshare
+        constant-term pin and the share-vs-commitment check — run as ONE
+        batched device dispatch each once the session crosses
+        `dkg_device.MIN_N` lanes (verdicts bit-identical to the host
+        loops, which remain the fallback)."""
+        staged = []     # (bundle, dealer, pub) past the cheap checks
+        staged_dealers = set()      # in-batch dedup: the FIRST bundle per
+        # dealer wins, exactly as when insertion happened inside the loop
+        # (an equivocating dealer must not get bundle B stored while the
+        # share was decrypted from bundle A)
         for b in bundles:
             dealer = self._dealer(b.dealer_index)
-            if dealer is None or b.dealer_index in self._deal_bundles:
+            if dealer is None or b.dealer_index in self._deal_bundles \
+                    or b.dealer_index in staged_dealers:
                 continue
             if len(b.commits) != self.cfg.threshold:
                 continue
@@ -305,17 +317,23 @@ class DistKeyGenerator:
                                          b"".join(b.commits))
             except (ValueError, AssertionError):
                 continue
-            if self.is_resharing:
-                # dealer's constant-term commitment must equal its public old
-                # share g^{s_d} = oldPubPoly.eval(d) — otherwise it is trying
-                # to change the collective key
-                expect = self.old_pub.eval(b.dealer_index)
-                if self.scheme.key_group.to_bytes(expect) != b.commits[0]:
-                    continue
+            staged.append((b, dealer, pub))
+            staged_dealers.add(b.dealer_index)
+        if self.is_resharing and staged:
+            # dealer's constant-term commitment must equal its public old
+            # share g^{s_d} = oldPubPoly.eval(d) — otherwise it is trying
+            # to change the collective key
+            ok = self._constant_terms_ok(staged)
+            staged = [entry for entry, good in zip(staged, ok) if good]
+        candidates = []     # (bundle, pub, decrypted share)
+        for b, dealer, pub in staged:
             self._deal_bundles[b.dealer_index] = b
             self._valid_dealers.add(b.dealer_index)
             if self.holder_index is not None:
-                self._try_decrypt_own(b, dealer, pub)
+                share = self._decrypt_own(b, dealer)
+                if share is not None:
+                    candidates.append((b, pub, share))
+        self._adopt_matching_shares(candidates)
         if self.holder_index is None:
             return None
         responses = []
@@ -329,18 +347,46 @@ class DistKeyGenerator:
                                     rb.hash(self.cfg.nonce))
         return rb
 
-    def _try_decrypt_own(self, b: DealBundle, dealer: DkgNode, pub: PubPoly):
+    def _constant_terms_ok(self, staged) -> list:
+        """Per-bundle reshare pin verdicts; one device dispatch above the
+        lane threshold, else the host loop."""
+        from . import dkg_device
+        g = self.scheme.key_group
+        if dkg_device.use_device(len(staged)):
+            claimed = [g.from_bytes(b.commits[0]) for b, _, _ in staged]
+            return dkg_device.constant_terms_match(
+                g, list(self.old_pub.commits),
+                [b.dealer_index for b, _, _ in staged], claimed)
+        return [g.to_bytes(self.old_pub.eval(b.dealer_index)) == b.commits[0]
+                for b, _, _ in staged]
+
+    def _decrypt_own(self, b: DealBundle, dealer: DkgNode) -> Optional[int]:
         deal = next((d for d in b.deals if d.share_index == self.holder_index),
                     None)
         if deal is None:
+            return None
+        return _decrypt_share(self.scheme, self.cfg.longterm, dealer.public,
+                              b.dealer_index, self.holder_index,
+                              self.cfg.nonce, deal.encrypted)
+
+    def _adopt_matching_shares(self, candidates) -> None:
+        """Adopt every decrypted share that matches its dealer's
+        commitments — the O(n·t) hot loop of a large DKG, batched to one
+        dispatch for all n dealers' bundles on the device path."""
+        if not candidates:
             return
-        share = _decrypt_share(self.scheme, self.cfg.longterm, dealer.public,
-                               b.dealer_index, self.holder_index,
-                               self.cfg.nonce, deal.encrypted)
-        if share is None:
-            return
-        if self._share_matches(pub, self.holder_index, share):
-            self._my_shares[b.dealer_index] = share
+        from . import dkg_device
+        if dkg_device.use_device(len(candidates)):
+            ok = dkg_device.verify_shares(
+                self.scheme.key_group,
+                [list(pub.commits) for _, pub, _ in candidates],
+                self.holder_index, [s for _, _, s in candidates])
+        else:
+            ok = [self._share_matches(pub, self.holder_index, s)
+                  for _, pub, s in candidates]
+        for (b, _, share), good in zip(candidates, ok):
+            if good:
+                self._my_shares[b.dealer_index] = share
 
     def _share_matches(self, pub: PubPoly, holder_idx: int, share: int) -> bool:
         g = self.scheme.key_group.curve
@@ -426,6 +472,7 @@ class DistKeyGenerator:
             raise DkgError(f"too few qualified dealers: {len(qual)} < {need}")
         g = self.scheme.key_group
         curve = g.curve
+        from . import dkg_device
         if self.is_resharing:
             # Lagrange-combine the dealt polynomials at the OLD indices so
             # the constant term interpolates back to the collective secret;
@@ -433,13 +480,24 @@ class DistKeyGenerator:
             # nodes combine the same dealer subset.
             qual = qual[:need]
             lams = {d: _lagrange_coeff(qual, d) for d in qual}
-            commits = []
-            for j in range(self.cfg.threshold):
-                acc = None
-                for d in qual:
-                    c = g.from_bytes(self._deal_bundles[d].commits[j])
-                    acc = curve.add(acc, curve.mul(c, lams[d]))
-                commits.append(g.to_bytes(acc))
+            if dkg_device.use_device(len(qual)):
+                # batched Lagrange recovery of the public polynomial:
+                # ONE dispatch over |qual| x t lanes instead of the
+                # host's |qual|·t sequential scalar muls
+                matrix = [[g.from_bytes(c)
+                           for c in self._deal_bundles[d].commits]
+                          for d in qual]
+                combined = dkg_device.combine_commits(
+                    g, matrix, [lams[d] for d in qual])
+                commits = [g.to_bytes(c) for c in combined]
+            else:
+                commits = []
+                for j in range(self.cfg.threshold):
+                    acc = None
+                    for d in qual:
+                        c = g.from_bytes(self._deal_bundles[d].commits[j])
+                        acc = curve.add(acc, curve.mul(c, lams[d]))
+                    commits.append(g.to_bytes(acc))
             share = None
             if self.holder_index is not None:
                 missing = [d for d in qual if d not in self._my_shares]
@@ -448,10 +506,17 @@ class DistKeyGenerator:
                 val = sum(lams[d] * self._my_shares[d] for d in qual) % R
                 share = PriShare(self.holder_index, val)
         else:
-            commits_pts = [None] * self.cfg.threshold
-            for d in qual:
-                for j, c in enumerate(self._deal_bundles[d].commits):
-                    commits_pts[j] = curve.add(commits_pts[j], g.from_bytes(c))
+            if dkg_device.use_device(len(qual)):
+                matrix = [[g.from_bytes(c)
+                           for c in self._deal_bundles[d].commits]
+                          for d in qual]
+                commits_pts = dkg_device.combine_commits(g, matrix)
+            else:
+                commits_pts = [None] * self.cfg.threshold
+                for d in qual:
+                    for j, c in enumerate(self._deal_bundles[d].commits):
+                        commits_pts[j] = curve.add(commits_pts[j],
+                                                   g.from_bytes(c))
             commits = [g.to_bytes(c) for c in commits_pts]
             share = None
             if self.holder_index is not None:

@@ -1,0 +1,313 @@
+"""Batched device DKG/reshare math (ISSUE 13, ROADMAP item 3).
+
+The host DKG state machine (`crypto/dkg.py`) is O(n·t) sequential scalar
+multiplications in exactly three places, all of them embarrassingly
+parallel across participants:
+
+  * share verification — every holder checks each dealer's decrypted
+    share against that dealer's polynomial commitments:
+    ``g·s_d == Σ_j x^j C_{d,j}``.  Here that is ONE dispatch for all n
+    dealers: a vmapped Horner ladder in the exponent (per-step multiply
+    by the SMALL evaluation point x = holder_index+1, 16-bit inner
+    ladder — `be16(index)` bounds x — plus one mixed add per
+    coefficient) lane-parallel over dealers, one 256-bit fixed-base
+    ladder for ``g·s_d``, and a projective equality.
+  * the reshare constant-term pin — each dealer's ``C_{d,0}`` must equal
+    ``oldPubPoly.eval(dealer_index)``: evaluation of ONE polynomial at n
+    per-lane points, the same Horner with per-lane x bits.
+  * reshare finalization — the combined commitments
+    ``commits[j] = Σ_d λ_d · C_{d,j}`` are n·t full-width scalar muls:
+    one dispatch over m·t lanes (the λ bits repeat across a dealer's t
+    coefficients) followed by a halving point reduce over dealers.
+
+Parity contract: accept/reject sets are BIT-IDENTICAL to the host path.
+Deserialized commitments are subgroup-checked (host/serialize.py), so the
+unreduced small-x Horner multiplier equals the host's ``x^j mod R``
+powers on every admissible input, including the point at infinity (which
+the complete add formulas absorb).  The host path stays both the
+fallback (no jax / small sessions below `DRAND_DKG_DEVICE_MIN_N`) and
+the cross-check oracle for the parity tests.
+
+Dispatch economy (the acceptance bar): a 1024-participant DKG verifies a
+full bundle set in ONE dispatch, plus one for the reshare constant-term
+pin — a handful of dispatches total where the host loop did n·t scalar
+muls.  `dispatch_count()` is the CPU-testable counter, mirroring
+`crypto/batch.dispatch_count`.
+"""
+
+import os
+import threading
+from functools import lru_cache
+from typing import Dict, List, Optional, Sequence
+
+# knobs (COMPONENTS.md "Committee-scale engine")
+MIN_N = int(os.environ.get("DRAND_DKG_DEVICE_MIN_N", "64"))
+_ENABLED = os.environ.get("DRAND_DKG_DEVICE", "1") != "0"
+
+# the evaluation point rides a be16 share index (crypto/tbls wire format),
+# so 16 ladder bits always cover x = index+1
+X_BITS = 16
+
+_lock = threading.Lock()
+_dispatches = 0
+
+
+def _count_dispatch() -> None:
+    global _dispatches
+    with _lock:
+        _dispatches += 1
+
+
+def dispatch_count() -> int:
+    """Jitted-pipeline invocations so far (test/bench hook)."""
+    with _lock:
+        return _dispatches
+
+
+def available() -> bool:
+    """Device math usable: jax imports and the env switch is on."""
+    if not _ENABLED:
+        return False
+    try:
+        import jax  # noqa: F401
+    except Exception:  # pragma: no cover - jax is baked into the image
+        return False
+    return True
+
+
+def use_device(n_lanes: int, min_n: Optional[int] = None) -> bool:
+    """Routing predicate: batch on device once a session crosses the
+    size threshold (below it, host scalar muls beat a dispatch)."""
+    floor = MIN_N if min_n is None else min_n
+    return floor > 0 and n_lanes >= floor and available()
+
+
+# ---------------------------------------------------------------------------
+# host <-> device plumbing
+# ---------------------------------------------------------------------------
+
+def _is_g2(group) -> bool:
+    return group.point_len == 96
+
+
+def _curve(group):
+    from ..ops import curve as DC
+    return DC.G2_DEV if _is_g2(group) else DC.G1_DEV
+
+
+def _encode(group, pts):
+    from ..ops import curve as DC
+    return (DC.encode_g2_points if _is_g2(group)
+            else DC.encode_g1_points)(pts)
+
+
+def _decode(group, dev_pts):
+    from ..ops import curve as DC
+    return (DC.decode_g2_points if _is_g2(group)
+            else DC.decode_g1_points)(dev_pts)
+
+
+def _bits(ks: Sequence[int], nbits: int):
+    from ..ops import curve as DC
+    return DC.scalars_to_bits(list(ks), nbits)
+
+
+def _tree_map(fn, tree):
+    import jax
+    return jax.tree.map(fn, tree)
+
+
+def _reshape_tm(tree, t: int, m: int):
+    """Leaves (t*m, ...) -> (t, m, ...): coefficient-major lane layout."""
+    return _tree_map(lambda l: l.reshape((t, m) + l.shape[1:]), tree)
+
+
+# ---------------------------------------------------------------------------
+# jitted pipelines (one compiled program per curve x shape, cached by jax)
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=None)
+def _horner_eq_pipeline(g2: bool):
+    """eq(gen·share, Σ_j x^j C_j) lane-parallel: commits (t, m), xbits
+    (X_BITS, m), gen (m,), share_bits (256, m) -> (m,) bool."""
+    import jax
+    from ..ops import curve as DC
+    curve = DC.G2_DEV if g2 else DC.G1_DEV
+
+    def run(commits, xbits, gen_pt, share_bits):
+        t = commits[0].shape[0] if not g2 else commits[0][0].shape[0]
+        acc = _tree_map(lambda l: l[t - 1], commits)
+
+        def body(acc, cj):
+            acc = curve.scalar_mul_bits(acc, xbits)
+            return curve.add(acc, cj), None
+
+        rest = _tree_map(lambda l: l[:t - 1][::-1], commits)
+        acc, _ = jax.lax.scan(body, acc, rest)
+        lhs = curve.scalar_mul_bits(gen_pt, share_bits)
+        return curve.eq_points(lhs, acc)
+
+    return jax.jit(run)
+
+
+@lru_cache(maxsize=None)
+def _eval_all_pipeline(g2: bool):
+    """Σ_j x_i^j C_j for per-lane x_i: commits (t,) single points, xbits
+    (X_BITS, m) -> Jacobian (m,) point tree."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops import curve as DC
+    curve = DC.G2_DEV if g2 else DC.G1_DEV
+
+    def run(commits, xbits):
+        t = commits[0].shape[0] if not g2 else commits[0][0].shape[0]
+        m = xbits.shape[1]
+        bc = lambda l: jnp.broadcast_to(l, (m,) + l.shape)  # noqa: E731
+        acc = _tree_map(lambda l: bc(l[t - 1]), commits)
+
+        def body(acc, cj):
+            acc = curve.scalar_mul_bits(acc, xbits)
+            return curve.add(acc, _tree_map(bc, cj)), None
+
+        rest = _tree_map(lambda l: l[:t - 1][::-1], commits)
+        acc, _ = jax.lax.scan(body, acc, rest)
+        return acc
+
+    return jax.jit(run)
+
+
+@lru_cache(maxsize=None)
+def _combine_pipeline(g2: bool, weighted: bool):
+    """commits[j] = Σ_d [λ_d] C_{d,j}: points (t, m), lam_bits (256, m)
+    (ignored when not weighted) -> (t,) Jacobian point tree.  The reduce
+    over dealers is a halving tree of complete adds on (k, t) batches."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops import curve as DC
+    curve = DC.G2_DEV if g2 else DC.G1_DEV
+
+    def _reduce_dealers(p):
+        # leaves (m, t, ...) -> (t, ...)
+        n = p[0].shape[0] if not g2 else p[0][0].shape[0]
+        while n > 1:
+            half = n // 2
+            a = _tree_map(lambda l: l[:half], p)
+            b = _tree_map(lambda l: l[half:2 * half], p)
+            s = curve.add(a, b)
+            if n % 2:
+                rest = _tree_map(lambda l: l[2 * half:], p)
+                p = jax.tree.map(
+                    lambda x, y: jnp.concatenate([x, y], 0), s, rest)
+            else:
+                p = s
+            n = half + (n % 2)
+        return _tree_map(lambda l: l[0], p)
+
+    def run(points, lam_bits):
+        # points leaves (t, m, ...)
+        if weighted:
+            t = points[0].shape[0] if not g2 else points[0][0].shape[0]
+            m = lam_bits.shape[1]
+            flat = _tree_map(
+                lambda l: l.reshape((t * m,) + l.shape[2:]), points)
+            bits = jnp.tile(lam_bits, (1, t))   # lane layout (t, m) flat
+            mult = curve.scalar_mul_bits(flat, bits)
+            points = _tree_map(
+                lambda l: l.reshape((t, m) + l.shape[1:]), mult)
+        # transpose to (m, t, ...) so the halving reduce runs over dealers
+        swapped = _tree_map(lambda l: l.swapaxes(0, 1), points)
+        return _reduce_dealers(swapped)
+
+    return jax.jit(run)
+
+
+# ---------------------------------------------------------------------------
+# public surface (host types in, host types out)
+# ---------------------------------------------------------------------------
+
+def verify_shares(group, commits_list: List[List[object]],
+                  holder_index: int, shares: Sequence[int]) -> List[bool]:
+    """One dispatch: for each dealer d, does ``gen·shares[d]`` equal the
+    dealer's public polynomial evaluated at this holder?  `commits_list`
+    holds each dealer's commitments as host points (uniform length t);
+    verdicts are bit-identical to `dkg.DistKeyGenerator._share_matches`.
+    """
+    m = len(commits_list)
+    if m == 0:
+        return []
+    t = len(commits_list[0])
+    assert all(len(c) == t for c in commits_list), "ragged commit lists"
+    curve = group.curve
+    # coefficient-major flatten: lane d of step j sees C_{d,j}
+    flat = [commits_list[d][j] for j in range(t) for d in range(m)]
+    commits_dev = _reshape_tm(_encode(group, flat), t, m)
+    xbits = _bits([holder_index + 1] * m, X_BITS)
+    gen_dev = _encode(group, [curve.gen] * m)
+    from .host.params import R
+    share_bits = _bits([s % R for s in shares], 256)
+    _count_dispatch()
+    ok = _horner_eq_pipeline(_is_g2(group))(
+        commits_dev, xbits, gen_dev, share_bits)
+    import numpy as np
+    return [bool(v) for v in np.asarray(ok)]
+
+
+def eval_all(group, commits: List[object],
+             indices: Sequence[int]) -> List[object]:
+    """One dispatch: evaluate one public polynomial at every index in
+    `indices` (x = index+1).  Returns host affine points (None =
+    infinity) — e.g. all n public key shares of a committee, where the
+    host loop was n·t scalar muls (`tbls.PubPoly.eval` per signer)."""
+    if not indices:
+        return []
+    commits_dev = _encode(group, list(commits))
+    xbits = _bits([i + 1 for i in indices], X_BITS)
+    _count_dispatch()
+    out = _eval_all_pipeline(_is_g2(group))(commits_dev, xbits)
+    return _decode(group, out)
+
+
+def constant_terms_match(group, old_commits: List[object],
+                         dealer_indices: Sequence[int],
+                         claimed: Sequence[object]) -> List[bool]:
+    """One dispatch (plus host compares): the reshare pin — dealer d's
+    constant-term commitment must equal ``oldPubPoly.eval(d)``.  `claimed`
+    holds each dealer's C_{d,0} as a host point."""
+    evals = eval_all(group, old_commits, dealer_indices)
+    return [e == c for e, c in zip(evals, claimed)]
+
+
+def combine_commits(group, commits_matrix: List[List[object]],
+                    lams: Optional[Sequence[int]] = None) -> List[object]:
+    """One dispatch: the finalization combine.  With `lams`,
+    ``commits[j] = Σ_d λ_d·C_{d,j}`` (reshare Lagrange recovery of the
+    public polynomial); without, the plain per-coefficient sum (fresh
+    DKG).  Returns t host affine points."""
+    m = len(commits_matrix)
+    if m == 0:
+        return []
+    t = len(commits_matrix[0])
+    assert all(len(c) == t for c in commits_matrix), "ragged commit lists"
+    flat = [commits_matrix[d][j] for j in range(t) for d in range(m)]
+    points = _reshape_tm(_encode(group, flat), t, m)
+    weighted = lams is not None
+    if weighted:
+        from .host.params import R
+        lam_bits = _bits([l % R for l in lams], 256)
+    else:
+        lam_bits = _bits([0] * m, 1)    # placeholder, ignored by the jit
+    _count_dispatch()
+    out = _combine_pipeline(_is_g2(group), weighted)(points, lam_bits)
+    return _decode(group, out)
+
+
+def prime_public_shares(pub_poly, n_nodes: int) -> Dict[int, object]:
+    """Compute every signer's public share in one dispatch and prefill
+    the PubPoly eval memo (`tbls.PubPoly.prime`), so the host partial
+    verifier and `crypto/partials.BatchPartialVerifier` setup stop being
+    n·t host scalar muls at committee scale.  Returns the index→point
+    mapping."""
+    pts = eval_all(pub_poly.group, list(pub_poly.commits), range(n_nodes))
+    mapping = dict(enumerate(pts))
+    pub_poly.prime(mapping)
+    return mapping

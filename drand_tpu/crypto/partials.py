@@ -215,7 +215,14 @@ class BatchPartialVerifier:
         self.scheme = scheme
         self.g2sig = scheme.sig_group is GroupG2
         self.n_nodes = n_nodes
-        # host: evaluate every node's public share once per group
+        # every node's public share, once per group: ONE device dispatch
+        # at committee scale (crypto/dkg_device.eval_all primes the
+        # PubPoly memo so the evals below are lookups), host Horner below
+        # the lane threshold — where n·t scalar muls are cheaper than a
+        # dispatch
+        from . import dkg_device
+        if dkg_device.use_device(n_nodes):
+            dkg_device.prime_public_shares(pub_poly, n_nodes)
         self.pub_points = [pub_poly.eval(i) for i in range(n_nodes)]
         if self.g2sig:
             # pks on G1

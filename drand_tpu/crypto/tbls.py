@@ -9,8 +9,8 @@ recovery in the exponent) live in drand_tpu.crypto.jax.tbls.
 """
 
 import secrets
-from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
 
 from .host.params import R
 from .schemes import Scheme
@@ -56,6 +56,14 @@ class PubPoly:
     """Commitments to a PriPoly on a group; commits[0] is the public key."""
     group: object
     commits: List[object]
+    # Public shares memoized per (instance, index): `verify_partial` used
+    # to recompute pub_poly.eval(idx) — t scalar muls — for the SAME
+    # signer index every round, making host-path partial verification at
+    # large t quadratic across rounds.  The commits list is treated as
+    # immutable after construction (nothing in the codebase mutates it;
+    # reshare transitions build a fresh PubPoly).
+    _eval_cache: Dict[int, object] = field(default_factory=dict, init=False,
+                                           repr=False, compare=False)
 
     @property
     def threshold(self) -> int:
@@ -66,6 +74,9 @@ class PubPoly:
 
     def eval(self, index: int):
         """Public counterpart of share index: sum_j commits[j] * (i+1)^j."""
+        cached = self._eval_cache.get(index)
+        if cached is not None:
+            return cached
         x = index + 1
         g = self.group.curve
         acc = None
@@ -73,7 +84,14 @@ class PubPoly:
         for c in self.commits:
             acc = g.add(acc, g.mul(c, xp))
             xp = xp * x % R
+        self._eval_cache[index] = acc
         return acc
+
+    def prime(self, points: Dict[int, object]) -> None:
+        """Prefill the eval memo (crypto/dkg_device.eval_all computes every
+        public share in one device dispatch; this hands the results to the
+        host path so neither side re-derives them)."""
+        self._eval_cache.update(points)
 
     def to_bytes(self) -> bytes:
         return b"".join(self.group.to_bytes(c) for c in self.commits)

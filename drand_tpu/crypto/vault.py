@@ -19,6 +19,12 @@ class Vault:
         self.scheme = scheme
         self._group = group
         self._share = share
+        # one PubPoly per share: rebuilding it per call deserialized all
+        # t commitments every round AND defeated the per-instance eval
+        # memo (tbls.PubPoly) that un-quadratics committee-scale partial
+        # verification
+        self._pub_cache = None
+        self._pub_for = None
 
     # -- signing (vault.go:60-68) -------------------------------------------
 
@@ -39,9 +45,15 @@ class Vault:
             return self._share
 
     def get_pub(self) -> Optional[tbls.PubPoly]:
-        """The public polynomial for partial verification (vault.go:48-52)."""
+        """The public polynomial for partial verification (vault.go:48-52);
+        cached per share so every consumer sees ONE memoized instance."""
         with self._lock:
-            return None if self._share is None else self._share.pub_poly()
+            if self._share is None:
+                return None
+            if self._pub_for is not self._share:
+                self._pub_cache = self._share.pub_poly()
+                self._pub_for = self._share
+            return self._pub_cache
 
     def public_key_bytes(self) -> Optional[bytes]:
         with self._lock:

@@ -383,6 +383,33 @@ class RestServer:
                     payload["dkg"] = lifecycle()
                 except Exception:
                     pass
+            # peer reliability (the Handel overlay's one source of truth,
+            # net/resilience.py score_snapshot): score + breaker state +
+            # last-transition per peer, bounded so a thousand-signer
+            # committee can't balloon the health body — the worst-scored
+            # peers are the interesting ones, keep those
+            res = getattr(bp, "resilience", None)
+            scores = getattr(res, "peer_scores", None)
+            if callable(scores):
+                try:
+                    snap = scores()
+                    if len(snap) > 64:
+                        keep = sorted(snap, key=lambda k: snap[k]["score"])
+                        snap = {k: snap[k] for k in keep[:64]}
+                    if snap:
+                        payload["peers"] = snap
+                except Exception:
+                    pass
+            # committee-scale aggregation (beacon/handel.py): per-chain
+            # overlay state so an operator sees the tree working
+            handel = getattr(bp, "handel_summary", None)
+            if callable(handel):
+                try:
+                    hs = handel()
+                    if hs is not None:
+                        payload["handel"] = hs
+                except Exception:
+                    pass
         # one-line verify-service summary: the daemon-owned service when
         # one exists, else the process default (never create one here)
         svc = None

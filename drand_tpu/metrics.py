@@ -241,6 +241,29 @@ reshare_transition_pending = Gauge(
     "round (the pending-transition ledger is non-empty)",
     ["beacon_id"], registry=GROUP)
 
+# Committee-scale engine (beacon/handel.py + crypto/dkg_device.py): the
+# Handel overlay's session lifecycle, candidate verdicts, send volume and
+# demotions — the observable difference between a converging tree and a
+# wedged level.
+handel_sessions = Counter(
+    "handel_sessions_total",
+    "Handel per-round sessions by outcome (complete | flushed)",
+    ["beacon_id", "result"], registry=GROUP)
+handel_candidates = Counter(
+    "handel_candidates_total",
+    "Incoming candidate aggregates by admission verdict",
+    ["beacon_id", "verdict"], registry=GROUP)
+handel_sends = Counter(
+    "handel_sends_total", "Candidate aggregates sent to level peers",
+    ["beacon_id"], registry=GROUP)
+handel_demotions = Counter(
+    "handel_demotions_total",
+    "Peers demoted by the overlay (bad candidates past the limit)",
+    ["beacon_id"], registry=GROUP)
+handel_active_sessions = Gauge(
+    "handel_active_sessions", "Live per-round Handel sessions",
+    ["beacon_id"], registry=GROUP)
+
 
 def scrape(which: str = "group") -> bytes:
     reg = {"private": PRIVATE, "http": HTTP, "group": GROUP,

@@ -187,6 +187,23 @@ class ProtocolClient:
             lambda t: self._protocol(peer).partial_beacon(packet, timeout=t),
             timeout=timeout, deadline=deadline)
 
+    def handel_aggregate(self, peer: Peer, packet,
+                         timeout: Optional[float] = None,
+                         deadline: Optional[Deadline] = None) -> None:
+        """One Handel candidate aggregate (beacon/handel.py).  Overlay
+        sends are latency-critical and redundant across a level's targets,
+        so this is a SINGLE attempt under breaker accounting — the next
+        tick re-targets by score anyway, and a backoff chain inside the
+        tick thread would stall every later level's sends."""
+        fn = lambda t: self._protocol(peer).handel_aggregate(  # noqa: E731
+            packet, timeout=t)
+        t = timeout or self.timeout
+        if self.resilience is None:
+            fn(deadline.clamp(t) if deadline is not None else t)
+            return
+        self.resilience.call(fn, key=peer.address, op="handel_aggregate",
+                             timeout=t, deadline=deadline, max_attempts=1)
+
     def sync_chain(self, peer: Peer, from_round: int,
                    beacon_id: str = "") -> "_BeaconStream":
         """Server-stream of BeaconPackets starting at from_round

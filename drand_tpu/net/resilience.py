@@ -50,6 +50,14 @@ DEFAULT_SYNC_BUDGET = float(os.environ.get("DRAND_SYNC_BUDGET", "120"))
 CLOSED, OPEN, HALF_OPEN = 0, 1, 2
 _STATE_NAMES = {CLOSED: "closed", OPEN: "open", HALF_OPEN: "half_open"}
 
+# peer-score bounds (the Handel-style reliability rank, arXiv:1906.05132
+# §5): every recorded success is +1, every failure -2, clamped so one
+# burst can neither whitewash nor permanently bury a peer
+SCORE_MAX = 10.0
+SCORE_MIN = -10.0
+SCORE_SUCCESS = 1.0
+SCORE_FAILURE = -2.0
+
 
 class DeadlineExceeded(Exception):
     """The operation's overall budget is spent."""
@@ -131,6 +139,8 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._probe_in_flight = False
         self._probe_started = 0.0
+        self._score = 0.0
+        self._last_transition = self.clock.now()
         self._lock = threading.Lock()
         self._export_state()
 
@@ -149,6 +159,7 @@ class CircuitBreaker:
         if new == self._state:
             return
         self._state = new
+        self._last_transition = self.clock.now()
         self._export_state()
         from ..metrics import breaker_transitions
         breaker_transitions.labels(self.scope, self.key,
@@ -210,11 +221,13 @@ class CircuitBreaker:
         with self._lock:
             self._consecutive_failures = 0
             self._probe_in_flight = False
+            self._score = min(SCORE_MAX, self._score + SCORE_SUCCESS)
             self._set_state(CLOSED)
 
     def record_failure(self) -> None:
         with self._lock:
             self._probe_in_flight = False
+            self._score = max(SCORE_MIN, self._score + SCORE_FAILURE)
             if self._state == HALF_OPEN:
                 self._opened_at = self.clock.now()
                 self._set_state(OPEN)
@@ -224,6 +237,20 @@ class CircuitBreaker:
                     self._consecutive_failures >= self.failure_threshold:
                 self._opened_at = self.clock.now()
                 self._set_state(OPEN)
+
+    @property
+    def score(self) -> float:
+        with self._lock:
+            return self._score
+
+    def snapshot(self) -> dict:
+        """Read-only view for consumers that must not reach into breaker
+        internals (Handel level scheduling, /health): current score, state
+        name, and the clock time of the last state transition."""
+        with self._lock:
+            return {"score": self._score,
+                    "state": _STATE_NAMES[self._state],
+                    "last_transition": self._last_transition}
 
 
 def peer_key(peer) -> str:
@@ -293,6 +320,21 @@ class BreakerRegistry:
             items = list(self._breakers.items())
         return {k: br.state_name() for k, br in items}
 
+    def score_snapshot(self) -> Dict[str, dict]:
+        """Read-only peer-score view — the ONE source of truth shared by
+        Handel level scheduling and /health (score + state +
+        last-transition per peer key; see CircuitBreaker.snapshot)."""
+        with self._lock:
+            items = list(self._breakers.items())
+        return {k: br.snapshot() for k, br in items}
+
+    def score(self, key: str) -> float:
+        """Current score for one peer key (0.0 when unknown — an unseen
+        peer ranks level with a neutral one, never below it)."""
+        with self._lock:
+            br = self._breakers.get(key)
+        return 0.0 if br is None else br.score
+
 
 class ResiliencePolicy:
     """One bundle of clock + backoff + breakers + retry budget, shared by
@@ -321,6 +363,9 @@ class ResiliencePolicy:
     def rank(self, peers: Sequence[object],
              key: Callable[[object], str] = peer_key) -> List[object]:
         return self.breakers.rank(peers, rng=self.rng, key=key)
+
+    def peer_scores(self) -> Dict[str, dict]:
+        return self.breakers.score_snapshot()
 
     # -- retry executor ------------------------------------------------------
 

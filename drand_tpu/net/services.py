@@ -14,6 +14,11 @@ PROTOCOL = ServiceSpec("drand.Protocol", [
     Method("PushDKGInfo", pb.DKGInfoPacket, pb.Empty),
     Method("BroadcastDKG", pb.DKGPacket, pb.Empty),
     Method("PartialBeacon", pb.PartialBeaconPacket, pb.Empty),
+    # Handel overlay (beacon/handel.py): one candidate aggregate for a
+    # tree level.  Rides the Protocol plane, so net/admission.py's
+    # classify_method already treats it as critical-class — aggregation
+    # traffic is never shed behind public reads.
+    Method("HandelAggregate", pb.HandelAggregatePacket, pb.Empty),
     Method("SyncChain", pb.SyncRequest, pb.BeaconPacket, server_stream=True),
     Method("Status", pb.StatusRequest, pb.StatusResponse),
     # Federation: GroupMetrics snapshot over the node-to-node plane

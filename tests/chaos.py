@@ -1943,3 +1943,218 @@ class DealCrashRestartScenario:
                 detail=f"dead epoch {dead_nonce.hex()[:16]}")
         finally:
             h.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# Handel committee chaos (beacon/handel.py; ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class HandelByzantineResult:
+    """Verdict of one seeded committee run."""
+    n: int
+    n_honest: int
+    threshold: int
+    honest_complete: int              # honest sessions that hit threshold
+    ticks_used: int
+    level_budget: int
+    byz_behaviors: Dict[int, str] = field(default_factory=dict)
+    demotions: Dict[int, List[int]] = field(default_factory=dict)
+    polled_after_demotion: List[tuple] = field(default_factory=list)
+    recovered_valid: bool = False
+    full_weights: List[int] = field(default_factory=list)
+    digest: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return (self.honest_complete == self.n_honest
+                and self.ticks_used <= self.level_budget
+                and not self.polled_after_demotion
+                and self.recovered_valid)
+
+
+class HandelByzantineScenario:
+    """Seeded Byzantine committee on the Handel overlay (FakeClock, zero
+    network I/O, real threshold-BLS crypto).
+
+    Honest members run real `HandelSession`s against a shared loopback;
+    Byzantine members run NO session — each tick the scenario injects
+    their seeded misbehavior directly at honest targets:
+
+      * ``invalid``    — candidates carrying partials with forged sig
+                         bytes (verify fails)
+      * ``equivocate`` — a DIFFERENT forged candidate per tick (latest
+                         wins per sender, so memory stays bounded while
+                         the verify window keeps re-paying until the
+                         demotion limit)
+      * ``outofblock`` — candidates claiming signers outside the level's
+                         mirror block (structural violation)
+      * ``silent``     — sends nothing at all (the tree must route
+                         around the hole)
+
+    Assertions (HandelByzantineResult.ok): every honest session reaches
+    the threshold within the LEVEL BUDGET (levels x level_ticks), no
+    honest node polls a peer after demoting it, and the recovered group
+    signature verifies against the collective key.  Same seed => same
+    digest."""
+
+    BEHAVIORS = ("invalid", "equivocate", "outofblock", "silent")
+
+    def __init__(self, seed: int, n: int = 24, threshold: int = 13,
+                 n_byzantine: int = 6, scheme_id: str =
+                 "pedersen-bls-chained"):
+        from drand_tpu.beacon import handel as H
+        from drand_tpu.crypto import tbls
+        from drand_tpu.crypto.host.params import R
+
+        assert n - n_byzantine >= threshold, "honest quorum must exist"
+        self.H = H
+        self.seed = seed
+        self.n = n
+        self.threshold = threshold
+        self.scheme = scheme_from_name(scheme_id)
+        self.rng = random.Random(stable_seed(seed, "handel"))
+        # deterministic polynomial => deterministic digest across runs
+        self.poly = tbls.PriPoly(
+            [self.rng.randrange(R) for _ in range(threshold)])
+        self.pub = self.poly.commit(self.scheme.key_group)
+        # Byzantine assignment: seeded sample, behaviors round-robin
+        self.byzantine = sorted(self.rng.sample(range(n), n_byzantine))
+        self.behaviors = {b: self.BEHAVIORS[i % len(self.BEHAVIORS)]
+                          for i, b in enumerate(self.byzantine)}
+        self.honest = [i for i in range(n) if i not in self.behaviors]
+        self.cfg = H.HandelConfig(min_group=2, fanout=4, window=32,
+                                  bad_limit=2)
+
+    # -- misbehavior ---------------------------------------------------------
+
+    def _forged(self, byz: int, variant: int) -> bytes:
+        sig_len = 96 if self.scheme.sig_group.point_len == 96 else 48
+        body = bytes(self.rng.randrange(256) for _ in range(sig_len))
+        return byz.to_bytes(2, "big") + body
+
+    def _inject(self, sessions, demote_ticks, tick: int) -> None:
+        """One tick of Byzantine traffic, seeded and order-stable."""
+        H = self.H
+        for byz in self.byzantine:
+            kind = self.behaviors[byz]
+            if kind == "silent":
+                continue
+            # each byz node hits a seeded sample of its mirror partners
+            for level in range(1, H.num_levels(self.n) + 1):
+                targets = [t for t in H.level_block(self.n, byz, level)
+                           if t in sessions]
+                if not targets:
+                    continue
+                tgt = targets[self.rng.randrange(len(targets))]
+                recv_level = level     # symmetric blocks (mirror law)
+                if kind == "invalid":
+                    agg = H.Aggregate({byz: self._forged(byz, 0)})
+                elif kind == "equivocate":
+                    agg = H.Aggregate({byz: self._forged(byz, tick)})
+                else:   # outofblock: claim a signer the level can't hold
+                    outside = (max(H.level_block(self.n, tgt, recv_level))
+                               + 1) % self.n
+                    agg = H.Aggregate({byz: self._forged(byz, 0),
+                                       outside: self._forged(outside, 0)})
+                sessions[tgt].receive(recv_level, byz, agg)
+
+    # -- the run -------------------------------------------------------------
+
+    def run(self) -> HandelByzantineResult:
+        from drand_tpu.beacon.chainstore import HostPartialVerifier
+        from drand_tpu.crypto import tbls
+
+        H = self.H
+        prev = b"\x21" * 32
+        msg = self.scheme.digest_beacon(1, prev)
+        partials = {i: tbls.sign_partial(self.scheme, self.poly.eval(i),
+                                         msg)
+                    for i in self.honest}
+        inbox: List[tuple] = []
+        sessions: Dict[int, object] = {}
+        done: Dict[int, Dict[int, bytes]] = {}
+        demote_ticks: Dict[int, Dict[int, int]] = {i: {}
+                                                   for i in self.honest}
+        tick_now = {"t": 0}
+
+        def sender(me):
+            def send(peer, level, agg):
+                inbox.append((peer, level, me,
+                              H.Aggregate(dict(agg.partials))))
+            return send
+
+        for i in self.honest:
+            sessions[i] = H.HandelSession(
+                self.cfg, self.n, i, self.threshold, 1, prev, msg,
+                HostPartialVerifier(self.scheme, self.pub),
+                send=sender(i),
+                on_complete=(lambda i: lambda parts:
+                             done.__setitem__(i, parts))(i),
+                on_demote=(lambda i: lambda peer:
+                           demote_ticks[i].setdefault(peer,
+                                                      tick_now["t"]))(i))
+            sessions[i].add_own(partials[i])
+
+        budget = self.cfg.level_budget(self.n)
+        ticks_used = budget
+        for tick in range(budget):
+            tick_now["t"] = tick
+            if len(done) == len(self.honest):
+                ticks_used = tick
+                break
+            self._inject(sessions, demote_ticks, tick)
+            msgs, inbox[:] = inbox[:], []
+            for tgt, lvl, snd, agg in msgs:
+                if tgt in sessions:
+                    sessions[tgt].receive(lvl, snd, agg)
+            for s in sessions.values():
+                s.tick()
+        else:
+            if len(done) == len(self.honest):
+                ticks_used = budget
+
+        # demoted peers must stop being polled: any send AT or AFTER the
+        # demotion tick (+1 slack: the demotion may land mid-tick, after
+        # this tick's send pass already fired) is a violation
+        polled_after = []
+        for i in self.honest:
+            for peer, when in demote_ticks[i].items():
+                late = [t for t in sessions[i].sends_to(peer)
+                        if t > when]
+                if late:
+                    polled_after.append((i, peer, late[:3]))
+
+        recovered_valid = False
+        digest = ""
+        if done:
+            first = sorted(done)[0]
+            good = list(done[first].values())
+            try:
+                sig = tbls.recover(self.scheme, self.pub, msg,
+                                   good[: self.threshold], self.threshold,
+                                   self.n, verify_each=False)
+                recovered_valid = self.scheme.verify_beacon(
+                    self.scheme.key_group.to_bytes(
+                        self.pub.public_key()), 1, prev, sig)
+                h = hashlib.sha256()
+                for idx in sorted(done[first]):
+                    h.update(idx.to_bytes(2, "big"))
+                    h.update(done[first][idx])
+                h.update(sig)
+                digest = h.hexdigest()
+            except ValueError:
+                pass
+
+        return HandelByzantineResult(
+            n=self.n, n_honest=len(self.honest), threshold=self.threshold,
+            honest_complete=len(done), ticks_used=ticks_used,
+            level_budget=budget, byz_behaviors=dict(self.behaviors),
+            demotions={i: sorted(demote_ticks[i]) for i in self.honest
+                       if demote_ticks[i]},
+            polled_after_demotion=polled_after,
+            recovered_valid=recovered_valid,
+            full_weights=[len(sessions[i].verified)
+                          for i in sorted(sessions)],
+            digest=digest)

@@ -22,7 +22,7 @@ from drand_tpu.key import DistPublic, Share, new_group, new_keypair
 # outlive the daemon (the leaked transition-<id> thread bug).
 SERVICE_THREAD_PREFIXES = ("verify-scheduler", "verify-packer",
                            "verify-watchdog", "verify-probe",
-                           "transition-")
+                           "transition-", "handel-")
 
 # the REST edge's threads (http_server.py): ONE acceptor + a FIXED worker
 # pool — request traffic must never grow this set (the unbounded

@@ -1,0 +1,275 @@
+"""Device-vs-host DKG math parity (crypto/dkg_device.py; ISSUE 13).
+
+Property-style cases: tampered commitments, wrong-index shares, and
+reshare constant-term mismatches must be rejected IDENTICALLY by the
+batched device pipelines and the host `_share_matches` path.  Shapes
+here stay small (the pipelines are shape-polymorphic scans, so the
+compiled programs are the same ones the n=1024 committee test in
+test_committee.py exercises at scale)."""
+
+import secrets
+
+import pytest
+
+from drand_tpu.crypto import dkg as D
+from drand_tpu.crypto import dkg_device as DD
+from drand_tpu.crypto import tbls
+from drand_tpu.crypto.host.params import R
+from drand_tpu.crypto.schemes import scheme_from_name
+
+
+@pytest.fixture(scope="module")
+def scheme():
+    return scheme_from_name("pedersen-bls-chained")
+
+
+@pytest.fixture()
+def force_device(monkeypatch):
+    monkeypatch.setattr(DD, "MIN_N", 2)
+
+
+def _dealers(g, m, t, rng):
+    polys = [tbls.PriPoly([rng.randrange(R) for _ in range(t)])
+             for _ in range(m)]
+    return polys, [p.commit(g) for p in polys]
+
+
+# ---------------------------------------------------------------------------
+# routing predicate
+# ---------------------------------------------------------------------------
+
+def test_use_device_threshold(monkeypatch):
+    monkeypatch.setattr(DD, "MIN_N", 64)
+    assert not DD.use_device(63)
+    assert DD.use_device(64) == DD.available()
+    monkeypatch.setattr(DD, "MIN_N", 0)
+    assert not DD.use_device(10 ** 6)       # 0 disables outright
+    assert DD.use_device(8, min_n=4) == DD.available()
+
+
+def test_small_sessions_stay_on_host(monkeypatch, scheme):
+    """Below the lane threshold the dkg module must never touch the
+    device module's batch entry points."""
+    monkeypatch.setattr(DD, "MIN_N", 64)
+    monkeypatch.setattr(DD, "verify_shares",
+                        lambda *a, **k: pytest.fail("device path taken"))
+    g = scheme.key_group
+    rng = __import__("random").Random(5)
+    polys, pubs = _dealers(g, 3, 3, rng)
+    gen = D.DistKeyGenerator.__new__(D.DistKeyGenerator)
+    gen.scheme = scheme
+    gen.holder_index = 1
+    gen._my_shares = {}
+    cands = [(type("B", (), {"dealer_index": d})(), pubs[d],
+              polys[d].eval(1).value) for d in range(3)]
+    gen._adopt_matching_shares(cands)
+    assert set(gen._my_shares) == {0, 1, 2}
+
+
+# ---------------------------------------------------------------------------
+# share verification parity
+# ---------------------------------------------------------------------------
+
+def test_verify_shares_parity_under_tampering(scheme):
+    """Wrong-index shares, random-garbage shares, tampered commitments:
+    device and host accept/reject sets are bit-identical."""
+    g = scheme.key_group
+    rng = __import__("random").Random(7)
+    m, t, holder = 8, 4, 3
+    polys, pubs = _dealers(g, m, t, rng)
+    shares = [p.eval(holder).value for p in polys]
+    shares[1] = polys[1].eval(holder + 1).value          # wrong index
+    shares[2] = rng.randrange(R)                         # garbage
+    pubs[4].commits[2] = g.curve.mul(g.curve.gen, rng.randrange(R))
+    pubs[6].commits[0] = g.curve.mul(g.curve.gen, rng.randrange(R))
+    commits_list = [list(p.commits) for p in pubs]
+    host = [g.curve.mul(g.curve.gen, s) == pubs[d].eval(holder)
+            for d, s in enumerate(shares)]
+    before = DD.dispatch_count()
+    dev = DD.verify_shares(g, commits_list, holder, shares)
+    assert DD.dispatch_count() - before == 1
+    assert dev == host
+    assert dev[0] and dev[3]                # honest dealers still accepted
+    assert not (dev[1] or dev[2])
+
+
+def test_verify_shares_zero_and_infinity_edges(scheme):
+    """share = 0 (infinity LHS) and an infinity commitment both follow
+    the host verdict exactly (the complete add formulas absorb them)."""
+    g = scheme.key_group
+    rng = __import__("random").Random(11)
+    m, t, holder = 4, 3, 0
+    polys, pubs = _dealers(g, m, t, rng)
+    shares = [p.eval(holder).value for p in polys]
+    shares[1] = 0                                        # forged zero share
+    pubs[2].commits[1] = None                            # infinity commit
+    host = [g.curve.mul(g.curve.gen, s) == pubs[d].eval(holder)
+            for d, s in enumerate(shares)]
+    dev = DD.verify_shares(g, [list(p.commits) for p in pubs],
+                           holder, shares)
+    assert dev == host
+
+
+def test_eval_all_matches_host_pubpoly(scheme):
+    g = scheme.key_group
+    rng = __import__("random").Random(13)
+    _, pubs = _dealers(g, 1, 5, rng)
+    pub = pubs[0]
+    idxs = list(range(9))
+    dev = DD.eval_all(g, list(pub.commits), idxs)
+    fresh = tbls.PubPoly(g, list(pub.commits))      # memo-free oracle
+    assert dev == [fresh.eval(i) for i in idxs]
+
+
+def test_constant_terms_match_parity(scheme):
+    g = scheme.key_group
+    rng = __import__("random").Random(17)
+    _, (old,) = _dealers(g, 1, 4, rng)
+    m = 6
+    claimed = [old.eval(d) for d in range(m)]
+    claimed[2] = g.curve.mul(g.curve.gen, 424242)        # key-change attempt
+    claimed[5] = None
+    got = DD.constant_terms_match(g, list(old.commits), range(m), claimed)
+    assert got == [True, True, False, True, True, False]
+
+
+def test_combine_commits_parity(scheme):
+    g = scheme.key_group
+    rng = __import__("random").Random(19)
+    m, t = 5, 3
+    _, pubs = _dealers(g, m, t, rng)
+    matrix = [list(p.commits) for p in pubs]
+    lams = [rng.randrange(R) for _ in range(m)]
+    dev = DD.combine_commits(g, matrix, lams)
+    host = []
+    for j in range(t):
+        acc = None
+        for d in range(m):
+            acc = g.curve.add(acc, g.curve.mul(matrix[d][j], lams[d]))
+        host.append(acc)
+    assert dev == host
+    # plain-sum flavor (fresh DKG finalize)
+    dev2 = DD.combine_commits(g, matrix)
+    host2 = []
+    for j in range(t):
+        acc = None
+        for d in range(m):
+            acc = g.curve.add(acc, matrix[d][j])
+        host2.append(acc)
+    assert dev2 == host2
+
+
+# ---------------------------------------------------------------------------
+# the full state machine over the device path
+# ---------------------------------------------------------------------------
+
+def _fresh_session(scheme, n, thr, nonce=b"n" * 32):
+    g = scheme.key_group
+    secs = [secrets.randbelow(1 << 200) for _ in range(n)]
+    nodes = [D.DkgNode(i, g.to_bytes(g.curve.mul(g.curve.gen, s)))
+             for i, s in enumerate(secs)]
+    gens = [D.DistKeyGenerator(D.DkgConfig(
+        scheme=scheme, longterm=secs[i], nonce=nonce,
+        new_nodes=nodes, threshold=thr)) for i in range(n)]
+    return secs, nodes, gens
+
+
+def test_full_dkg_device_path_matches_host(scheme, force_device):
+    """The same deal bundles processed by a device-routed and a
+    host-routed node must produce identical shares and commitments."""
+    n, thr = 5, 3
+    secs, nodes, gens = _fresh_session(scheme, n, thr)
+    deals = [x.generate_deals() for x in gens]
+    # tamper dealer 3's deal to holder 0: encrypted garbage -> decrypt
+    # fails; tamper dealer 4's commitments after signing -> sig reject
+    deals[3].deals[0].encrypted = bytes(64)
+    deals[4].commits[1] = deals[4].commits[0]
+    resps = [x.process_deal_bundles(deals) for x in gens]
+    # holder 0 complains about dealer 3 AND dealer 4 (bad bundle sig)
+    st0 = {r.dealer_index: r.status for r in resps[0].responses}
+    assert st0[3] == D.STATUS_COMPLAINT and st0[4] == D.STATUS_COMPLAINT
+    # a host-routed twin (fresh generator, device off) agrees exactly
+    import drand_tpu.crypto.dkg_device as dd
+    old_min = dd.MIN_N
+    dd.MIN_N = 10 ** 9
+    try:
+        twin = D.DistKeyGenerator(D.DkgConfig(
+            scheme=scheme, longterm=secs[0], nonce=b"n" * 32,
+            new_nodes=nodes, threshold=thr))
+        twin_resp = twin.process_deal_bundles(deals)
+    finally:
+        dd.MIN_N = old_min
+    assert {r.dealer_index: r.status for r in twin_resp.responses} == st0
+    assert twin._my_shares == gens[0]._my_shares
+
+
+def test_duplicate_dealer_bundles_first_wins(scheme):
+    """An equivocating dealer sending TWO validly-signed bundles in one
+    batch must not get bundle B stored while the share was decrypted
+    from bundle A (review finding: the staged restructure briefly lost
+    the in-batch dedup).  The first bundle wins, and the stored bundle
+    and adopted share stay consistent."""
+    n, thr = 4, 3
+    secs, nodes, gens = _fresh_session(scheme, n, thr)
+    deals = [x.generate_deals() for x in gens]
+    evil_twin = D.DistKeyGenerator(D.DkgConfig(
+        scheme=scheme, longterm=secs[0], nonce=b"n" * 32,
+        new_nodes=nodes, threshold=thr))
+    second = evil_twin.generate_deals()     # different polynomial, valid sig
+    g1 = gens[1]
+    g1.process_deal_bundles(deals + [second])
+    stored = g1._deal_bundles[0]
+    assert stored.hash(b"n" * 32) == deals[0].hash(b"n" * 32)
+    pub = tbls.PubPoly.from_bytes(scheme.key_group,
+                                  b"".join(stored.commits))
+    gcurve = scheme.key_group.curve
+    assert gcurve.mul(gcurve.gen, g1._my_shares[0]) == pub.eval(1), \
+        "adopted share inconsistent with the stored bundle's commitments"
+
+
+def test_full_reshare_device_path_preserves_key(scheme, force_device):
+    """Reshare over the device path: constant-term pin enforced, Lagrange
+    combine on device, collective key byte-identical."""
+    n, thr = 5, 3
+    secs, nodes, gens = _fresh_session(scheme, n, thr)
+    deals = [x.generate_deals() for x in gens]
+    resps = [x.process_deal_bundles(deals) for x in gens]
+    outs = [x.process_response_bundles(resps)[0] for x in gens]
+    assert all(o is not None for o in outs)
+    pk = outs[0].public_key()
+
+    rgens = [D.DistKeyGenerator(D.DkgConfig(
+        scheme=scheme, longterm=secs[i], nonce=b"r" * 32,
+        new_nodes=nodes, threshold=thr, old_nodes=nodes, old_threshold=thr,
+        share=outs[i].share, public_coeffs=list(outs[0].commits)))
+        for i in range(n)]
+    rdeals = [x.generate_deals() for x in rgens]
+    # dealer 2 tries to change the collective key: deal a polynomial whose
+    # constant term is NOT its old share — the pin must reject the bundle
+    evil = D.DistKeyGenerator(D.DkgConfig(
+        scheme=scheme, longterm=secs[2], nonce=b"r" * 32,
+        new_nodes=nodes, threshold=thr, old_nodes=nodes, old_threshold=thr,
+        share=tbls.PriShare(2, 123456789), \
+        public_coeffs=list(outs[0].commits)))
+    rdeals[2] = evil.generate_deals()
+    rresps = [x.process_deal_bundles(rdeals) for x in rgens]
+    assert all(2 not in x._valid_dealers for x in rgens), \
+        "constant-term pin missed a key-change attempt"
+    routs = [x.process_response_bundles(rresps)[0] for x in rgens]
+    assert all(o is not None for o in routs)
+    assert {o.public_key() for o in routs} == {pk}, "collective key drifted"
+
+
+def test_prime_public_shares_one_dispatch(scheme):
+    g = scheme.key_group
+    rng = __import__("random").Random(23)
+    _, (pubp,) = _dealers(g, 1, 4, rng)
+    pub = tbls.PubPoly(g, list(pubp.commits))
+    before = DD.dispatch_count()
+    mapping = DD.prime_public_shares(pub, 6)
+    assert DD.dispatch_count() - before == 1
+    assert set(mapping) == set(range(6))
+    # memo primed: evals are lookups that agree with the device values
+    oracle = tbls.PubPoly(g, list(pubp.commits))
+    for i in range(6):
+        assert pub.eval(i) == oracle.eval(i) == mapping[i]

@@ -124,6 +124,12 @@ class _Protocol:
     def broadcast_dkg(self, req, ctx):
         return pb.Empty()
 
+    def handel_aggregate(self, req, ctx):
+        self.partials.append((req.round, tuple(req.partial_sigs),
+                              req.metadata.beaconID))
+        self.event.set()
+        return pb.Empty()
+
 
 class _Public:
     def public_rand(self, req, ctx):

@@ -196,6 +196,39 @@ def overload_main(args) -> int:
     return 0 if result.ok else 1
 
 
+def handel_main(args) -> int:
+    """--handel mode: the committee-scale Handel overlay under seeded
+    Byzantine members (invalid candidates, equivocation, out-of-block
+    claims, silent holes).  Every honest session must reach the
+    threshold within the level budget, demoted peers must stop being
+    polled, and the recovered group signature must verify.  Same seed,
+    same digest."""
+    from chaos import HandelByzantineScenario
+
+    n = max(args.nodes, 16)
+    byz = min(args.byzantine, n // 4) or n // 4
+    thr = (n - byz) // 2 + 1
+    scenario = HandelByzantineScenario(seed=args.seed, n=n, threshold=thr,
+                                       n_byzantine=byz)
+    r = scenario.run()
+    print(f"seed            : {args.seed}")
+    print(f"committee       : n={r.n} threshold={r.threshold} "
+          f"byzantine={len(r.byz_behaviors)}")
+    print(f"behaviors       : {r.byz_behaviors}")
+    print(f"honest complete : {r.honest_complete}/{r.n_honest} "
+          f"in {r.ticks_used} ticks (level budget {r.level_budget})")
+    print(f"demotions       : " + (", ".join(
+        f"node{i}->{peers}" for i, peers in sorted(r.demotions.items()))
+        or "none"))
+    print(f"polled-after-demotion violations: "
+          f"{r.polled_after_demotion or 'none'}")
+    print(f"recovered valid : {r.recovered_valid}")
+    print(f"full weights    : min={min(r.full_weights)} "
+          f"max={max(r.full_weights)} (honest={r.n_honest})")
+    print(f"digest          : {r.digest}")
+    return 0 if r.ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=42)
@@ -222,6 +255,12 @@ def main() -> int:
                          "+ leader crash in setup + crash-restart "
                          "mid-deal) instead of the network chaos "
                          "scenario")
+    ap.add_argument("--handel", action="store_true",
+                    help="run the committee-scale Handel overlay "
+                         "scenario (Byzantine candidates, demotion, "
+                         "level-budget convergence) instead of the "
+                         "network chaos scenario; --nodes/--byzantine "
+                         "scale the committee (min 16)")
     args = ap.parse_args()
 
     if args.storage:
@@ -232,6 +271,8 @@ def main() -> int:
         return overload_main(args)
     if args.reshare:
         return reshare_main(args)
+    if args.handel:
+        return handel_main(args)
 
     from chaos import ChaosScenario
 
