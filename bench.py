@@ -46,14 +46,18 @@ Configs (BASELINE.json north_star):
                        marked degraded for that)
 
 Compiled-program economy: every verifier pads to PAD=8192 (pad_to), so
-the whole bench needs exactly five on-chip programs — G1-RLC@8192 in
-its donating (streamed dispatch_packed, configs 5/6) and non-donating
-(resident re-verify, config 2) flavors, G2-RLC@8192,
-partials-verify@(2048x7), and the fused decompress+recover GLV program
-— plus the fixture signing pipelines.  All configs run inside ONE
-child process so each program compiles (or cache-loads) at most once;
-the parent restarts the child for the remaining configs if it hangs
-or dies.
+each RLC program shape compiles once.  Since ISSUE 14 the message FRONT
+is part of the flavor: configs 5/6 stream the donating G1-RLC with the
+raw-message device-h2f front (message-bytes-in — the steady-state
+serving path), config 2's resident re-verify keeps the host-expanded
+"fields" front (hash once, re-verify many), config 1's chained chunk
+carries the digest front (its genesis slot has a seed-width
+previous_sig), and config 4 adds the non-donating raw fronts — about
+seven RLC programs plus partials-verify@(2048x7), the fused
+decompress+recover GLV program and the fixture signing pipelines.  All
+configs run inside ONE child process so each program compiles (or
+cache-loads) at most once; the parent restarts the child for the
+remaining configs if it hangs or dies.
 
 Fixture chains are generated once and cached under /tmp/drand_tpu_bench
 (generation is setup, not measurement).  DRAND_TPU_BENCH_CONFIGS=1,5
@@ -370,13 +374,22 @@ def bench_streamed_store(stats):
             n += len(rounds)
         return n
 
+    from drand_tpu.crypto import batch as _batch
+
     t0 = time.perf_counter()
     n = replay()                               # cold (incl. compile/cache)
     stats["streamed_cold_s"] = round(time.perf_counter() - t0, 1)
+    pack0 = _batch.pack_seconds()
     t0 = time.perf_counter()
     n = replay()                               # warm steady-state
     dt = time.perf_counter() - t0
     assert n == N_STREAM
+    # host pack seconds over the warm replay (ISSUE 14): the term the
+    # device hash-to-field front removes the per-message hashing from
+    stats["streamed_pack_s"] = round(_batch.pack_seconds() - pack0, 2)
+    stats["streamed_h2f_device"] = bool(
+        ver.h2f_device if ver.h2f_device is not None
+        else _batch.h2f_device_default(PAD))
     return n / dt
 
 
@@ -433,6 +446,8 @@ def bench_coalesced_service(stats):
         # occupancy observability (ISSUE 10): effective in-flight depth
         # and the queue-time vs device-time split over the warm replay
         stats["coalesced_inflight_depth"] = st["inflight_depth_max"]
+        stats["coalesced_pack_s"] = round(
+            st["pack_time_s"] - before["pack_time_s"], 2)
         stats["coalesced_queue_s"] = round(
             st["queue_time_s"] - before["queue_time_s"], 2)
         stats["coalesced_device_s"] = round(
@@ -740,8 +755,16 @@ def _child(indices):
                   flush=True)
 
 
+_LAST_EMIT = {"line": None}
+
+
 def _emit(configs, stats):
-    """Print the full cumulative result line (the driver parses the last)."""
+    """Print the full cumulative result line (the driver parses the last).
+
+    Consecutive DUPLICATE lines are suppressed: the r05 tail printed the
+    identical cumulative record three times (the reader thread emits
+    after the final config, then main() emitted again unconditionally) —
+    the final record now lands exactly once unless something changed."""
     headline, headline_config = 0.0, None
     for name in ("streamed_store", "unchained_resident"):
         if configs.get(name):
@@ -794,7 +817,10 @@ def _emit(configs, stats):
               "committee_scale": COMMITTEE_N,
               **stats},
     }
-    print(json.dumps(out), flush=True)
+    line = json.dumps(out)
+    if line != _LAST_EMIT["line"]:
+        _LAST_EMIT["line"] = line
+        print(line, flush=True)
     return headline
 
 

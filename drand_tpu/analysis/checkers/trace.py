@@ -53,6 +53,19 @@ SYNC_SINKS = {"bool", "int", "float"}
 SYNC_SINK_METHODS = {"item", "tolist"}
 SYNC_BLOCKERS = {"jax.block_until_ready", "jax.device_get"}
 
+# host-hash-in-loop (ISSUE 14): per-lane host hashing inside a loop on a
+# hot-path module is O(n) GIL-bound work per chunk — the exact stage the
+# device hash-to-field front removed from steady-state packing.  Flags
+# direct hashlib constructions AND the known host hash-to-field/digest
+# helpers when called per element in a for/while/comprehension.
+# Sanctioned sites (the parity oracle and the below-threshold host
+# fallback) carry justified `# tpu-vet: disable=trace` suppressions.
+HASH_SCOPES = ("ops/", "crypto/batch.py", "crypto/partials.py",
+               "crypto/verify_service.py")
+HOST_HASH_HELPERS = {"hash_to_field_fp", "hash_to_field_fp2",
+                     "expand_message_xmd", "hash_to_curve_g1",
+                     "hash_to_curve_g2", "digest_beacon"}
+
 
 def _in_scope(rel: str) -> bool:
     return any(rel.startswith(s) or f"/{s}" in f"/{rel}" for s in SCOPES) \
@@ -64,6 +77,11 @@ def _in_sync_scope(rel: str) -> bool:
                for s in SYNC_SCOPES)
 
 
+def _in_hash_scope(rel: str) -> bool:
+    return any(rel.startswith(s) or f"/{s}" in f"/{rel}"
+               for s in HASH_SCOPES)
+
+
 class TraceChecker:
     name = "trace"
     description = ("Python control flow on tracers, .item()/int() inside "
@@ -72,10 +90,64 @@ class TraceChecker:
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
         if _in_sync_scope(module.rel):
             yield from self._check_sync_loops(module)
+        if _in_hash_scope(module.rel):
+            yield from self._check_hash_loops(module)
         if not _in_scope(module.rel):
             return
         for fn, static in self._jitted_functions(module):
             yield from self._check_jitted(module, fn, static)
+
+    # -- host-hash-in-loop (hot-path pack stage pass) ------------------------
+
+    _LOOPY = (ast.For, ast.While, ast.ListComp, ast.SetComp,
+              ast.GeneratorExp, ast.DictComp)
+
+    def _check_hash_loops(self, module: ModuleInfo) -> Iterator[Finding]:
+        jitted = {fn for fn, _ in self._jitted_functions(module)}
+        seen = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node not in jitted:
+                for f in self._hash_loops_in(module, node):
+                    key = (f.line, f.col)
+                    if key not in seen:         # nested loops: flag once
+                        seen.add(key)
+                        yield f
+
+    def _is_host_hash_call(self, module: ModuleInfo,
+                           call: ast.Call) -> Optional[str]:
+        d = module.resolve(dotted(call.func) or "") or ""
+        if d.startswith("hashlib."):
+            return d
+        leaf = d.rsplit(".", 1)[-1]
+        if leaf in HOST_HASH_HELPERS:
+            return leaf + "()"
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in HOST_HASH_HELPERS:
+            return call.func.attr + "()"
+        return None
+
+    def _hash_loops_in(self, module: ModuleInfo,
+                       fn: ast.AST) -> Iterator[Finding]:
+        for loop in self._walk_scope(fn):
+            if not isinstance(loop, self._LOOPY):
+                continue
+            for node in self._walk_scope(loop):
+                if node is loop or not isinstance(node, ast.Call):
+                    continue
+                what = self._is_host_hash_call(module, node)
+                if what:
+                    yield Finding(
+                        checker=self.name, code="trace-host-hash-in-loop",
+                        message=(f"per-lane host hash {what} inside a "
+                                 f"loop in {fn.name}() is O(n) GIL-bound "
+                                 "pack work per chunk; ship raw message "
+                                 "words and hash on device "
+                                 "(ops/h2c.py device hash-to-field), or "
+                                 "suppress at a sanctioned parity-oracle/"
+                                 "fallback site"),
+                        path=module.rel, line=node.lineno,
+                        col=node.col_offset)
 
     # -- sync-in-loop (host orchestration pass) ------------------------------
 

@@ -25,6 +25,8 @@ two to bound recompiles.
 
 import os
 import secrets
+import threading
+import time
 from functools import lru_cache
 
 import jax
@@ -39,6 +41,7 @@ from ..ops import curve as DC
 from ..ops import h2c as DH
 from ..ops import limbs as L
 from ..ops import pairing as DP
+from ..ops import sha256 as SHA
 
 SECURITY_BITS = 128  # RLC randomizer width
 _MIN_BATCH = 8
@@ -58,6 +61,53 @@ INFLIGHT_BUDGET_BYTES = int(float(os.environ.get(
 # them in place — no second copy of the chunk encoding lives across the
 # in-flight window).  "auto"/1 donates; 0 keeps the buffers (debugging).
 _DONATE = os.environ.get("DRAND_VERIFY_DONATE", "auto") != "0"
+
+# -- device hash-to-field (ISSUE 14) ----------------------------------------
+# Message-front modes for the verify pipelines.  The steady-state pack
+# path ships RAW fixed-width message bytes and the whole digest +
+# expand_message_xmd + hash_to_field chain runs inside the same dispatch
+# (ops/h2c.py device stages); "fields" is the legacy host-expanded
+# encoding — kept as the parity oracle and the below-threshold fallback;
+# "digest" ships host-computed 32-byte digests and expands on device
+# (irregular chained chunks — e.g. the genesis-seed slot's non-signature
+# previous_sig — and the partials rows, whose digests the caller already
+# holds).
+FRONT_FIELDS = "fields"
+FRONT_DIGEST = "digest"
+FRONT_RAW_UNCHAINED = "raw_unchained"
+FRONT_RAW_CHAINED = "raw_chained"
+
+
+def h2f_device_min_n() -> int:
+    """Batch width at or above which packing ships raw message bytes and
+    hash-to-field runs on device (DRAND_H2F_DEVICE_MIN_N; below it the
+    host loop is cheaper than the extra traced hash stages)."""
+    return int(os.environ.get("DRAND_H2F_DEVICE_MIN_N", "64"))
+
+
+def h2f_device_default(width: int) -> bool:
+    """Front selection for a `width`-lane program: DRAND_H2F_DEVICE=0
+    forces the host oracle, =1 forces device, auto compares the width
+    against the threshold.  Deterministic per width, so each compiled
+    pad keeps exactly one front flavor."""
+    mode = os.environ.get("DRAND_H2F_DEVICE", "auto")
+    if mode == "0":
+        return False
+    if mode == "1":
+        return True
+    return width >= h2f_device_min_n()
+
+
+# Host pack wall time (pack_chunk), process-wide — the `pack` term of the
+# pack|queue|device latency split, delta-able by bench/tools like
+# dispatch_count().  Locked: a multi-group service runs one packer
+# thread per group, and a float += is not atomic.
+_PACK_SECONDS = {"t": 0.0}
+_PACK_LOCK = threading.Lock()
+
+
+def pack_seconds() -> float:
+    return _PACK_SECONDS["t"]
 
 
 def chunk_footprint_bytes(pad: int, g2sig: bool) -> int:
@@ -391,32 +441,81 @@ def _exact_g1sig_core(sig_jac, hm, pk_aff, neg_g2_aff):
     return sub_ok & ok
 
 
+def _h2f_front(g2sig: bool, front: str, dst: bytes):
+    """Static front resolver: message pytree -> (u0, u1) field elements
+    inside the traced pipeline.  "fields" passes the host-expanded pair
+    through; the device fronts run digest + expand_message_xmd +
+    hash_to_field ON DEVICE (ops/h2c.py) — same dispatch, no extra
+    program stage, `dispatch_count()` unchanged."""
+    if front == FRONT_FIELDS:
+        return lambda msg: msg
+
+    def resolve(msg):
+        if front == FRONT_DIGEST:
+            dw = msg[0]
+        else:
+            dw = DH.beacon_digests_dev(msg)
+        if g2sig:
+            return DH.hash_to_field_fp2_dev(dw, 32, dst)
+        return DH.hash_to_field_fp_dev(dw, 32, dst)
+
+    return resolve
+
+
 @lru_cache(maxsize=None)
-def _rlc_pipeline_g2sig(donate: bool = False):
-    # donate_argnums hands the packed chunk encoding (sig_x, sign, u0, u1)
+def _rlc_pipeline_g2sig(donate: bool = False, front: str = FRONT_FIELDS,
+                        dst: bytes = b""):
+    # donate_argnums hands the packed chunk encoding (sig_x, sign, msg)
     # back to XLA for in-place reuse — with a depth-k in-flight window the
     # alternative is k live copies of every input buffer.  The donating
     # variant is a SEPARATE compiled program; only the streaming
     # dispatch_packed path uses it (resolve_packed re-encodes from the
-    # retained host arrays on the rare RLC-failure path).
-    return jax.jit(_rlc_run_g2sig,
-                   donate_argnums=(0, 1, 2, 3) if donate else ())
+    # retained host arrays on the rare RLC-failure path).  `front`/`dst`
+    # are trace-time constants: each (front, dst) pair is its own
+    # compiled flavor, selected deterministically per pad width.
+    h2f = _h2f_front(True, front, dst)
+
+    def run(sig_x, sign, msg, keys, n, pk_aff, neg_g1_aff):
+        u0, u1 = h2f(msg)
+        return _rlc_run_g2sig(sig_x, sign, u0, u1, keys, n, pk_aff,
+                              neg_g1_aff)
+
+    return jax.jit(run, donate_argnums=(0, 1, 2) if donate else ())
 
 
 @lru_cache(maxsize=None)
-def _rlc_pipeline_g1sig(donate: bool = False):
-    return jax.jit(_rlc_run_g1sig,
-                   donate_argnums=(0, 1, 2, 3) if donate else ())
+def _rlc_pipeline_g1sig(donate: bool = False, front: str = FRONT_FIELDS,
+                        dst: bytes = b""):
+    h2f = _h2f_front(False, front, dst)
+
+    def run(sig_x, sign, msg, keys, n, pk_aff, neg_g2_aff):
+        u0, u1 = h2f(msg)
+        return _rlc_run_g1sig(sig_x, sign, u0, u1, keys, n, pk_aff,
+                              neg_g2_aff)
+
+    return jax.jit(run, donate_argnums=(0, 1, 2) if donate else ())
 
 
 @lru_cache(maxsize=None)
-def _exact_pipeline_g2sig():
-    return jax.jit(_exact_run_g2sig)
+def _exact_pipeline_g2sig(front: str = FRONT_FIELDS, dst: bytes = b""):
+    h2f = _h2f_front(True, front, dst)
+
+    def run(sig_x, sign, msg, pk_aff, neg_g1_aff):
+        u0, u1 = h2f(msg)
+        return _exact_run_g2sig(sig_x, sign, u0, u1, pk_aff, neg_g1_aff)
+
+    return jax.jit(run)
 
 
 @lru_cache(maxsize=None)
-def _exact_pipeline_g1sig():
-    return jax.jit(_exact_run_g1sig)
+def _exact_pipeline_g1sig(front: str = FRONT_FIELDS, dst: bytes = b""):
+    h2f = _h2f_front(False, front, dst)
+
+    def run(sig_x, sign, msg, pk_aff, neg_g2_aff):
+        u0, u1 = h2f(msg)
+        return _exact_run_g1sig(sig_x, sign, u0, u1, pk_aff, neg_g2_aff)
+
+    return jax.jit(run)
 
 
 # ---------------------------------------------------------------------------
@@ -433,9 +532,14 @@ class BatchBeaconVerifier:
     kind = "device"  # metrics label for integrity scans (chain/integrity.py)
 
     def __init__(self, scheme: Scheme, public_key_bytes: bytes,
-                 pad_to: int | None = None, sharding=None, devices=None):
+                 pad_to: int | None = None, sharding=None, devices=None,
+                 h2f_device: bool | None = None):
         self.scheme = scheme
         self.g2sig = scheme.sig_group is GroupG2
+        # h2f_device: None = auto (per pad width vs DRAND_H2F_DEVICE_MIN_N);
+        # True/False pin the front — the verify service pins per handle so
+        # the compiled-program flavor set is fixed at handle creation
+        self.h2f_device = h2f_device
         # pad_to: optional canonical batch width.  Batches pad UP to it so
         # differently-sized chains share one compiled program (the bench
         # pads every config to 8192: compile count is the scarce resource
@@ -467,16 +571,34 @@ class BatchBeaconVerifier:
     # -- host-side packing ---------------------------------------------------
 
     def _messages(self, rounds, prev_sigs):
+        """Host digest_beacon loop — the FIELDS/DIGEST-front oracle and
+        fallback only; the raw fronts ship (prevSig, round) words and
+        digest on device (ops/h2c.beacon_digests_dev)."""
         if self.scheme.chained:
-            return [self.scheme.digest_beacon(r, p) for r, p in zip(rounds, prev_sigs)]
+            # tpu-vet: disable=trace  (oracle/fallback, see docstring)
+            return [self.scheme.digest_beacon(r, p)
+                    for r, p in zip(rounds, prev_sigs)]
+        # tpu-vet: disable=trace  (oracle/fallback, see docstring)
         return [self.scheme.digest_beacon(r, None) for r in rounds]
 
     def _encode(self, sigs, msgs, pad):
-        """Host packing, O(1) Python ops: numpy wire parse (x limbs + sign
-        flags; y recovery happens on device in the pipelines) and batched
-        hash-to-field.  Malformed and padding slots carry the generator
-        encoding — inert (zero RLC coefficient / discarded exact result),
-        with the verdict in the returned bad mask."""
+        """Host packing for the FIELDS front (the parity oracle /
+        below-threshold path), O(1) Python ops: numpy wire parse (x limbs
+        + sign flags; y recovery happens on device in the pipelines) and
+        batched host hash-to-field.  Malformed and padding slots carry
+        the generator encoding — inert (zero RLC coefficient / discarded
+        exact result), with the verdict in the returned bad mask."""
+        sig_x, sign, bad = self._encode_sigs(sigs, pad)
+        pmsgs = _pad_msgs(msgs, pad)
+        if self.g2sig:
+            u0, u1 = DH.hash_msgs_to_field_g2(pmsgs, self.scheme.dst)
+        else:
+            u0, u1 = DH.hash_msgs_to_field_g1(pmsgs, self.scheme.dst)
+        return (sig_x, sign, u0, u1), bad
+
+    def _encode_sigs(self, sigs, pad):
+        """The signature half of packing (shared by every front): numpy
+        wire parse -> (sig_x device tensor(s), sign flags, bad mask)."""
         import jax.numpy as jnp
         n = len(sigs)
         xw, sign, bad = _wire_parse(sigs, self.g2sig)
@@ -494,12 +616,63 @@ class BatchBeaconVerifier:
             sig_x = (jnp.asarray(full_x[:, 0]), jnp.asarray(full_x[:, 1]))
         else:
             sig_x = jnp.asarray(full_x)
-        pmsgs = _pad_msgs(msgs, pad)
-        if self.g2sig:
-            u0, u1 = DH.hash_msgs_to_field_g2(pmsgs, self.scheme.dst)
-        else:
-            u0, u1 = DH.hash_msgs_to_field_g1(pmsgs, self.scheme.dst)
-        return (sig_x, jnp.asarray(full_sign), u0, u1), bad
+        return sig_x, jnp.asarray(full_sign), bad
+
+    @staticmethod
+    def _round_words(rounds, pad) -> np.ndarray:
+        """(pad, 2) uint32 BE words of the 8-byte big-endian rounds."""
+        r = np.zeros(pad, np.uint64)
+        r[:len(rounds)] = np.asarray([int(x) for x in rounds], np.uint64)
+        return np.stack([(r >> 32).astype(np.uint32),
+                         (r & 0xFFFFFFFF).astype(np.uint32)], axis=1)
+
+    def _msg_front(self, rounds, prev_sigs, pad):
+        """Build the device-h2f message pytree: raw fixed-width message
+        words (pure numpy concatenation — the host pack stage does no
+        hashing at all) when the chunk is uniform, else host digests
+        shipped as words (the digest front: irregular chained chunks —
+        a genesis-seed previous_sig is not signature-width).  Returns
+        (front, msg)."""
+        import jax.numpy as jnp
+        rw = jnp.asarray(self._round_words(rounds, pad))
+        if not self.scheme.chained:
+            return FRONT_RAW_UNCHAINED, (rw,)
+        plen = self.scheme.sig_group.point_len
+        lens = {len(p) for p in prev_sigs if p}
+        if lens <= {plen}:
+            prev = np.zeros((pad, plen), np.uint8)
+            has = np.zeros(pad, np.uint32)
+            idx = [i for i, p in enumerate(prev_sigs) if p]
+            if idx:
+                # one bulk join + frombuffer, not a per-lane row assign:
+                # the prev matrix is most of the chained pack term
+                flat = np.frombuffer(
+                    b"".join(bytes(prev_sigs[i]) for i in idx), np.uint8)
+                prev[idx] = flat.reshape(len(idx), plen)
+                has[idx] = 1
+            pw = np.ascontiguousarray(
+                prev.reshape(pad, plen // 4, 4).view(">u4")
+                .reshape(pad, plen // 4).astype(np.uint32))
+            return FRONT_RAW_CHAINED, (jnp.asarray(pw), rw, jnp.asarray(has))
+        msgs = _pad_msgs(self._messages(rounds, prev_sigs), pad)
+        dw = SHA.pack_msgs_to_words(msgs, 32)
+        return FRONT_DIGEST, (jnp.asarray(dw),)
+
+    def _pack_enc(self, rounds, sigs, prev_sigs, pad):
+        """Front-aware packing -> ((sig_x, sign, msg), bad, front).  The
+        front is resolved per PAD WIDTH (h2f_device_default, or the
+        explicit `h2f_device=` ctor pin): each compiled pad keeps one
+        flavor, and below the threshold the host oracle path runs
+        unchanged."""
+        use_dev = self.h2f_device if self.h2f_device is not None \
+            else h2f_device_default(pad)
+        if use_dev:
+            sig_x, sign, bad = self._encode_sigs(sigs, pad)
+            front, msg = self._msg_front(rounds, prev_sigs, pad)
+            return (sig_x, sign, msg), bad, front
+        msgs = self._messages(rounds, prev_sigs)
+        (sig_x, sign, u0, u1), bad = self._encode(sigs, msgs, pad)
+        return (sig_x, sign, (u0, u1)), bad, FRONT_FIELDS
 
     # -- verification ---------------------------------------------------------
 
@@ -586,7 +759,19 @@ class BatchBeaconVerifier:
     def _leaf_len(enc):
         return jax.tree.leaves(enc)[0].shape[0]
 
-    def _rlc_dispatch(self, enc, n, donate: bool = False):
+    @staticmethod
+    def _norm_enc(enc, front=None):
+        """Accept both encoding spellings: the legacy 4-tuple
+        (sig_x, sign, u0, u1) — the FIELDS front, still produced by
+        `_encode` for external callers (bench config 2, the chip
+        profilers, the multichip dryrun) — and the front-aware 3-tuple
+        (sig_x, sign, msg)."""
+        if len(enc) == 4:
+            sig_x, sign, u0, u1 = enc
+            return (sig_x, sign, (u0, u1)), FRONT_FIELDS
+        return enc, (front or FRONT_FIELDS)
+
+    def _rlc_dispatch(self, enc, n, donate: bool = False, front=None):
         """Dispatch one RLC check (no sync): returns the device-side fused
         verdict scalar.  The randomizer bits are sampled on device from a
         fresh 128-bit key; n rides as a 0-d operand so every chunk shares
@@ -594,25 +779,30 @@ class BatchBeaconVerifier:
         (they are dead to the caller afterwards — dispatch_packed's
         streaming path, which retains the host arrays for re-encode)."""
         import jax.numpy as jnp
+        enc, front = self._norm_enc(enc, front)
         enc = self._shard_round_axis(enc)
-        sig_x, sign, u0, u1 = enc
-        pipe = _rlc_pipeline_g2sig(donate) if self.g2sig \
-            else _rlc_pipeline_g1sig(donate)
+        sig_x, sign, msg = enc
+        dst = self.scheme.dst
+        pipe = _rlc_pipeline_g2sig(donate, front, dst) if self.g2sig \
+            else _rlc_pipeline_g1sig(donate, front, dst)
         _count_dispatch()
-        _, all_ok = pipe(sig_x, sign, u0, u1, jnp.asarray(_rlc_keys()),
+        _, all_ok = pipe(sig_x, sign, msg, jnp.asarray(_rlc_keys()),
                          jnp.uint32(n), self.pk_aff, self.fixed_aff)
         return all_ok
 
-    def _rlc_ok(self, enc, n) -> bool:
+    def _rlc_ok(self, enc, n, front=None) -> bool:
         """One RLC check over an encoded range; True iff all n rounds verify."""
-        return bool(self._rlc_dispatch(enc, n))
+        return bool(self._rlc_dispatch(enc, n, front=front))
 
-    def _exact(self, enc, n) -> np.ndarray:
+    def _exact(self, enc, n, front=None) -> np.ndarray:
         """Per-round exact pairing checks over an encoded range."""
-        sig_x, sign, u0, u1 = enc
-        pipe = _exact_pipeline_g2sig() if self.g2sig else _exact_pipeline_g1sig()
+        enc, front = self._norm_enc(enc, front)
+        sig_x, sign, msg = enc
+        dst = self.scheme.dst
+        pipe = _exact_pipeline_g2sig(front, dst) if self.g2sig \
+            else _exact_pipeline_g1sig(front, dst)
         _count_dispatch()
-        return np.asarray(pipe(sig_x, sign, u0, u1,
+        return np.asarray(pipe(sig_x, sign, msg,
                                self.pk_aff, self.fixed_aff))[:n]
 
     # Below this range size a failed RLC goes straight to exact checks;
@@ -621,20 +811,21 @@ class BatchBeaconVerifier:
     # chunk.  Compiled shapes stay bounded: every level is a power of two.
     _BISECT_MIN = 64
 
-    def _verify_range(self, enc, lo, hi, bad, top=False) -> np.ndarray:
+    def _verify_range(self, enc, lo, hi, bad, top=False,
+                      front=None) -> np.ndarray:
         n = hi - lo
         # top level: use the batch encoding at its full pad (which may
         # exceed _pad_len(n) when pad_to is set — sharing one compiled
         # program shape across chains); bisection re-pads sub-ranges
         sub = enc if top else self._slice_enc(enc, lo, hi)
-        if not bad[lo:hi].any() and self._rlc_ok(sub, n):
+        if not bad[lo:hi].any() and self._rlc_ok(sub, n, front=front):
             return np.ones(n, dtype=bool)
         if n <= self._BISECT_MIN:
-            return self._exact(sub, n) & ~bad[lo:hi]
+            return self._exact(sub, n, front=front) & ~bad[lo:hi]
         mid = lo + n // 2
         return np.concatenate([
-            self._verify_range(enc, lo, mid, bad),
-            self._verify_range(enc, mid, hi, bad),
+            self._verify_range(enc, lo, mid, bad, front=front),
+            self._verify_range(enc, mid, hi, bad, front=front),
         ])
 
     def verify_batch(self, rounds, sigs, prev_sigs=None) -> np.ndarray:
@@ -642,17 +833,18 @@ class BatchBeaconVerifier:
 
         Fast path: one RLC check for the whole batch.  On failure, RLC
         bisection narrows to the bad region, then exact per-round checks
-        locate the invalid rounds.  Points and message hashes are encoded
-        exactly once; bisection works on slices of that encoding."""
+        locate the invalid rounds.  Points and raw messages are encoded
+        exactly once; bisection works on slices of that encoding (the
+        device fronts re-hash a sliced sub-range inside its dispatch —
+        hashing is a few percent of a pairing pass)."""
         n = len(rounds)
         if n == 0:
             return np.zeros(0, dtype=bool)
         if prev_sigs is None:
             prev_sigs = [None] * n
-        msgs = self._messages(rounds, prev_sigs)
-        enc, bad = self._encode(sigs, msgs,
-                                max(_pad_len(n), self.pad_to or 0))
-        return self._verify_range(enc, 0, n, bad, top=True)
+        enc, bad, front = self._pack_enc(rounds, sigs, prev_sigs,
+                                         max(_pad_len(n), self.pad_to or 0))
+        return self._verify_range(enc, 0, n, bad, top=True, front=front)
 
     # -- pack / dispatch / resolve: the double-buffer triple -----------------
     # The verify service's pipelined executor drives these three stages for
@@ -660,17 +852,24 @@ class BatchBeaconVerifier:
     # chunk k); verify_stream below rides the same split for store replay.
 
     def pack_chunk(self, rounds, sigs, prev_sigs=None):
-        """Stage 1, host side: numpy wire parse + batched hash-to-field.
-        Returns an opaque packed tuple for dispatch/resolve.  The host-side
-        (sigs, msgs) ride along so the rare RLC-failure path can re-encode
-        after dispatch_packed DONATED the enc buffers to the device."""
+        """Stage 1, host side: numpy wire parse + message packing (raw
+        message words above the h2f threshold — NO host hashing — else
+        the host hash-to-field oracle).  Returns an opaque packed tuple
+        for dispatch/resolve.  The host-side (sigs, rounds, prevs) ride
+        along so the rare RLC-failure path can re-encode after
+        dispatch_packed DONATED the enc buffers to the device.  Wall
+        time accumulates into `pack_seconds()` — the `pack` term of the
+        pack|queue|device split."""
+        t0 = time.perf_counter()
         n = len(rounds)
         if prev_sigs is None:
             prev_sigs = [None] * n
-        msgs = self._messages(rounds, prev_sigs)
-        enc, bad = self._encode(sigs, msgs,
-                                max(_pad_len(n), self.pad_to or 0))
-        return [n, enc, bad, (list(sigs), msgs)]
+        enc, bad, front = self._pack_enc(rounds, sigs, prev_sigs,
+                                         max(_pad_len(n), self.pad_to or 0))
+        with _PACK_LOCK:
+            _PACK_SECONDS["t"] += time.perf_counter() - t0
+        return [n, enc, bad, front, (list(rounds), list(sigs),
+                                     list(prev_sigs))]
 
     def dispatch_packed(self, packed):
         """Stage 2: enqueue one RLC pass on device (no sync).  Returns the
@@ -678,7 +877,7 @@ class BatchBeaconVerifier:
         exact fallback.  Input buffers are donated (DRAND_VERIFY_DONATE):
         a depth-k in-flight window must not hold k live copies of every
         chunk encoding on top of the programs' own working set."""
-        n, enc, bad, repack = packed
+        n, enc, bad, front, repack = packed
         if bad.any():
             return None                   # rare: straight to fallback
         if enc is None:
@@ -686,27 +885,28 @@ class BatchBeaconVerifier:
             # service's failover ladder re-invokes dispatch_packed once):
             # the first attempt consumed the encoding — rebuild it from
             # the retained host arrays, same as the resolve failure path
-            sigs, msgs = repack
-            enc, _ = self._encode(sigs, msgs,
-                                  max(_pad_len(n), self.pad_to or 0))
+            rounds, sigs, prevs = repack
+            enc, _, front = self._pack_enc(
+                rounds, sigs, prevs, max(_pad_len(n), self.pad_to or 0))
+            packed[3] = front
         if _DONATE:
             packed[1] = None              # enc is dead after the dispatch
-            return self._rlc_dispatch(enc, n, donate=True)
-        return self._rlc_dispatch(enc, n)
+            return self._rlc_dispatch(enc, n, donate=True, front=front)
+        return self._rlc_dispatch(enc, n, front=front)
 
     def resolve_packed(self, packed, verdict) -> np.ndarray:
         """Stage 3: block on the verdict scalar; bisect to the culprits on
         failure.  Returns the per-round validity array."""
-        n, enc, bad, repack = packed
+        n, enc, bad, front, repack = packed
         if verdict is not None and bool(verdict):
             return np.ones(n, dtype=bool)
         if enc is None:
             # the fast path donated the encoding; rebuild it for bisection
-            sigs, msgs = repack
-            enc, bad = self._encode(sigs, msgs,
-                                    max(_pad_len(n), self.pad_to or 0))
+            rounds, sigs, prevs = repack
+            enc, bad, front = self._pack_enc(
+                rounds, sigs, prevs, max(_pad_len(n), self.pad_to or 0))
         # slow path: bisection + exact checks locate the bad rounds
-        return self._verify_range(enc, 0, n, bad, top=True)
+        return self._verify_range(enc, 0, n, bad, top=True, front=front)
 
     def pipeline_depth(self, depth=None, chunk_size: int = 8192) -> int:
         """Effective dispatch-pipeline depth: the requested depth (arg >

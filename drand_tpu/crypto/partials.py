@@ -41,12 +41,14 @@ from . import tbls as HT
 from .batch import (_NEG_G1, _NEG_G2, _count_dispatch, _device_rlc_bits,
                     _gen_sub, _rlc_keys, _wire_parse, _GEN_JAC_G1,
                     _GEN_JAC_G2, _GEN_SIGN_G1, _GEN_SIGN_G2, _GEN_X_G1,
-                    _GEN_X_G2)
+                    _GEN_X_G2, FRONT_DIGEST, FRONT_FIELDS, _h2f_front,
+                    h2f_device_default)
 from .schemes import Scheme, GroupG2
 from ..ops import curve as DC
 from ..ops import h2c as DH
 from ..ops import limbs as L
 from ..ops import pairing as DP
+from ..ops import sha256 as SHA
 
 
 def _tile_rounds(tree_pt, k):
@@ -199,13 +201,33 @@ def _exact_partials_run_g1sig(sig_x, sign, u0, u1, pk_slot, neg_g2_aff):
 
 
 @lru_cache(maxsize=None)
-def _rlc_pipeline(g2sig: bool):
-    return jax.jit(_rlc_partials_run_g2sig if g2sig else _rlc_partials_run_g1sig)
+def _rlc_pipeline(g2sig: bool, front: str = FRONT_FIELDS, dst: bytes = b""):
+    # front resolver shared with the beacon pipelines (batch._h2f_front):
+    # "fields" passes the host-expanded (u0, u1) through, "digest" ships
+    # the per-round 32-byte digests as words and runs expand_message_xmd
+    # + hash_to_field ON DEVICE inside the same dispatch (ISSUE 14)
+    core = _rlc_partials_run_g2sig if g2sig else _rlc_partials_run_g1sig
+    h2f = _h2f_front(g2sig, front, dst)
+
+    def run(sig_x, sign, msg, keys, valid, onehot, pk_sel, fixed_aff):
+        u0, u1 = h2f(msg)
+        return core(sig_x, sign, u0, u1, keys, valid, onehot, pk_sel,
+                    fixed_aff)
+
+    return jax.jit(run)
 
 
 @lru_cache(maxsize=None)
-def _exact_pipeline(g2sig: bool):
-    return jax.jit(_exact_partials_run_g2sig if g2sig else _exact_partials_run_g1sig)
+def _exact_pipeline(g2sig: bool, front: str = FRONT_FIELDS,
+                    dst: bytes = b""):
+    core = _exact_partials_run_g2sig if g2sig else _exact_partials_run_g1sig
+    h2f = _h2f_front(g2sig, front, dst)
+
+    def run(sig_x, sign, msg, pk_slot, fixed_aff):
+        u0, u1 = h2f(msg)
+        return core(sig_x, sign, u0, u1, pk_slot, fixed_aff)
+
+    return jax.jit(run)
 
 
 class BatchPartialVerifier:
@@ -280,10 +302,21 @@ class BatchPartialVerifier:
             return (jnp.asarray(xw[:, 0]), jnp.asarray(xw[:, 1]))
         return jnp.asarray(xw)
 
-    def _hash_msgs(self, msgs):
+    def _msg_enc(self, msgs):
+        """(front, msg pytree) for a round-digest list: above the h2f
+        threshold the 32-byte digests ship as raw words and expand on
+        device (the caller computed them once per ROUND, not per slot —
+        the per-message xmd loop is what moves off-host); below it the
+        host hash-to-field oracle runs unchanged."""
+        if h2f_device_default(len(msgs)) \
+                and all(len(m) == 32 for m in msgs):
+            return FRONT_DIGEST, (jnp.asarray(
+                SHA.pack_msgs_to_words(msgs, 32)),)
         if self.g2sig:
-            return DH.hash_msgs_to_field_g2(msgs, self.scheme.dst)
-        return DH.hash_msgs_to_field_g1(msgs, self.scheme.dst)
+            return FRONT_FIELDS, DH.hash_msgs_to_field_g2(msgs,
+                                                          self.scheme.dst)
+        return FRONT_FIELDS, DH.hash_msgs_to_field_g1(msgs,
+                                                      self.scheme.dst)
 
     def _pk_sel(self, signer_list):
         ix = np.asarray(signer_list)
@@ -309,7 +342,7 @@ class BatchPartialVerifier:
             return valid  # nothing parsed — no device work to do
         sig_x = self._sig_x(xw)
         sign_d = jnp.asarray(sign)
-        u0, u1 = self._hash_msgs(msgs)
+        front, msg = self._msg_enc(msgs)
 
         flat_valid = valid.reshape(-1)
         flat_idx = idxs.reshape(-1)
@@ -320,8 +353,8 @@ class BatchPartialVerifier:
         # per-slot randomizers are sampled on device from a fresh 128-bit
         # key (batch._device_rlc_bits); invalid slots get zero coefficients
         _count_dispatch()
-        _, all_ok = _rlc_pipeline(self.g2sig)(
-            sig_x, sign_d, u0, u1, jnp.asarray(_rlc_keys()),
+        _, all_ok = _rlc_pipeline(self.g2sig, front, self.scheme.dst)(
+            sig_x, sign_d, msg, jnp.asarray(_rlc_keys()),
             jnp.asarray(flat_valid.astype(np.uint32)), jnp.asarray(onehot),
             self._pk_sel(signers), self.fixed_aff)
         if bool(all_ok):
@@ -330,6 +363,7 @@ class BatchPartialVerifier:
         # exact fallback: per-slot pairings with per-slot public shares
         pk_slot = self._pk_sel(idxs.reshape(-1))
         _count_dispatch()
-        got = np.asarray(_exact_pipeline(self.g2sig)(
-            sig_x, sign_d, u0, u1, pk_slot, self.fixed_aff))
+        got = np.asarray(_exact_pipeline(self.g2sig, front,
+                                         self.scheme.dst)(
+            sig_x, sign_d, msg, pk_slot, self.fixed_aff))
         return got.reshape(r, k) & valid

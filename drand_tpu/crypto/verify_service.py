@@ -448,6 +448,7 @@ class VerifyService:
         self._dispatches = 0
         self._dispatch_lanes = 0    # sum of real lanes over all dispatches
         self._dispatch_slots = 0    # sum of padded widths over all dispatches
+        self._pack_time = 0.0       # sum of per-chunk host pack wall time
         self._queue_time = 0.0      # sum of per-batch queue waits (oldest rider)
         self._device_time = 0.0     # sum of per-chunk dispatch->verdict time
         self._inflight_max = 0      # deepest in-flight window observed
@@ -514,13 +515,19 @@ class VerifyService:
             pin = pool.n_devices > 1
 
             def factory(g, s=scheme, p=pk, pin=pin):
-                from .batch import BatchBeaconVerifier
+                from .batch import BatchBeaconVerifier, h2f_device_default
                 fpad, _ = self._tuned(s, max(1, g.n_devices))
                 # the group's placement is built once and shared by
-                # every chain on the group (DeviceGroup.sharding caches)
+                # every chain on the group (DeviceGroup.sharding caches);
+                # the hash-to-field front is PINNED per handle (ISSUE
+                # 14): at/above DRAND_H2F_DEVICE_MIN_N the pack path
+                # ships raw message bytes and the digest + xmd + h2f
+                # chain runs inside the verify dispatch — one compiled
+                # flavor per handle, fixed at creation
                 return BatchBeaconVerifier(
                     s, p, pad_to=fpad,
-                    sharding=g.sharding() if pin else None)
+                    sharding=g.sharding() if pin else None,
+                    h2f_device=h2f_device_default(fpad))
         if backend is None:
             if factory is not None:
                 backend = factory(group)
@@ -688,10 +695,11 @@ class VerifyService:
         sharding = pool.pool_sharding()
         if sharding is None:
             return False
-        from .batch import BatchBeaconVerifier
+        from .batch import BatchBeaconVerifier, h2f_device_default
         pool_pad = slot.pad * pool.n_devices
         pb = BatchBeaconVerifier(slot.scheme, slot.pk, pad_to=pool_pad,
-                                 sharding=sharding)
+                                 sharding=sharding,
+                                 h2f_device=h2f_device_default(pool_pad))
         with self._cond:
             if slot.pool_backend is None:
                 slot.pool_backend = pb
@@ -1102,8 +1110,16 @@ class VerifyService:
             depth = backend.pipeline_depth(depth, pad_width)
 
         def pack(lo, hi):
-            return lo, hi, backend.pack_chunk(
+            # the pack term of the pack|queue|device latency split: host
+            # wall time spent building the chunk encoding (numpy wire
+            # parse + message packing; with device h2f there is no host
+            # hashing left in here) — observed per chunk, overlapped
+            # with device compute by construction
+            t0 = self.clock.monotonic()
+            packed = backend.pack_chunk(
                 rounds[lo:hi], sigs[lo:hi], prevs[lo:hi])
+            self._account_pack(batch.lane, self.clock.monotonic() - t0)
+            return lo, hi, packed
 
         def dispatch(item):
             lo, hi, packed = item
@@ -1802,6 +1818,17 @@ class VerifyService:
                 # the latency history the watchdog deadline derives from
                 slot.latencies.append(max(0.0, elapsed))
 
+    def _account_pack(self, lane: str, elapsed: float) -> None:
+        """The pack third of the pack|queue|device latency split: host
+        packing wall time per chunk (packer thread) — the term the
+        device-h2f front shrinks, readable off the same instrumentation
+        as the other two."""
+        from ..metrics import verify_dispatch_latency
+        verify_dispatch_latency.labels(lane, "pack").observe(
+            max(0.0, elapsed))
+        with self._cond:
+            self._pack_time += max(0.0, elapsed)
+
     def _account_queue(self, lane: str, waited: float) -> None:
         """The queue half of the dispatch-latency split: submit-to-gather
         wait of a batch's oldest rider (coalescing window + lane
@@ -1849,12 +1876,17 @@ class VerifyService:
                 # (bench config 6) instead of blending cold+warm runs
                 "dispatch_lanes": self._dispatch_lanes,
                 "dispatch_slots": self._dispatch_slots,
-                # occupancy observability (ISSUE 10): queue vs device time
-                # split and the deepest in-flight dispatch window seen
+                # occupancy observability (ISSUE 10/14): the
+                # pack|queue|device latency split and the deepest
+                # in-flight dispatch window seen
+                "pack_time_s": self._pack_time,
                 "queue_time_s": self._queue_time,
                 "device_time_s": self._device_time,
                 "inflight_depth_max": self._inflight_max,
-                "tuning": {s.label: {"pad": s.pad, "depth": s.depth}
+                "tuning": {s.label: {
+                    "pad": s.pad, "depth": s.depth,
+                    "h2f_device": bool(getattr(s.primary, "h2f_device",
+                                               False))}
                            for s in self._slots.values()},
                 "queue_depth": {ln: self._qdepth_locked(ln)
                                 for ln in LANES},
@@ -1902,7 +1934,8 @@ class VerifyService:
                 f"fill={s['fill_ratio']:.2f} preempt={s['preemptions']} "
                 f"queue={q[LANE_LIVE]}/{q[LANE_BACKGROUND]} "
                 f"inflight<={s['inflight_depth_max']} "
-                f"qt/dt={s['queue_time_s']:.1f}/{s['device_time_s']:.1f}s")
+                f"pt/qt/dt={s['pack_time_s']:.1f}/{s['queue_time_s']:.1f}"
+                f"/{s['device_time_s']:.1f}s")
         if s["n_groups"]:
             line += (f" groups={s['n_groups']}"
                      f"x{max(1, s['n_devices']) // max(1, s['n_groups'])}dev")

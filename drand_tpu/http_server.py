@@ -436,6 +436,7 @@ class RestServer:
             st = svc.stats()
             payload["verify_inflight_depth"] = st["inflight_depth_max"]
             payload["verify_latency_split"] = {
+                "pack_s": round(st["pack_time_s"], 3),
                 "queue_s": round(st["queue_time_s"], 3),
                 "device_s": round(st["device_time_s"], 3)}
             # multi-device scale-out (ISSUE 11): the device-group view —

@@ -138,10 +138,13 @@ verify_fill_ratio = Histogram(
     registry=PRIVATE)
 verify_dispatch_latency = Histogram(
     "verify_service_dispatch_latency_seconds",
-    "Verify-service latency split: phase=queue is submit-to-gather wait "
-    "(coalescing window + lane contention, per batch), phase=device is "
-    "dispatch-to-verdict wall time (per coalesced chunk) — occupancy "
-    "regressions show up as device-time growth, overload as queue growth",
+    "Verify-service latency split: phase=pack is host chunk-packing wall "
+    "time (numpy wire parse + message packing; the term device "
+    "hash-to-field removes the hashing from), phase=queue is "
+    "submit-to-gather wait (coalescing window + lane contention, per "
+    "batch), phase=device is dispatch-to-verdict wall time (per coalesced "
+    "chunk) — occupancy regressions show up as device-time growth, "
+    "overload as queue growth, host-bound packing as pack growth",
     ["lane", "phase"], registry=PRIVATE)
 verify_inflight = Gauge(
     "verify_service_inflight_depth",

@@ -395,6 +395,33 @@ def inv_mod(a):
     return pow_fixed(a, P - 2)
 
 
+# R^3 mod p: the to-Montgomery factor for the HIGH 2^384-scaled half of a
+# 512-bit OS2IP chunk (mont_mul(hi, R3) = hi·R² = mont(hi·2^384)).
+R3_LIMBS = jnp.asarray(int_to_limbs(R2_MONT * R_MONT % P))
+
+
+def be_words_to_mont(w):
+    """(..., 16) uint32 BIG-ENDIAN 32-bit words — one 64-byte RFC 9380
+    OS2IP chunk per lane — -> Montgomery limbs of the value mod p.
+
+    v = hi·2^384 + lo with hi < 2^128, lo < 2^384; both halves stay raw
+    (possibly >= p) and one stacked mont_mul against R²/R³ lands each in
+    canonical Montgomery form: T = a·b < R·p keeps REDC's (T + m·p)/R
+    below 2p, so the single conditional subtract still canonicalizes."""
+    rev = w[..., ::-1]                            # LE word order
+    lo16 = rev & MASK
+    hi16 = rev >> LIMB_BITS
+    limbs32 = jnp.stack([lo16, hi16], axis=-1) \
+        .reshape(w.shape[:-1] + (NLIMB + 8,))
+    lo = limbs32[..., :NLIMB]
+    hi = jnp.concatenate(
+        [limbs32[..., NLIMB:],
+         jnp.zeros(w.shape[:-1] + (NLIMB - 8,), U32)], axis=-1)
+    mlo, mhi = mul_many([(lo, jnp.broadcast_to(R2_LIMBS, lo.shape)),
+                         (hi, jnp.broadcast_to(R3_LIMBS, hi.shape))])
+    return add_mod(mlo, mhi)
+
+
 # Host-side convenience: pack python ints into (batched) Montgomery limbs.
 def encode_mont(xs) -> jnp.ndarray:
     """Host: int or list of ints -> Montgomery limb tensor on device."""

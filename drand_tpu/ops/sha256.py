@@ -1,0 +1,207 @@
+"""Batched SHA-256 compression on device (uint32 lanes, fixed block count).
+
+The last host crypto stage of the verify pack path (ISSUE 14): beacon
+messages are fixed-size (`H(prevSig || round)` chained, `H(round)`
+unchained — PAPER.md), so the SHA-256 block count per lane is STATIC and
+the whole digest + RFC 9380 `expand_message_xmd` chain vectorizes over
+lanes with zero data-dependent control flow — exactly the shape the rest
+of ops/ already exploits for the pow scans.
+
+Layout and cost model:
+
+* A message is a ``(..., k)`` uint32 array of BIG-ENDIAN 32-bit words
+  (the wire order SHA-256 consumes), one row per lane.  Static framing —
+  a whole-block prefix (the xmd Z_pad), a static tail (l_i_b / DST'),
+  and the SHA padding — is folded in at TRACE time: whole static leading
+  blocks collapse to a host-precomputed midstate (``_compress_host``),
+  and the static suffix bytes become broadcast constants.
+* The 64 rounds of one block run as ONE ``lax.scan`` carrying the eight
+  working registers plus a 16-word schedule ring — the per-step body is
+  ~15 uint32 vector ops, tiny next to a mont_mul, and like every scan in
+  ops/ it costs per STEP, not per lane: hashing 8192 messages costs the
+  same sequential depth as hashing one.
+* uint32 adds wrap naturally; rotations are shift-pairs.  No per-lane
+  Python anywhere — the host's only job is numpy word packing.
+"""
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+U32 = jnp.uint32
+
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5,
+    0x3956c25b, 0x59f111f1, 0x923f82a4, 0xab1c5ed5,
+    0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174,
+    0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7,
+    0xc6e00bf3, 0xd5a79147, 0x06ca6351, 0x14292967,
+    0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3,
+    0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5,
+    0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f, 0x682e6ff3,
+    0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+], dtype=np.uint32)
+
+_H0 = np.array([
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+], dtype=np.uint32)
+
+_M32 = 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Host mirror: pure-Python compression for STATIC data (midstates of
+# whole-block static prefixes; also the oracle for the unit tests).
+# ---------------------------------------------------------------------------
+
+def _rotr_i(x: int, r: int) -> int:
+    return ((x >> r) | (x << (32 - r))) & _M32
+
+
+def _compress_host(state, block: bytes):
+    """One SHA-256 compression over 64 static bytes (host ints)."""
+    w = [int.from_bytes(block[4 * i:4 * i + 4], "big") for i in range(16)]
+    for t in range(16, 64):
+        s0 = _rotr_i(w[t - 15], 7) ^ _rotr_i(w[t - 15], 18) ^ (w[t - 15] >> 3)
+        s1 = _rotr_i(w[t - 2], 17) ^ _rotr_i(w[t - 2], 19) ^ (w[t - 2] >> 10)
+        w.append((w[t - 16] + s0 + w[t - 7] + s1) & _M32)
+    a, b, c, d, e, f, g, h = state
+    for t in range(64):
+        s1 = _rotr_i(e, 6) ^ _rotr_i(e, 11) ^ _rotr_i(e, 25)
+        ch = (e & f) ^ (~e & g & _M32)
+        t1 = (h + s1 + ch + int(_K[t]) + w[t]) & _M32
+        s0 = _rotr_i(a, 2) ^ _rotr_i(a, 13) ^ _rotr_i(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = (s0 + maj) & _M32
+        a, b, c, d, e, f, g, h = (
+            (t1 + t2) & _M32, a, b, c, (d + t1) & _M32, e, f, g)
+    return tuple((x + y) & _M32 for x, y in zip(state, (a, b, c, d, e, f, g, h)))
+
+
+@lru_cache(maxsize=None)
+def _midstate(prefix: bytes) -> np.ndarray:
+    """State after compressing a static whole-block prefix from the IV."""
+    assert len(prefix) % 64 == 0
+    state = tuple(int(x) for x in _H0)
+    for off in range(0, len(prefix), 64):
+        state = _compress_host(state, prefix[off:off + 64])
+    return np.array(state, dtype=np.uint32)
+
+
+# ---------------------------------------------------------------------------
+# Device compression
+# ---------------------------------------------------------------------------
+
+def _rotr(x, r: int):
+    return (x >> r) | (x << (32 - r))
+
+
+def compress(state, block):
+    """One compression: state (..., 8), block (..., 16), both uint32.
+
+    A single 64-step scan; the schedule ring `w` carries W[t..t+15], so
+    message expansion and the round function share the step."""
+    regs = tuple(state[..., i] for i in range(8))
+
+    def step(carry, k):
+        a, b, c, d, e, f, g, h, w = carry
+        wt = w[..., 0]
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + k + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        w1 = w[..., 1]
+        w14 = w[..., 14]
+        sg0 = _rotr(w1, 7) ^ _rotr(w1, 18) ^ (w1 >> 3)
+        sg1 = _rotr(w14, 17) ^ _rotr(w14, 19) ^ (w14 >> 10)
+        nw = wt + sg0 + w[..., 9] + sg1          # W[t+16]
+        w = jnp.concatenate([w[..., 1:], nw[..., None]], axis=-1)
+        return (t1 + t2, a, b, c, d + t1, e, f, g, w), None
+
+    carry, _ = jax.lax.scan(step, regs + (block,), jnp.asarray(_K))
+    out = jnp.stack(carry[:8], axis=-1)
+    return state + out
+
+
+def _suffix_bytes(total_len: int, tail: bytes) -> bytes:
+    """`tail` + the SHA-256 padding for a `total_len`-byte message (the
+    tail being its final len(tail) bytes) — everything after the dynamic
+    region, as static bytes."""
+    pad = (56 - (total_len + 1)) % 64
+    return tail + b"\x80" + b"\x00" * pad + (8 * total_len).to_bytes(8, "big")
+
+
+def sha256_words(dyn_words, dyn_len: int | None = None, tail: bytes = b"",
+                 prefix: bytes = b""):
+    """SHA-256 of ``prefix || dyn || tail`` per lane -> (..., 8) digest words.
+
+    ``dyn_words``: (..., k) uint32 BE words, ``dyn_len`` bytes of dynamic
+    per-lane data (default 4k; a partial final word carries its bytes in
+    the HIGH positions, low bytes zero).  ``prefix`` is static and a
+    whole-block multiple (folded to a host midstate — the xmd Z_pad costs
+    zero device blocks); ``tail`` is static of any length (merged into
+    the partial word and broadcast).  Block count is static."""
+    dyn_words = jnp.asarray(dyn_words)
+    k = int(dyn_words.shape[-1])
+    if dyn_len is None:
+        dyn_len = 4 * k
+    assert 4 * (k - 1) < dyn_len <= 4 * k if k else dyn_len == 0
+    total_len = len(prefix) + dyn_len + len(tail)
+    suffix = _suffix_bytes(total_len, tail)
+    rem = dyn_len - 4 * (k - 1) if k else 0      # bytes in the last word
+    if k and rem < 4:
+        # merge the first (4-rem) static bytes into the partial word's
+        # low byte positions, keeping byte-exact big-endian semantics
+        fill = int.from_bytes(suffix[:4 - rem], "big")
+        dyn_words = dyn_words.at[..., -1].set(
+            dyn_words[..., -1] | jnp.uint32(fill))
+        suffix = suffix[4 - rem:]
+    assert len(suffix) % 4 == 0
+    sw = np.frombuffer(suffix, dtype=">u4").astype(np.uint32)
+    shape = dyn_words.shape[:-1]
+    stream = jnp.concatenate(
+        [dyn_words, jnp.broadcast_to(jnp.asarray(sw), shape + (len(sw),))],
+        axis=-1)
+    nwords = int(stream.shape[-1])
+    assert nwords % 16 == 0
+    state = jnp.broadcast_to(jnp.asarray(_midstate(prefix)), shape + (8,))
+    for blk in range(nwords // 16):
+        state = compress(state, stream[..., 16 * blk:16 * blk + 16])
+    return state
+
+
+# ---------------------------------------------------------------------------
+# Host word packing (numpy; the pack path's only remaining message work)
+# ---------------------------------------------------------------------------
+
+def pack_msgs_to_words(msgs, msg_len: int | None = None) -> np.ndarray:
+    """Equal-length byte strings -> (n, ceil(len/4)) uint32 BE word array
+    (partial final word zero-padded low).  Pure numpy."""
+    if msg_len is None:
+        msg_len = len(msgs[0]) if msgs else 0
+    k = (msg_len + 3) // 4
+    buf = np.zeros((len(msgs), 4 * k), np.uint8)
+    if msg_len:
+        flat = np.frombuffer(b"".join(bytes(m) for m in msgs), np.uint8)
+        buf[:, :msg_len] = flat.reshape(len(msgs), msg_len)
+    return np.ascontiguousarray(buf.reshape(len(msgs), k, 4).view(">u4")
+                                .reshape(len(msgs), k).astype(np.uint32))
+
+
+def digest_bytes(digest_words) -> list:
+    """(n, 8) device/numpy digest words -> list of 32-byte digests (tests)."""
+    arr = np.asarray(digest_words, dtype=np.uint32)
+    be = arr.astype(">u4").tobytes()
+    return [be[32 * i:32 * (i + 1)] for i in range(arr.shape[0])]

@@ -1,0 +1,56 @@
+"""Seeded violations for the trace checker's host-hash-in-loop rule
+(ISSUE 14): per-lane host hashing inside a loop on a hot-path module is
+O(n) GIL-bound pack work per chunk — the stage the device hash-to-field
+front removed.  Every BAD line must be caught; negatives stay silent."""
+
+import hashlib
+from hashlib import sha256
+
+import numpy as np
+
+
+def digest_loop(msgs):
+    out = []
+    for m in msgs:
+        out.append(hashlib.sha256(m).digest())      # BAD: hashlib in loop
+    return out
+
+
+def aliased_digest_while(msgs):
+    out = []
+    while msgs:
+        out.append(sha256(msgs.pop()).digest())     # BAD: aliased hashlib
+    return out
+
+
+def helper_loop(msgs, dst):
+    from drand_tpu.crypto.host.h2c import hash_to_field_fp
+    return [hash_to_field_fp(m, dst, 2) for m in msgs]  # BAD: h2f helper
+
+
+def scheme_digest_comprehension(scheme, rounds):
+    return [scheme.digest_beacon(r, None) for r in rounds]  # BAD: per lane
+
+
+def hash_once_outside_loop(msgs):
+    """Negative: one digest over the joined batch is not per-lane work."""
+    joined = hashlib.sha256(b"".join(msgs)).digest()
+    out = []
+    for m in msgs:
+        out.append(len(m))                          # host metadata: fine
+    return joined, out
+
+
+def numpy_pack_loop(msgs):
+    """Negative: numpy word packing per message is the sanctioned pack
+    stage — no hashing involved."""
+    return [np.frombuffer(m, np.uint8) for m in msgs]
+
+
+def justified_oracle(msgs, scheme):
+    """A justified per-lane digest (the parity oracle) suppresses."""
+    out = []
+    for r in msgs:
+        # tpu-vet: disable=trace  (parity oracle fixture)
+        out.append(scheme.digest_beacon(r, None))
+    return out

@@ -244,12 +244,12 @@ def test_dispatch_packed_retry_after_donation():
     orig = ver._rlc_dispatch
     calls = {"n": 0}
 
-    def flaky(enc, n, donate=False):
+    def flaky(enc, n, donate=False, front=None):
         calls["n"] += 1
         assert enc is not None, "retry saw a consumed encoding"
         if calls["n"] == 1:
             raise ConnectionError("transient dispatch fault")
-        return orig(enc, n, donate=donate)
+        return orig(enc, n, donate=donate, front=front)
 
     ver._rlc_dispatch = flaky
     with pytest.raises(ConnectionError):
@@ -320,3 +320,116 @@ def test_verify_service_device_end_to_end():
         assert st["dispatches"] == 2 and st["submitted"] == 2
     finally:
         svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Device hash-to-field fronts (ISSUE 14): LoE-vector-pinned end-to-end
+# parity on both groups, corruption included, and the one-dispatch
+# property of the message-bytes-in entry points.
+# ---------------------------------------------------------------------------
+
+def test_device_h2f_mainnet_vector_g2_chained():
+    """The chained LoE mainnet beacon through the RAW message front
+    (prevSig/round words in, digest + xmd + h2f on device): verdicts
+    bit-identical to the host-hashed oracle, corrupt copy rejected."""
+    sch_id, round_, pub, sig, prev = MAINNET_BEACONS[0]
+    ver = batch.BatchBeaconVerifier(scheme_from_name(sch_id),
+                                    bytes.fromhex(pub), h2f_device=True)
+    sig_b, prev_b = bytes.fromhex(sig), bytes.fromhex(prev)
+    bad_sig = bytearray(sig_b)
+    bad_sig[6] ^= 1
+    packed = ver.pack_chunk([round_, round_ + 1, round_],
+                            [sig_b, sig_b, bytes(bad_sig)],
+                            [prev_b, prev_b, prev_b])
+    assert packed[3] == batch.FRONT_RAW_CHAINED
+    got = ver.verify_batch([round_, round_ + 1, round_],
+                           [sig_b, sig_b, bytes(bad_sig)],
+                           [prev_b, prev_b, prev_b])
+    assert got.tolist() == [True, False, False]
+
+
+def test_device_h2f_mainnet_vector_g1_unchained():
+    sch_id, round_, pub, sig, _ = MAINNET_BEACONS[3]
+    ver = batch.BatchBeaconVerifier(scheme_from_name(sch_id),
+                                    bytes.fromhex(pub), h2f_device=True)
+    got = ver.verify_batch([round_, round_ + 1], [bytes.fromhex(sig)] * 2)
+    assert got.tolist() == [True, False]
+
+
+def test_device_h2f_front_parity_with_host_oracle():
+    """Freshly-signed G1 chain through BOTH fronts: identical verdicts,
+    including a valid-point-wrong-round lane and a garbage lane."""
+    sch, sec, _ = _keyed_verifier("bls-unchained-on-g1")
+    beacons = _signed_chain(sch, sec, 8)
+    sigs = [b.signature for b in beacons]
+    sigs[3] = sigs[2]                      # valid point, wrong round
+    sigs[6] = b"\x00" * 48                 # malformed wire bytes
+    rounds = [b.round for b in beacons]
+    pub = sch.public_bytes(sch.keypair(seed=b"batch-test")[1])
+    dev = batch.BatchBeaconVerifier(sch, pub, h2f_device=True)
+    host = batch.BatchBeaconVerifier(sch, pub, h2f_device=False)
+    got_d = dev.verify_batch(rounds, sigs)
+    got_h = host.verify_batch(rounds, sigs)
+    assert (got_d == got_h).all()
+    assert got_d.tolist() == [True, True, True, False,
+                              True, True, False, True]
+
+
+def test_device_h2f_stream_entry_is_one_dispatch():
+    """One-dispatch acceptance for the message-bytes-in entry: a packed
+    chunk through the raw front is exactly ONE dispatch (the fused front
+    adds no stage), and the pack stage does zero host hashing while the
+    pack-seconds accumulator advances."""
+    from drand_tpu.ops import h2c as DHH
+
+    sch, sec, _ = _keyed_verifier("bls-unchained-on-g1")
+    beacons = _signed_chain(sch, sec, 6)
+    rounds = [b.round for b in beacons]
+    sigs = [b.signature for b in beacons]
+    pub = sch.public_bytes(sch.keypair(seed=b"batch-test")[1])
+    ver = batch.BatchBeaconVerifier(sch, pub, h2f_device=True)
+    # warm the donating raw program so the counted pass measures steady
+    # state (a cold pass takes the same count; this keeps timing honest)
+    packed = ver.pack_chunk(rounds, sigs)
+    assert ver.resolve_packed(packed, ver.dispatch_packed(packed)).all()
+    hashed = DHH.host_h2f_count()
+    before = batch.dispatch_count()
+    packed = ver.pack_chunk(rounds, sigs)
+    verdict = ver.dispatch_packed(packed)
+    ok = ver.resolve_packed(packed, verdict)
+    assert ok.all()
+    assert batch.dispatch_count() - before == 1
+    assert DHH.host_h2f_count() == hashed
+
+
+def test_device_h2f_partials_digest_front_parity():
+    """BatchPartialVerifier with the digest front (threshold forced to
+    the test scale): identical accept/reject to the host-h2f oracle,
+    including a corrupted slot."""
+    import os as _os
+
+    from drand_tpu.crypto.partials import BatchPartialVerifier
+
+    sch = scheme_from_name("bls-unchained-on-g1")
+    t, n_nodes, nr = 3, 5, 4
+    poly = tbls.PriPoly.random(t, secret=0xFEED)
+    shares = poly.shares(n_nodes)
+    pub_poly = poly.commit(sch.key_group)
+    msgs = [sch.digest_beacon(r, None) for r in range(1, nr + 1)]
+    rows = [[i.to_bytes(2, "big") + sch.sign(shares[i].value, m)
+             for i in (0, 1, 3)] for m in msgs]
+    rows[2][1] = rows[1][1]                # valid partial, wrong round
+    bpv = BatchPartialVerifier(sch, pub_poly, n_nodes)
+    old = _os.environ.get("DRAND_H2F_DEVICE_MIN_N")
+    try:
+        _os.environ["DRAND_H2F_DEVICE_MIN_N"] = str(10 ** 9)
+        want = bpv.verify_partials(msgs, rows)          # host front
+        _os.environ["DRAND_H2F_DEVICE_MIN_N"] = "2"
+        got = bpv.verify_partials(msgs, rows)           # digest front
+    finally:
+        if old is None:
+            _os.environ.pop("DRAND_H2F_DEVICE_MIN_N", None)
+        else:
+            _os.environ["DRAND_H2F_DEVICE_MIN_N"] = old
+    assert (got == want).all()
+    assert not got[2][1] and got.sum() == 3 * nr - 1

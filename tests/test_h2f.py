@@ -1,0 +1,232 @@
+"""Device hash-to-field (ISSUE 14): RFC 9380 expand_message_xmd KATs,
+bit-exact hashlib parity for the device SHA-256 / hash-to-field stages
+over all beacon message shapes (chained 104-byte with and without a
+previous signature, unchained 8-byte, both DSTs), front selection and
+the no-host-hash counter pin.
+
+These are the CPU-fast tier-1 tests: they compile only the small hash /
+field-conversion programs (no pairing).  The end-to-end verify parity
+(device front vs host oracle, corrupt signatures included) lives in the
+heavy bucket beside the other RLC tests (tests/test_batch.py) and the
+hash-to-curve golden tests (tests/test_ops_curve_pairing.py)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from drand_tpu.crypto import batch, schemes
+from drand_tpu.crypto.host import h2c as HH
+from drand_tpu.crypto.host.params import DST_G1, DST_G2
+from drand_tpu.ops import h2c as DH
+from drand_tpu.ops import limbs as L
+from drand_tpu.ops import sha256 as SHA
+
+# RFC 9380 Appendix K.1: expand_message_xmd(SHA-256), DST
+# "QUUX-V01-CS02-with-expander-SHA256-128" — the suite's published
+# vectors, pinned as hex.
+_XMD_DST = b"QUUX-V01-CS02-with-expander-SHA256-128"
+_XMD_KATS_32 = {
+    b"": "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235",
+    b"abc":
+        "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615",
+    b"abcdef0123456789":
+        "eff31487c770a893cfb36f912fbfcbff40d5661771ca4b2cb4eafe524333f5c1",
+}
+_XMD_KATS_128 = {
+    b"": "af84c27ccfd45d41914fdff5df25293e221afc53d8ad2ac06d5e3e29485dadbe"
+         "e0d121587713a3e0dd4d5e69e93eb7cd4f5df4cd103e188cf60cb02edc3edf18"
+         "eda8576c412b18ffb658e3dd6ec849469b979d444cf7b26911a08e63cf31f9dc"
+         "c541708d3491184472c2c29bb749d4286b004ceb5ee6b9a7fa5b646c993f0ced",
+    b"abc":
+         "abba86a6129e366fc877aab32fc4ffc70120d8996c88aee2fe4b32d6c7b6437a"
+         "647e6c3163d40b76a73cf6a5674ef1d890f95b664ee0afa5359a5c4e07985635"
+         "bbecbac65d747d3d2da7ec2b8221b17b0ca9dc8a1ac1c07ea6a1e60583e2cb00"
+         "058e77b7b72a298425cd1b941ad4ec65e8afc50303a22c0f99b0509b4c895f40",
+}
+
+
+def _dev_expand(msg: bytes, dst: bytes, n: int) -> bytes:
+    w = jnp.asarray(SHA.pack_msgs_to_words([msg, msg], len(msg)))
+    out = np.asarray(DH.expand_msg_xmd_dev(w, len(msg), dst,
+                                           (n + 3) // 4 * 4), np.uint32)
+    rows = [out[i].astype(">u4").tobytes()[:n] for i in range(2)]
+    assert rows[0] == rows[1]           # lanes are independent
+    return rows[0]
+
+
+def test_expand_message_xmd_kats_host_and_device():
+    for msg, want in _XMD_KATS_32.items():
+        assert HH.expand_message_xmd(msg, _XMD_DST, 0x20).hex() == want
+        assert _dev_expand(msg, _XMD_DST, 0x20).hex() == want
+    for msg, want in _XMD_KATS_128.items():
+        assert HH.expand_message_xmd(msg, _XMD_DST, 0x80).hex() == want
+        assert _dev_expand(msg, _XMD_DST, 0x80).hex() == want
+
+
+def test_expand_device_matches_host_long_and_odd_messages():
+    """Beyond the pinned vectors: device == host for long and non-word-
+    aligned messages (the partial-word merge path)."""
+    for msg in (b"q128_" + b"q" * 123, b"a512_" + b"a" * 507,
+                b"x" * 17, b"y" * 31):
+        for n in (0x20, 0x80):
+            assert _dev_expand(msg, _XMD_DST, n) == \
+                HH.expand_message_xmd(msg, _XMD_DST, n)
+
+
+def test_device_sha256_matches_hashlib_all_beacon_shapes():
+    """Bit-exact SHA-256 parity for every message shape the pack path
+    ships: unchained 8-byte, chained 56/104-byte (G1/G2 prev widths),
+    the 32-byte digest, and odd lengths through the merge path."""
+    for size in (0, 3, 8, 17, 31, 32, 56, 64, 104, 200):
+        msgs = [bytes([i]) * size if size else b"" for i in range(3)]
+        w = jnp.asarray(SHA.pack_msgs_to_words(msgs, size))
+        got = SHA.digest_bytes(SHA.sha256_words(w, size))
+        assert got == [hashlib.sha256(m).digest() for m in msgs], size
+
+
+def test_hash_to_field_device_parity_both_dsts():
+    msgs = [hashlib.sha256(bytes([i])).digest() for i in range(5)]
+    dw = jnp.asarray(SHA.pack_msgs_to_words(msgs, 32))
+    for dst in (DST_G1, DST_G2):
+        u0, u1 = DH.hash_to_field_fp_dev(dw, 32, dst)
+        g0, g1 = L.decode_mont(u0), L.decode_mont(u1)
+        for i, m in enumerate(msgs):
+            assert (g0[i], g1[i]) == tuple(HH.hash_to_field_fp(m, dst, 2))
+        (a0, a1), (b0, b1) = DH.hash_to_field_fp2_dev(dw, 32, dst)
+        da0, da1, db0, db1 = map(L.decode_mont, (a0, a1, b0, b1))
+        for i, m in enumerate(msgs):
+            (w00, w01), (w10, w11) = HH.hash_to_field_fp2(m, dst, 2)
+            assert (da0[i], da1[i], db0[i], db1[i]) == (w00, w01, w10, w11)
+
+
+def test_beacon_digest_device_parity():
+    """Device digest == Scheme.digest_beacon for chained (including the
+    genesis slot with NO previous signature) and unchained messages."""
+    sch = schemes.scheme_from_name(schemes.DEFAULT_SCHEME_ID)
+    schu = schemes.scheme_from_name(schemes.UNCHAINED_SCHEME_ID)
+    prevs = [b"\x11" * 96, None, b"\x22" * 96, b""]
+    rounds = [1, 2, 2 ** 40 + 7, 4]
+    rw = jnp.asarray(SHA.pack_msgs_to_words(
+        [r.to_bytes(8, "big") for r in rounds]))
+    pw = jnp.asarray(SHA.pack_msgs_to_words(
+        [p if p else b"\x00" * 96 for p in prevs]))
+    hp = jnp.asarray(np.array([1, 0, 1, 0], np.uint32))
+    got = SHA.digest_bytes(DH.beacon_digests_dev((pw, rw, hp)))
+    assert got == [sch.digest_beacon(r, p) for r, p in zip(rounds, prevs)]
+    got_u = SHA.digest_bytes(DH.beacon_digests_dev((rw,)))
+    assert got_u == [schu.digest_beacon(r, None) for r in rounds]
+
+
+# -- front selection + the counter pin ---------------------------------------
+
+
+def _verifier(scheme_id, h2f_device=None, seed=b"h2f-front"):
+    sch = schemes.scheme_from_name(scheme_id)
+    _, pub = sch.keypair(seed=seed)
+    return sch, batch.BatchBeaconVerifier(sch, sch.public_bytes(pub),
+                                          h2f_device=h2f_device)
+
+
+def test_h2f_device_default_threshold(monkeypatch):
+    monkeypatch.setenv("DRAND_H2F_DEVICE_MIN_N", "64")
+    monkeypatch.delenv("DRAND_H2F_DEVICE", raising=False)
+    assert not batch.h2f_device_default(8)
+    assert not batch.h2f_device_default(63)
+    assert batch.h2f_device_default(64)
+    assert batch.h2f_device_default(8192)
+    monkeypatch.setenv("DRAND_H2F_DEVICE", "0")
+    assert not batch.h2f_device_default(8192)
+    monkeypatch.setenv("DRAND_H2F_DEVICE", "1")
+    assert batch.h2f_device_default(8)
+
+
+def test_pack_fronts_resolve_per_shape():
+    """raw fronts for uniform chunks, the digest front for an irregular
+    chained chunk (seed-width previous_sig), fields below threshold."""
+    _, ver = _verifier(schemes.SHORT_SIG_SCHEME_ID, h2f_device=True)
+    p = ver.pack_chunk([1, 2], [b"\x00" * 48] * 2)
+    assert p[3] == batch.FRONT_RAW_UNCHAINED
+    _, verc = _verifier(schemes.DEFAULT_SCHEME_ID, h2f_device=True)
+    p = verc.pack_chunk([2, 3], [b"\x00" * 96] * 2, [b"\x09" * 96] * 2)
+    assert p[3] == batch.FRONT_RAW_CHAINED
+    # genesis chunk: a 32-byte seed previous_sig is not signature-width
+    p = verc.pack_chunk([1, 2], [b"\x00" * 96] * 2,
+                        [b"\x09" * 32, b"\x08" * 96])
+    assert p[3] == batch.FRONT_DIGEST
+    # a chained chunk whose only prevs are absent still ships raw
+    p = verc.pack_chunk([1, 2], [b"\x00" * 96] * 2, [None, b""])
+    assert p[3] == batch.FRONT_RAW_CHAINED
+    _, verh = _verifier(schemes.SHORT_SIG_SCHEME_ID, h2f_device=False)
+    p = verh.pack_chunk([1, 2], [b"\x00" * 48] * 2)
+    assert p[3] == batch.FRONT_FIELDS
+
+
+def test_pack_does_no_host_hashing_above_threshold():
+    """The counter pin (acceptance): with the device front, pack_chunk
+    performs ZERO per-message host hash-to-field expansions and the pack
+    clock still advances; the host front moves the counter by the padded
+    width."""
+    sch, ver = _verifier(schemes.SHORT_SIG_SCHEME_ID, h2f_device=True)
+    rounds = list(range(1, 10))
+    sigs = [b"\xa0" + b"\x00" * 47] * len(rounds)
+    before = DH.host_h2f_count()
+    t_before = batch.pack_seconds()
+    ver.pack_chunk(rounds, sigs)
+    assert DH.host_h2f_count() == before          # no host hashing at all
+    assert batch.pack_seconds() > t_before        # the pack term ticked
+    _, verh = _verifier(schemes.SHORT_SIG_SCHEME_ID, h2f_device=False)
+    verh.pack_chunk(rounds, sigs)
+    assert DH.host_h2f_count() - before >= len(rounds)
+
+
+def test_service_pins_device_front_per_handle(monkeypatch):
+    """ISSUE 14 CPU smoke: a service handle at the canonical pad selects
+    the device front (healthy, not degraded); pinning the pad below the
+    threshold selects the host oracle."""
+    monkeypatch.setenv("DRAND_H2F_DEVICE_MIN_N", "64")
+    monkeypatch.delenv("DRAND_H2F_DEVICE", raising=False)
+    from drand_tpu.crypto.verify_service import VerifyService
+    sch = schemes.scheme_from_name(schemes.SHORT_SIG_SCHEME_ID)
+    _, pub = sch.keypair(seed=b"h2f-svc")
+    svc = VerifyService(pad=8192, pipeline_depth=1)
+    try:
+        svc.handle(sch, sch.public_bytes(pub))
+        st = svc.stats()
+        entry = next(iter(st["tuning"].values()))
+        assert entry["h2f_device"] is True
+        assert all(state == "healthy" for state in st["backends"].values())
+        assert not svc.degraded_backends()
+        # the pack term is part of the split surface from the start
+        assert st["pack_time_s"] == 0.0
+        assert "pt/qt/dt=" in svc.summary()
+    finally:
+        svc.stop()
+    svc = VerifyService(pad=16, pipeline_depth=1)
+    try:
+        svc.handle(sch, sch.public_bytes(pub))
+        entry = next(iter(svc.stats()["tuning"].values()))
+        assert entry["h2f_device"] is False
+    finally:
+        svc.stop()
+
+
+def test_legacy_fields_encoding_still_accepted():
+    """External callers (bench config 2, the chip profilers, the
+    multichip dryrun) hand `_encode`'s 4-tuple straight to _rlc_ok /
+    _exact — the normalizer must keep that spelling working."""
+    _, ver = _verifier(schemes.SHORT_SIG_SCHEME_ID)
+    enc = (1, 2, (3, 4))
+    norm, front = ver._norm_enc((1, 2, 3, 4))
+    assert norm == enc and front == batch.FRONT_FIELDS
+    norm, front = ver._norm_enc(enc, batch.FRONT_RAW_UNCHAINED)
+    assert norm == enc and front == batch.FRONT_RAW_UNCHAINED
+
+
+def test_round_words_encoding():
+    got = batch.BatchBeaconVerifier._round_words([1, 2 ** 40 + 7], 4)
+    assert got.shape == (4, 2)
+    for i, r in enumerate([1, 2 ** 40 + 7, 0, 0]):
+        assert (int(got[i, 0]) << 32) | int(got[i, 1]) == r

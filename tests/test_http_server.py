@@ -141,10 +141,11 @@ def test_health_includes_verify_service_summary(served):
             body = json.loads(e.read())
         assert "verify" in body
         assert "dispatches=" in body["verify"]
-        # occupancy observability (ISSUE 10): inflight depth + the
-        # queue-vs-device latency split ride along
+        # occupancy observability (ISSUE 10/14): inflight depth + the
+        # pack|queue|device latency split ride along
         assert body["verify_inflight_depth"] == 0
-        assert set(body["verify_latency_split"]) == {"queue_s", "device_s"}
+        assert set(body["verify_latency_split"]) == \
+            {"pack_s", "queue_s", "device_s"}
     finally:
         set_service(old)
         svc.stop()

@@ -232,7 +232,7 @@ def test_service_consults_tuning_file(tmp_path, monkeypatch):
     # the tuned pad drives the chunking: 10 rounds at pad 4 -> 4,4,2
     assert [len(c) for c in stub.calls] == [4, 4, 2]
     tun = next(iter(svc.stats()["tuning"].values()))
-    assert tun == {"pad": 4, "depth": 3}
+    assert (tun["pad"], tun["depth"]) == (4, 3)
     svc.stop()
 
 
@@ -249,7 +249,7 @@ def test_env_override_beats_tuning_file(tmp_path, monkeypatch):
     assert h.verify_batch(*beacons(range(1, 11))).all()
     assert [len(c) for c in stub.calls] == [6, 4]
     tun = next(iter(svc.stats()["tuning"].values()))
-    assert tun == {"pad": 6, "depth": 2}
+    assert (tun["pad"], tun["depth"]) == (6, 2)
     svc.stop()
 
 
@@ -337,7 +337,7 @@ def test_service_resolves_tuning_for_its_group_size(tmp_path, monkeypatch):
     assert h.verify_batch(*beacons(range(1, 11))).all()
     assert [len(c) for c in stub.calls] == [6, 4]  # the @2 pad drives
     tun = next(iter(svc.stats()["tuning"].values()))
-    assert tun == {"pad": 6, "depth": 2}
+    assert (tun["pad"], tun["depth"]) == (6, 2)
     svc.stop()
 
 
@@ -363,9 +363,10 @@ def test_stats_carry_queue_device_split_and_summary():
     st = svc.stats()
     assert st["queue_time_s"] >= 100.0         # the fake-clock window wait
     assert st["device_time_s"] >= 0.0
+    assert st["pack_time_s"] >= 0.0            # the ISSUE 14 pack term
     assert "inflight_depth_max" in st
     s = svc.summary()
-    assert "inflight<=" in s and "qt/dt=" in s
+    assert "inflight<=" in s and "pt/qt/dt=" in s
     svc.stop()
 
 
@@ -377,9 +378,11 @@ def test_health_payload_carries_occupancy_fields():
     assert h.verify_batch(*beacons([1])).all()
     st = svc.stats()
     payload = {"verify_inflight_depth": st["inflight_depth_max"],
-               "verify_latency_split": {"queue_s": st["queue_time_s"],
+               "verify_latency_split": {"pack_s": st["pack_time_s"],
+                                        "queue_s": st["queue_time_s"],
                                         "device_s": st["device_time_s"]}}
-    assert set(payload["verify_latency_split"]) == {"queue_s", "device_s"}
+    assert set(payload["verify_latency_split"]) == \
+        {"pack_s", "queue_s", "device_s"}
     svc.stop()
 
 
@@ -388,6 +391,8 @@ def test_metrics_series_exist():
     metrics.verify_inflight.set(3)
     metrics.verify_dispatch_latency.labels("live", "queue").observe(0.1)
     metrics.verify_dispatch_latency.labels("live", "device").observe(0.2)
+    metrics.verify_dispatch_latency.labels("live", "pack").observe(0.05)
     blob = metrics.scrape("private").decode()
     assert "verify_service_inflight_depth 3.0" in blob
     assert 'verify_service_dispatch_latency_seconds_count{lane="live",phase="queue"}' in blob
+    assert 'verify_service_dispatch_latency_seconds_count{lane="live",phase="pack"}' in blob

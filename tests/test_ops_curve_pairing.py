@@ -93,6 +93,28 @@ class TestH2C:
         got = DC.decode_g1_points(jax.jit(DH.hash_to_g1_jac)(u0, u1))
         assert got == [HH.hash_to_curve_g1(m, DST_G1) for m in msgs]
 
+    def test_device_h2f_full_chain_matches_host(self):
+        """ISSUE 14 golden: message WORDS in, curve points out — the
+        device hash-to-field stages feeding the same SSWU pipelines
+        reproduce host hash_to_curve bit-for-bit on both groups."""
+        from drand_tpu.ops import sha256 as SHA
+
+        msgs = [b"device-h2f-%d" % i for i in range(3)]
+        mw = SHA.pack_msgs_to_words(msgs, len(msgs[0]))
+
+        def g2(mw_):
+            u0, u1 = DH.hash_to_field_fp2_dev(mw_, len(msgs[0]), DST_G2)
+            return DH.hash_to_g2_jac(u0, u1)
+
+        def g1(mw_):
+            u0, u1 = DH.hash_to_field_fp_dev(mw_, len(msgs[0]), DST_G1)
+            return DH.hash_to_g1_jac(u0, u1)
+
+        got2 = DC.decode_g2_points(jax.jit(g2)(mw))
+        assert got2 == [HH.hash_to_curve_g2(m, DST_G2) for m in msgs]
+        got1 = DC.decode_g1_points(jax.jit(g1)(mw))
+        assert got1 == [HH.hash_to_curve_g1(m, DST_G1) for m in msgs]
+
 
 class TestPairing:
     def test_pairing_matches_host(self):

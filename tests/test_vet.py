@@ -181,6 +181,33 @@ def test_trace_sync_in_loop_catches_fixture():
                 if f.path == "crypto/sync_bad.py"]) == 1
 
 
+def test_trace_host_hash_in_loop_catches_fixture():
+    """ISSUE 14 satellite: per-lane host hashing inside loops on the
+    hot-path modules — the exact stage device hash-to-field removed
+    from the steady-state pack path."""
+    report = _fixture_report("trace")
+    hits = [f for f in report.findings
+            if f.path == "ops/hash_bad.py"
+            and f.code == "trace-host-hash-in-loop"]
+    # direct hashlib in a for loop, the aliased `sha256` in a while
+    # loop, the h2f helper comprehension, and the digest_beacon
+    # comprehension — four seeded, each exactly once
+    assert len(hits) == len({f.line for f in hits}) == 4, \
+        sorted(f.line for f in hits)
+    msgs = [f.message for f in hits]
+    assert any("hashlib.sha256" in m for m in msgs)
+    assert any("hash_to_field_fp()" in m for m in msgs)
+    assert any("digest_beacon()" in m for m in msgs)
+    # negatives: one digest outside the loop, numpy packing per message
+    assert not any("hash_once_outside_loop" in m for m in msgs)
+    assert not any("numpy_pack_loop" in m for m in msgs)
+    # the justified parity-oracle site is a suppression, not a finding
+    assert not any("justified_oracle" in m for m in msgs)
+    assert any(f.path == "ops/hash_bad.py" and
+               f.code == "trace-host-hash-in-loop"
+               for f in report.suppressed)
+
+
 def test_store_checker_catches_fixture():
     report = _fixture_report("store")
     codes = _codes(report, "store_bad.py")

@@ -186,9 +186,61 @@ def profile_g2(n):
     print(f"  {'=> rounds/s (e2e program)':30s} {n/ (e2e/1e3):9.1f}")
 
 
+def profile_pack(n, g2=False, reps=None):
+    """--pack mode (ISSUE 14): host pack seconds per chunk PRE (host
+    hash-to-field, the old path) vs POST (raw message words, device
+    h2f), plus the warm end-to-end RLC pass per front — the committed
+    before/after number for the pack term of the pack|queue|device
+    split.  Prints one JSON line."""
+    import json
+
+    from drand_tpu.crypto import batch, schemes
+    from drand_tpu.ops import h2c as DHH
+
+    reps = reps or REPS
+    sid = (schemes.UNCHAINED_SCHEME_ID if g2
+           else schemes.SHORT_SIG_SCHEME_ID)
+    sch = schemes.scheme_from_name(sid)
+    sec, pub = sch.keypair(seed=b"profile-pack")
+    rounds = list(range(1, n + 1))
+    msgs = [sch.digest_beacon(r, None) for r in rounds]
+    sigs = batch.sign_batch(sch, sec, msgs)
+
+    out = {"mode": "pack_profile", "n": n, "kind": "g2" if g2 else "g1",
+           "h2f_min_n": batch.h2f_device_min_n()}
+    for label, h2f in (("host", False), ("device", True)):
+        ver = batch.BatchBeaconVerifier(sch, sch.public_bytes(pub),
+                                        h2f_device=h2f)
+        ts = []
+        hh0 = DHH.host_h2f_count()
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            packed = ver.pack_chunk(rounds, sigs)
+            ts.append(time.perf_counter() - t0)
+        out[f"pack_{label}_s_per_chunk"] = round(sorted(ts)[len(ts) // 2], 4)
+        out[f"pack_{label}_host_hashed_msgs"] = \
+            (DHH.host_h2f_count() - hh0) // reps
+        # warm end-to-end pass so the pack win is read NEXT TO the device
+        # cost it trades against (the device front re-hashes per pass)
+        ver.resolve_packed(packed, ver.dispatch_packed(packed))
+        packed = ver.pack_chunk(rounds, sigs)
+        t0 = time.perf_counter()
+        ok = ver.resolve_packed(packed, ver.dispatch_packed(packed))
+        out[f"e2e_{label}_s"] = round(time.perf_counter() - t0, 4)
+        assert ok.all(), "pack-profile fixture failed verification"
+    out["pack_speedup"] = round(
+        out["pack_host_s_per_chunk"] /
+        max(1e-9, out["pack_device_s_per_chunk"]), 2)
+    print(json.dumps(out), flush=True)
+
+
 if __name__ == "__main__":
     args = sys.argv[1:]
     g2 = "--g2" in args
+    pack = "--pack" in args
     ns = [int(a) for a in args if not a.startswith("--")] or [4096]
     for n in ns:
-        (profile_g2 if g2 else profile)(n)
+        if pack:
+            profile_pack(n, g2=g2)
+        else:
+            (profile_g2 if g2 else profile)(n)
