@@ -44,6 +44,13 @@ Configs (BASELINE.json north_star):
                        1-device chip this degenerates to one group +
                        an unsharded huge batch — still measured, never
                        marked degraded for that)
+  9. multitenant_serving (ISSUE 15): N tenants with heterogeneous
+                       schemes (G1 vs G2 cost) served through the
+                       tenancy layer — weighted placement, per-tenant
+                       read admission (one tenant deliberately
+                       rate-capped), measured per-tenant device time;
+                       per-tenant r/s, quota rejections and the
+                       placement map land in the JSON
 
 Compiled-program economy: every verifier pads to PAD=8192 (pad_to), so
 each RLC program shape compiles once.  Since ISSUE 14 the message FRONT
@@ -97,6 +104,12 @@ COMMITTEE_N = int(os.environ.get("DRAND_TPU_BENCH_COMMITTEE_N", "1024"))
 COMMITTEE_ROUNDS = int(os.environ.get("DRAND_TPU_BENCH_COMMITTEE_ROUNDS",
                                       "4"))
 COMMITTEE_DKG_T = int(os.environ.get("DRAND_TPU_BENCH_COMMITTEE_T", "32"))
+# config 9 (ISSUE 15): rounds per tenant replay, timed passes, and how
+# many tenants at most (heterogeneous-scheme lineup is defined in the
+# config; trimming it trims from the tail)
+N_TENANT = int(os.environ.get("DRAND_TPU_BENCH_TENANT_N", str(2 * PAD)))
+TENANT_PASSES = int(os.environ.get("DRAND_TPU_BENCH_TENANT_PASSES", "2"))
+TENANT_MAX = int(os.environ.get("DRAND_TPU_BENCH_TENANT_MAX", "4"))
 
 
 def _progress(msg):
@@ -108,13 +121,13 @@ def _progress(msg):
 
 
 def _configs():
-    raw = os.environ.get("DRAND_TPU_BENCH_CONFIGS", "1,2,3,4,5,6,7,8")
+    raw = os.environ.get("DRAND_TPU_BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9")
     out = set()
     for x in raw.split(","):
         x = x.strip()
-        if x.isdigit() and 1 <= int(x) <= 8:
+        if x.isdigit() and 1 <= int(x) <= 9:
             out.add(int(x))
-    return out or {1, 2, 3, 4, 5, 6, 7, 8}
+    return out or {1, 2, 3, 4, 5, 6, 7, 8, 9}
 
 
 def _jax_setup():
@@ -599,6 +612,143 @@ def bench_multidevice_scaleout(stats):
         svc.stop()
 
 
+def bench_multitenant_serving(stats):
+    """Config 9 (ISSUE 15): N tenants with heterogeneous schemes (G1 vs
+    G2 partial cost) and periods, served through the TENANCY layer — the
+    registry's weighted placement assigns each tenant's chain a device
+    group, every read admission runs the per-tenant sub-budgets, and the
+    verify service attributes measured device time per tenant.  Recorded:
+    per-tenant rounds/s, quota rejections (one tenant is deliberately
+    rate-capped), the chain→group placement map, and per-tenant device
+    seconds.  Value = total verified rounds/s across tenants."""
+    import threading
+
+    from drand_tpu.core.tenancy import TenantConfig, TenantRegistry
+    from drand_tpu.crypto import schemes
+    from drand_tpu.crypto.verify_service import VerifyService
+    from drand_tpu.net.admission import AdmissionController, CLASS_SHEDDABLE
+
+    registry = TenantRegistry()     # in-memory: bench, not a daemon
+    ctrl = AdmissionController(tenancy=registry, capacity=64,
+                               critical_reserve=8)
+    svc = VerifyService(pad=PAD, background_window=0.0,
+                        watchdog_floor=3600.0)
+    svc.set_tenancy(registry)
+    # heterogeneous tenants: scheme changes per-round device cost
+    # (G1 vs G2 RLC flavors), period is the nominal read cadence the
+    # rate quota is sized against; "capped" gets a bucket far below its
+    # offered load so quota rejections are measured, not hypothetical
+    tenants = [
+        ("anchor", schemes.SHORT_SIG_SCHEME_ID, dict(weight=2.0,
+                                                     anti_affinity=True)),
+        ("burst", schemes.SHORT_SIG_SCHEME_ID, dict(weight=1.0)),
+        ("heavy-g2", schemes.UNCHAINED_SCHEME_ID, dict(weight=1.0)),
+        ("capped", schemes.SHORT_SIG_SCHEME_ID, dict(weight=0.5, rate=4.0,
+                                                     burst=4)),
+    ][:max(2, TENANT_MAX)]
+    periods = {"anchor": 3, "burst": 30, "heavy-g2": 30, "capped": 30}
+    chains = {}
+    for name, scheme_id, kw in tenants:
+        chain_id = f"{name}-chain"
+        registry.set_tenant(TenantConfig(name=name, chains=(chain_id,),
+                                         **kw))
+        sch, pub, store = _unchained_store(
+            scheme_id, N_TENANT, f"mt-{name}".encode(),
+            f"mt-{name}")
+        registry.register_chain(chain_id, pk=pub)
+        chains[name] = (svc.handle(sch, pub), store)
+    _progress(f"multitenant fixtures ready: {len(chains)} tenants")
+
+    def replay(name, count_sheds=False):
+        handle, store = chains[name]
+        rounds = list(range(1, N_TENANT + 1))
+        sigs = [store.get(r).signature for r in rounds]
+        step = max(1, N_TENANT // 4)
+        served = sheds = 0
+        futs = []
+        for lo in range(0, N_TENANT, step):
+            # every span is admitted AS the tenant (the serving-path
+            # read admission the REST/gRPC edges perform)
+            ticket, s = ctrl.try_admit(CLASS_SHEDDABLE, tenant=name)
+            if ticket is None:
+                sheds += 1
+                assert s.tenant == name and s.retry_after > 0
+                continue
+            try:
+                futs.append((handle.submit(
+                    rounds[lo:lo + step], sigs[lo:lo + step],
+                    lane="live", flush_now=True), lo, step))
+            finally:
+                ticket.release()
+        for f, lo, _ in futs:
+            ok = f.result()
+            assert ok.all()
+            served += len(ok)
+        return served, sheds
+
+    try:
+        for name, _, _ in tenants:          # warm/compile, serial
+            replay(name)
+            _progress(f"multitenant warm: {name}")
+        per_tenant = {}
+        rejections = {}
+        served_total = {}
+        errs = []
+
+        def worker(name):
+            try:
+                t0 = time.perf_counter()
+                total_served = total_shed = 0
+                for _ in range(TENANT_PASSES):
+                    served, sheds = replay(name)
+                    total_served += served
+                    total_shed += sheds
+                dt = time.perf_counter() - t0
+                per_tenant[name] = round(total_served / dt, 1)
+                rejections[name] = total_shed
+                served_total[name] = total_served
+            except Exception as e:
+                errs.append(e)
+
+        before = svc.stats()
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker, args=(name,))
+                   for name, _, _ in tenants]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+        st = svc.stats()
+        total_rounds = sum(served_total.values())
+        stats["multitenant_n_tenants"] = len(tenants)
+        stats["multitenant_rounds_per_tenant"] = N_TENANT * TENANT_PASSES
+        stats["multitenant_schemes"] = {n: s for n, s, _ in tenants}
+        stats["multitenant_periods"] = {n: periods[n]
+                                        for n, _, _ in tenants}
+        stats["multitenant_per_tenant_rps"] = per_tenant
+        stats["multitenant_quota_rejections"] = rejections
+        stats["multitenant_placement"] = {
+            st["tenant_map"].get(label, "?"): gid
+            for label, gid in st["group_map"].items()}
+        stats["multitenant_device_seconds"] = {
+            n: round(registry.device_seconds_total(n), 3)
+            for n, _, _ in tenants}
+        stats["multitenant_serving_backend"] = (
+            "host_fallback" if st["failovers"] > before["failovers"]
+            or "degraded" in st["backends"].values() else "device")
+        # the capped tenant must actually have been rate-limited — a
+        # zero here means the quota plumbing silently did nothing
+        if any(n == "capped" for n, _, _ in tenants) \
+                and rejections.get("capped", 0) == 0:
+            stats["multitenant_warning"] = "capped tenant was never shed"
+        return total_rounds / dt
+    finally:
+        svc.stop()
+
+
 def bench_committee_scale(stats):
     """Config 8 (ISSUE 13): the committee-scale engine, in-process.
 
@@ -714,12 +864,14 @@ _RUNNERS = {
     6: "coalesced_service",
     7: "multidevice_scaleout",
     8: "committee_scale",
+    9: "multitenant_serving",
 }
 # Order: config 2 compiles/loads the shared G1@PAD program that 5, 6, 7,
-# 3 and 4 reuse; G2 (1, then 4) go after the G1 family so a G2 compile
-# overrun cannot starve the G1 numbers; 8 last (its (1, n) partials
-# program is unique to it).
-_ORDER = [2, 5, 6, 7, 3, 1, 4, 8]
+# 9, 3 and 4 reuse; G2 (1, then 4) go after the G1 family so a G2
+# compile overrun cannot starve the G1 numbers (9 sits between — its
+# heavy-g2 tenant shares config 4's G2-unchained flavor); 8 last (its
+# (1, n) partials program is unique to it).
+_ORDER = [2, 5, 6, 7, 3, 1, 4, 9, 8]
 
 
 def _child(indices):
@@ -737,6 +889,7 @@ def _child(indices):
             6: lambda: bench_coalesced_service(stats),
             7: lambda: bench_multidevice_scaleout(stats),
             8: lambda: bench_committee_scale(stats),
+            9: lambda: bench_multitenant_serving(stats),
         }
         t0 = time.monotonic()
         try:
@@ -815,6 +968,7 @@ def _emit(configs, stats):
               "coalesced_service": N_STREAM,
               "multidevice_scaleout": N_MD,
               "committee_scale": COMMITTEE_N,
+              "multitenant_serving": N_TENANT * TENANT_PASSES,
               **stats},
     }
     line = json.dumps(out)

@@ -10,11 +10,15 @@ request with no ceiling at all — the exact resource-exhaustion bug the
 beacon-client security review (arXiv:2109.11677) calls the dominant
 practical failure class.
 
-Scope: the serving paths only — `net/`, `http_server.py`, `relay.py`.
-Internal planes (DKG broadcast buffers, the aggregator's partial queue)
-are ingress-validated and threshold-bounded upstream, so they keep their
-simpler constructs.  A deliberate unbounded construct in scope carries a
-`# tpu-vet: disable=bounds` suppression WITH a justification.
+Scope: the serving paths only — `net/`, `http_server.py`, `relay.py`,
+and `core/tenancy.py` (the tenant registry sits on every admission
+decision and every Control-plane edit: any queue or executor grown there
+is flood-reachable, so it must be bounded like the rest of the serving
+plane).  Internal planes (DKG broadcast buffers, the aggregator's
+partial queue) are ingress-validated and threshold-bounded upstream, so
+they keep their simpler constructs.  A deliberate unbounded construct in
+scope carries a `# tpu-vet: disable=bounds` suppression WITH a
+justification.
 
 Flagged:
   * ``queue.Queue()`` / ``LifoQueue`` / ``PriorityQueue`` /
@@ -33,7 +37,7 @@ from ..core import Finding
 from ..symbols import ModuleInfo, dotted
 
 SCOPE_PREFIXES = ("net/",)
-SCOPE_FILES = ("http_server.py", "relay.py")
+SCOPE_FILES = ("http_server.py", "relay.py", "core/tenancy.py")
 
 BOUNDED_QUEUES = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue"}
 UNBOUNDABLE_QUEUES = {"queue.SimpleQueue"}

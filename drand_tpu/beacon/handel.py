@@ -432,6 +432,41 @@ def from_packet(pkt) -> Tuple[int, Optional[bytes], int, int, Aggregate]:
             pkt.sender_index, agg)
 
 
+def peer_host(addr: str) -> str:
+    """Host component of either a gRPC transport peer string
+    ('ipv4:10.0.0.1:52644', 'ipv6:[::1]:52644') or a drand node address
+    ('10.0.0.1:8080', 'node-a:443', '[::1]:8080').  The sender-binding
+    check compares hosts: the client connects from an ephemeral port, so
+    the port component carries no identity."""
+    a = addr
+    if a.startswith(("ipv4:", "ipv6:")):
+        a = a.split(":", 1)[1]
+    if a.startswith("[") and "]" in a:      # bracketed ipv6 literal
+        return a[:a.index("]") + 1]
+    return a.rsplit(":", 1)[0] if ":" in a else a
+
+
+def _ip_literal(host: str) -> bool:
+    """True iff `host` is an IPv4/IPv6 literal (brackets tolerated)."""
+    import ipaddress
+    try:
+        ipaddress.ip_address(host.strip("[]"))
+        return True
+    except ValueError:
+        return False
+
+
+def sender_binding_enforceable(claimed_addr: str) -> bool:
+    """The binding check compares the ROSTER address host against the
+    transport peer host — but gRPC's `context.peer()` is always a
+    numeric IP, so a roster registered under DNS names (the common
+    production form) would fail the comparison for every honest packet.
+    Enforce only when the roster host is itself an IP literal; DNS-named
+    rosters (and NAT'd deployments) keep the pre-binding trust model and
+    should bind identity with mTLS instead (the COMPONENTS.md note)."""
+    return _ip_literal(peer_host(claimed_addr))
+
+
 class ChainVerifier:
     """Late-bound view of a ChainStore's partial verifier: a reshare
     transition swaps `chain.partial_verifier` for the new group's, and
@@ -616,13 +651,36 @@ class HandelCoordinator:
         sess.add_own(partial)
         sess._send_pass()
 
-    def receive(self, pkt) -> None:
+    def receive(self, pkt, peer: Optional[str] = None) -> None:
         """One wire candidate (daemon ingress).  Raises ValueError on
-        protocol violations (mapped to INVALID_ARGUMENT upstream)."""
+        protocol violations (mapped to INVALID_ARGUMENT upstream).
+
+        `peer` is the TRANSPORT-level sender (gRPC `context.peer()`):
+        when given, the claimed `sender_index` must map — via the group
+        roster the coordinator was built with — to the same host the
+        packet physically arrived from (ROADMAP 3d).  Without this,
+        sender_index is pure self-declaration: any member could claim a
+        victim's index on forged candidates and farm the victim's
+        session-local score demotion (the one per-peer state content
+        offences feed).  Host-granular by design — the client dials from
+        an ephemeral port, and finer binding belongs to mTLS."""
         from ..metrics import handel_candidates
         round_, prev_sig, level, sender, agg = from_packet(pkt)
         if not (0 <= sender < self.n):
             raise ValueError(f"handel sender index {sender} out of range")
+        if peer is not None and self.score_key is not None:
+            claimed = self.score_key(sender)
+            # enforce only for IP-literal rosters: the transport peer is
+            # always numeric, so a DNS-named roster entry can never
+            # match and enforcing would reject every honest packet
+            # (sender_binding_enforceable; DNS rosters bind with mTLS)
+            if sender_binding_enforceable(claimed) \
+                    and peer_host(claimed) != peer_host(peer):
+                handel_candidates.labels(self.beacon_id,
+                                         "impersonation").inc()
+                raise ValueError(
+                    f"handel sender index {sender} is registered at "
+                    f"{claimed}, but the packet arrived from {peer}")
         sess = self._session(round_, prev_sig)
         if sess is None:
             return                      # stale round: already aggregated

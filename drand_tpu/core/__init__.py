@@ -3,6 +3,7 @@
 from .beacon_process import BeaconProcess
 from .config import Config, default_config_folder
 from .daemon import DrandDaemon
+from .tenancy import TenantConfig, TenantRegistry
 
-__all__ = ["BeaconProcess", "Config", "DrandDaemon",
-           "default_config_folder"]
+__all__ = ["BeaconProcess", "Config", "DrandDaemon", "TenantConfig",
+           "TenantRegistry", "default_config_folder"]

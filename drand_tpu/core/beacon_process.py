@@ -354,11 +354,15 @@ class BeaconProcess:
         """The /health `handel` block (None when the overlay is off)."""
         return self.handel.summary() if self.handel is not None else None
 
-    def process_handel(self, req) -> None:
+    def process_handel(self, req, peer: Optional[str] = None) -> None:
         """RPC ingress for drand.Protocol/HandelAggregate.  The future-
         round window check mirrors process_partial: without it a flood
         of far-future rounds would churn the coordinator's session cap
-        and evict the LIVE round's aggregation state."""
+        and evict the LIVE round's aggregation state.  `peer` is the
+        transport-level gRPC sender: the coordinator rejects packets
+        whose claimed sender_index is registered at a different host
+        (ROADMAP 3d — score demotion must not be griefable by
+        impersonation)."""
         if self.handel is None:
             raise ValueError("handel overlay not active")
         if self.handler is not None:
@@ -367,7 +371,7 @@ class BeaconProcess:
                 raise ValueError(
                     f"handel aggregate for future round {req.round} "
                     f"(next {next_round})")
-        self.handel.receive(req)
+        self.handel.receive(req, peer=peer)
 
     def start_beacon(self, catchup: bool) -> None:
         """Create store + handler + sync plane and start the round loop

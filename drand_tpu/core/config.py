@@ -109,6 +109,15 @@ class Config:
     rest_workers: int = 16              # REST edge worker-pool bound
     _admission: Optional[object] = field(default=None, init=False,
                                          repr=False, compare=False)
+    # multi-tenant serving (core/tenancy.py, ISSUE 15): the tenant
+    # registry — tenant → chains/weight/quotas/placement — persisted
+    # atomically beside the multibeacon layout and editable over the
+    # Control plane.  tenancy_device_window is the rolling window
+    # (seconds) the device-time quota is measured over; 0 = module
+    # default (DRAND_TENANT_DEVICE_WINDOW, else 30 s).
+    tenancy_device_window: float = 0.0
+    _tenancy: Optional[object] = field(default=None, init=False,
+                                       repr=False, compare=False)
     # startup chain-integrity pass (chain/integrity.py): "off" trusts the
     # disk, "linkage" is the structural host-only scan (gaps, torn rows,
     # prev_sig linkage), "full" adds batched signature verification —
@@ -172,7 +181,32 @@ class Config:
             adm = self._admission
             if adm is not None and adm.background_paused():
                 self._verify_service.set_background_paused(True)
+            # tenant-aware placement + per-tenant device-time accounting
+            self._verify_service.set_tenancy(self.tenancy())
         return self._verify_service
+
+    def tenancy(self):
+        """The daemon-owned tenant registry (core/tenancy.py), created on
+        first use: persisted at `<folder>/multibeacon/tenants.json`,
+        bound to the daemon clock, and wired so a Control-plane tenant
+        change reaches both enforcement planes without a restart (the
+        admission controller reads the registry live; the verify service
+        re-applies placement via `rebalance_tenants`)."""
+        if self._tenancy is None:
+            from .tenancy import TenantRegistry, registry_path
+            self._tenancy = TenantRegistry(
+                path=registry_path(self.folder), clock=self.clock,
+                device_window=self.tenancy_device_window)
+            self._tenancy.on_change(self._on_tenancy_change)
+        return self._tenancy
+
+    def _on_tenancy_change(self) -> None:
+        """Registry change listener: placement rebalance on the live
+        service (never CREATE one — adding a tenant to an idle daemon
+        must not spin up the verify pipeline as a side effect)."""
+        svc = self._verify_service
+        if svc is not None:
+            svc.rebalance_tenants()
 
     def handel_config(self):
         """The overlay knob bundle (beacon/handel.py HandelConfig); zeros
@@ -201,7 +235,8 @@ class Config:
                 recover_wait=self.admission_recover_wait,
                 dwell=self.admission_dwell,
                 pace_rate=self.admission_pace_rate,
-                background_hook=self._pause_background)
+                background_hook=self._pause_background,
+                tenancy=self.tenancy())
         return self._admission
 
     def _pause_background(self, paused: bool) -> None:

@@ -39,11 +39,16 @@ class DrandDaemon:
         self._exit = threading.Event()
 
         self.resilience = cfg.make_resilience(scope="node")
+        # multi-tenant registry (core/tenancy.py): who owns each chain,
+        # with what weight/quotas/placement — loaded from the multibeacon
+        # layout, edited over the Control plane below
+        self.tenancy = cfg.tenancy()
         # one serving-plane admission controller for every inbound
         # surface: the private gRPC gateway below, the REST edge (cli
         # wiring passes daemon.admission into RestServer), and the
         # SyncChain stream pacing — partials stay critical-class while
-        # public reads shed first (ROADMAP 5a overload protection)
+        # public reads shed first (ROADMAP 5a overload protection); the
+        # controller reads the tenant registry for per-tenant sub-budgets
         self.admission = cfg.admission()
         self.gateway = PrivateGateway(
             cfg.private_listen,
@@ -119,8 +124,12 @@ class DrandDaemon:
         for beacon_id in list_beacon_ids(self.cfg.folder):
             bp = self.instantiate_beacon_process(beacon_id)
             if bp.load():
-                bp.start_beacon(catchup=True)
+                # register BEFORE start_beacon: the verify handles built
+                # there resolve their tenant via the registry's pk index
+                # (register_chain also notifies, so late creation is
+                # re-labelled — this order just avoids the churn)
                 self._register_chain_hash(bp)
+                bp.start_beacon(catchup=True)
                 self.log.info("beacon loaded from disk", beacon_id=beacon_id)
             elif bp.journal.load_pending() is not None:
                 # newcomer restart with a staged reshare still pending:
@@ -138,6 +147,12 @@ class DrandDaemon:
         if info is not None:
             with self._lock:
                 self.chain_hashes[info.hash_string()] = bp.beacon_id
+            # index the chain for tenant resolution: hash (REST path /
+            # gRPC metadata) and public key (the verify service's
+            # pk-keyed handles) both map back to the beacon id
+            self.tenancy.register_chain(bp.beacon_id,
+                                        pk=info.public_key,
+                                        chain_hash=info.hash_string())
 
     # -- routing (drand_daemon_helper.go:77) ---------------------------------
 
@@ -231,7 +246,9 @@ class ProtocolService:
     def handel_aggregate(self, req, context):
         bp = _route(self.daemon, context, req.metadata)
         try:
-            bp.process_handel(req)
+            # the transport-level peer authenticates the claimed
+            # sender_index (beacon/handel.py sender-binding check)
+            bp.process_handel(req, peer=context.peer())
         except ValueError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         return pb.Empty()
@@ -388,8 +405,8 @@ class ControlService:
                     setup_timeout=info.timeout_seconds or 60)
         except Exception as e:
             context.abort(grpc.StatusCode.ABORTED, f"dkg failed: {e}")
-        bp.start_beacon(catchup=False)
         self.daemon._register_chain_hash(bp)
+        bp.start_beacon(catchup=False)
         return convert.group_to_proto(group, bp.beacon_id)
 
     def init_reshare(self, req, context):
@@ -451,8 +468,8 @@ class ControlService:
         if not bp.load():
             context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                           "beacon has no stored state")
-        bp.start_beacon(catchup=True)
         self.daemon._register_chain_hash(bp)
+        bp.start_beacon(catchup=True)
         return pb.LoadBeaconResponse(metadata=convert.metadata())
 
     def start_follow_chain(self, req, context):
@@ -547,6 +564,48 @@ class ControlService:
             except FileNotFoundError:
                 pass
         return pb.BackupDBResponse(metadata=convert.metadata(bp.beacon_id))
+
+    # -- multi-tenant registry (core/tenancy.py, ISSUE 15) -------------------
+
+    def _tenant_list_response(self) -> pb.TenantListResponse:
+        out = pb.TenantListResponse(metadata=convert.metadata())
+        reg = self.daemon.tenancy
+        for name in reg.names():
+            cfg = reg.get(name)
+            if cfg is None:
+                continue
+            out.tenants.append(pb.TenantConfigPacket(
+                name=cfg.name, weight=cfg.weight, rate=cfg.rate,
+                burst=cfg.burst, device_budget=cfg.device_budget,
+                chains=list(cfg.chains),
+                pin_group=-1 if cfg.pin_group is None else cfg.pin_group,
+                anti_affinity=cfg.anti_affinity, paused=cfg.paused))
+        return out
+
+    def tenant_set(self, req, context):
+        """Add or update one tenant (upsert); the registry persists
+        atomically and both enforcement planes see the change without a
+        restart."""
+        from .tenancy import TenantConfig
+        try:
+            self.daemon.tenancy.set_tenant(TenantConfig(
+                name=req.name, weight=req.weight, rate=req.rate,
+                burst=req.burst, device_budget=req.device_budget,
+                chains=tuple(req.chains),
+                pin_group=None if req.pin_group < 0 else req.pin_group,
+                anti_affinity=req.anti_affinity, paused=req.paused))
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return self._tenant_list_response()
+
+    def tenant_remove(self, req, context):
+        if not self.daemon.tenancy.remove_tenant(req.name):
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"unknown tenant {req.name!r}")
+        return self._tenant_list_response()
+
+    def tenant_list(self, req, context):
+        return self._tenant_list_response()
 
     def remote_status(self, req, context):
         bp = self._bp(context, req.metadata)

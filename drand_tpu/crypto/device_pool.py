@@ -166,6 +166,13 @@ class DevicePool:
         # fallback handles): they keep a stream affinity but must not
         # weigh on the least-loaded placement of real device chains
         self._weightless: set = set()
+        # tenant-aware placement (core/tenancy.py, ISSUE 15): per-key WFQ
+        # weight (placement is weight-PROPORTIONAL: a weight-3 tenant's
+        # chain loads a group 3x as much as a weight-1 chain, so the
+        # least-loaded choice spreads heavy tenants first) and the key's
+        # tenant label for anti-affinity + the snapshot
+        self._weights: Dict[Tuple, float] = {}
+        self._tenants: Dict[Tuple, str] = {}
         self._lock = threading.Lock()
         self._pool_sharding = None
         self._pool_sharding_built = False
@@ -201,27 +208,55 @@ class DevicePool:
 
     # -- assignment -----------------------------------------------------------
 
-    def _loads_locked(self) -> Dict[int, int]:
-        loads = {g.gid: 0 for g in self.groups}
+    def _loads_locked(self) -> Dict[int, float]:
+        loads = {g.gid: 0.0 for g in self.groups}
         for key, gid in self._assignments.items():
             if key not in self._weightless:
-                loads[gid] = loads.get(gid, 0) + 1
+                loads[gid] = loads.get(gid, 0.0) \
+                    + self._weights.get(key, 1.0)
         return loads
 
-    def assign(self, key, weigh: bool = True) -> DeviceGroup:
-        """Sticky least-loaded assignment.  Healthy groups are preferred;
-        with every group faulted the least-loaded one is used anyway
-        (the service's own failover ladder handles the fault).
-        `weigh=False` grants a stream affinity without counting toward
-        group load — host-fallback handles never dispatch on the
-        devices, so they must not push device chains off a group."""
+    def assign(self, key, weigh: bool = True, tenant: Optional[str] = None,
+               weight: float = 1.0, pin: Optional[int] = None,
+               anti_affinity: bool = False) -> DeviceGroup:
+        """Sticky least-loaded assignment, weight-proportional.  Healthy
+        groups are preferred; with every group faulted the least-loaded
+        one is used anyway (the service's own failover ladder handles
+        the fault).  `weigh=False` grants a stream affinity without
+        counting toward group load — host-fallback handles never
+        dispatch on the devices, so they must not push device chains off
+        a group.
+
+        Tenant hints (core/tenancy.py `placement_for_pk`): `weight`
+        scales this key's contribution to group load, `pin` forces a
+        specific group (premium isolation; ignored when out of range, and
+        a FAULTED pinned group still pins — its failover is the
+        service's ladder, not a silent placement change), and
+        `anti_affinity` prefers a healthy group no OTHER tenant's keys
+        occupy when one exists."""
         with self._lock:
             gid = self._assignments.get(key)
             if gid is not None:
                 return self.groups[gid]
+            if tenant is not None:
+                self._tenants[key] = tenant
+            self._weights[key] = max(0.0, float(weight))
+            if pin is not None and 0 <= pin < len(self.groups):
+                self._assignments[key] = pin
+                if not weigh:
+                    self._weightless.add(key)
+                return self.groups[pin]
             loads = self._loads_locked()
             candidates = [g for g in self.groups
                           if g.state == GROUP_HEALTHY] or self.groups
+            if anti_affinity and tenant is not None:
+                empty = [g for g in candidates
+                         if not any(gid == g.gid
+                                    and self._tenants.get(k) != tenant
+                                    and k not in self._weightless
+                                    for k, gid in self._assignments.items())]
+                if empty:
+                    candidates = empty
             best = min(candidates, key=lambda g: (loads[g.gid], g.gid))
             self._assignments[key] = best.gid
             if not weigh:
@@ -256,16 +291,29 @@ class DevicePool:
         with self._lock:
             self._assignments.pop(key, None)
             self._weightless.discard(key)
+            self._weights.pop(key, None)
+            self._tenants.pop(key, None)
 
-    def loads(self) -> Dict[int, int]:
+    def loads(self) -> Dict[int, float]:
         with self._lock:
             return self._loads_locked()
 
+    def gid_of(self, key) -> Optional[int]:
+        with self._lock:
+            return self._assignments.get(key)
+
     def snapshot(self) -> dict:
-        """Per-group view for stats()/health: device count, state and
-        handle load."""
+        """Per-group view for stats()/health: device count, state,
+        weighted handle load, and which tenants' chains live there."""
         with self._lock:
             loads = self._loads_locked()
+            tenants = {g.gid: set() for g in self.groups}
+            for key, gid in self._assignments.items():
+                t = self._tenants.get(key)
+                if t is not None and key not in self._weightless:
+                    tenants.setdefault(gid, set()).add(t)
         return {g.gid: {"devices": g.n_devices, "state": g.state,
-                        "handles": loads.get(g.gid, 0)}
+                        "handles": loads.get(g.gid, 0),
+                        **({"tenants": sorted(tenants[g.gid])}
+                           if tenants.get(g.gid) else {})}
                 for g in self.groups}

@@ -256,11 +256,13 @@ class _BackendSlot:
                  "state", "latencies", "sample", "failovers", "degraded_at",
                  "first_fault_at", "pad", "depth", "scheme", "pk", "kind",
                  "gid", "group_size", "backend_factory", "pool_backend",
-                 "pool_pad", "pool_ok", "pool_retry_at", "migrations")
+                 "pool_pad", "pool_ok", "pool_retry_at", "migrations",
+                 "tenant")
 
     def __init__(self, key, label, primary, fallback_factory=None,
                  pad=DEFAULT_PAD, depth=1, scheme=None, pk=b"",
-                 kind="custom", gid=0, group_size=0, backend_factory=None):
+                 kind="custom", gid=0, group_size=0, backend_factory=None,
+                 tenant=None):
         self.key = key
         self.label = label
         self.primary = primary
@@ -291,6 +293,9 @@ class _BackendSlot:
         self.failovers = 0
         self.degraded_at = None
         self.first_fault_at = None
+        # multi-tenant serving (ISSUE 15): the tenant this chain belongs
+        # to — device-time accounting + the placement map key
+        self.tenant = tenant
 
     @property
     def can_failover(self) -> bool:
@@ -428,6 +433,10 @@ class VerifyService:
         self.shard_threshold = max(0, int(shard_threshold or 0)) \
             or DEFAULT_SHARD_THRESHOLD
         self._pool = pool
+        # core/tenancy.py TenantRegistry (duck-typed): placement hints at
+        # handle creation, per-tenant device-time accounting per dispatch
+        self._tenancy = None
+        self._tenant_rebalances = 0
         self._cond = threading.Condition()
         self._streams: Dict[int, _GroupStream] = {}
         self._handles: Dict[Tuple, VerifyHandle] = {}
@@ -500,10 +509,25 @@ class VerifyService:
         if h is not None:
             return h
         pool = self._get_pool()
+        # tenant-aware placement (ISSUE 15): the registry maps the chain
+        # public key to its tenant's weight / group pin / anti-affinity;
+        # no registry (or an unknown chain) keeps the pre-tenancy
+        # least-loaded behavior exactly
+        tenant, hints = None, {}
+        if self._tenancy is not None:
+            try:
+                p = self._tenancy.placement_for_pk(pk)
+                tenant = p.get("tenant")
+                hints = {"tenant": tenant,
+                         "weight": p.get("weight", 1.0),
+                         "pin": p.get("pin"),
+                         "anti_affinity": p.get("anti_affinity", False)}
+            except Exception:
+                tenant, hints = None, {}
         # host handles get a stream but no placement weight: they never
         # dispatch on the group's devices, and counting them would push
         # real device chains off otherwise-empty groups
-        group = pool.assign(key, weigh=(kind != "host"))
+        group = pool.assign(key, weigh=(kind != "host"), **hints)
         pad, depth = self._tuned(scheme, max(1, group.n_devices))
         factory = backend_factory
         if backend is None and factory is None and kind == "device":
@@ -547,7 +571,7 @@ class VerifyService:
                             fallback_factory, pad=pad, depth=depth,
                             scheme=scheme, pk=pk, kind=kind,
                             gid=group.gid, group_size=group.n_devices,
-                            backend_factory=factory)
+                            backend_factory=factory, tenant=tenant)
         if pool_backend is not None:
             slot.pool_backend = pool_backend
             slot.pool_pad = getattr(pool_backend, "pad_to", 0) \
@@ -575,6 +599,77 @@ class VerifyService:
                 verify_backend_state.remove(slot.label, str(slot.gid))
             except KeyError:
                 pass
+
+    def set_tenancy(self, tenancy) -> None:
+        """Install the tenant registry (core/tenancy.py): new handles
+        place by tenant weight/pin/anti-affinity, and every device
+        dispatch attributes its measured device time to the chain's
+        tenant.  Config wires registry changes to `rebalance_tenants`."""
+        with self._cond:
+            self._tenancy = tenancy
+
+    def rebalance_tenants(self) -> int:
+        """Re-apply tenant placement after a registry change (tenant
+        add/update/remove, or a reshare swapping chains between
+        tenants): slots whose tenant's PIN now names a different group
+        move there (backend rebuilt on the target group's devices, the
+        _migrate discipline); slots whose tenant label changed just
+        re-label (sticky affinity — an unpinned chain is never shuffled,
+        churn rebalances it naturally).  Returns the number of slots
+        moved."""
+        tenancy = self._tenancy
+        pool = self._pool
+        if tenancy is None or pool is None:
+            return 0
+        with self._cond:
+            if self._stopped:
+                return 0
+            slots = list(self._slots.values())
+        moved = 0
+        for slot in slots:
+            try:
+                p = tenancy.placement_for_pk(slot.pk)
+            except Exception:
+                continue
+            tenant, pin = p.get("tenant"), p.get("pin")
+            with self._cond:
+                slot.tenant = tenant
+            if pin is None or not (0 <= pin < pool.n_groups) \
+                    or pin == slot.gid:
+                continue
+            if self._retarget(slot, pool.group(pin)):
+                moved += 1
+        if moved:
+            with self._cond:
+                self._tenant_rebalances += moved
+        return moved
+
+    def _retarget(self, slot: _BackendSlot, group) -> bool:
+        """Move one GROUP-BACKED slot's affinity and primary backend to
+        a specific group — the policy-driven sibling of `_migrate`.
+        Slots with no backend factory (explicit `backend=` injections,
+        host fallbacks) are never moved: their backend would keep
+        executing wherever it was built, so moving only the gid/stream
+        would charge the pinned group for work running elsewhere —
+        placement accounting must never lie.  A failed rebuild leaves
+        the slot untouched."""
+        if slot.backend_factory is None:
+            return False
+        old_gid = slot.gid
+        try:
+            new_backend = slot.backend_factory(group)
+        except BaseException:
+            return False
+        pad, depth = self._tuned(slot.scheme, max(1, group.n_devices)) \
+            if slot.scheme is not None else (slot.pad, slot.depth)
+        with self._cond:
+            slot.primary = new_backend
+            slot.gid = group.gid
+            slot.group_size = group.n_devices
+            slot.pad, slot.depth = pad, depth
+        self._pool.place(slot.key, group.gid)
+        self._set_state_gauge(slot, old_gid=old_gid)
+        return True
 
     def _get_pool(self):
         """The service-owned DevicePool, built on first handle (device
@@ -1817,6 +1912,17 @@ class VerifyService:
             if slot is not None:
                 # the latency history the watchdog deadline derives from
                 slot.latencies.append(max(0.0, elapsed))
+        if slot is not None and slot.tenant is not None \
+                and self._tenancy is not None:
+            # per-tenant device-time accounting (ISSUE 15): the measured
+            # device phase of the pack|queue|device split, attributed to
+            # the chain's tenant — the quota the admission plane enforces
+            # is occupancy the device actually served, not a guess
+            try:
+                self._tenancy.account_device_time(slot.tenant,
+                                                  max(0.0, elapsed))
+            except Exception:
+                pass        # accounting must never cost the dispatch
 
     def _account_pack(self, lane: str, elapsed: float) -> None:
         """The pack third of the pack|queue|device latency split: host
@@ -1901,6 +2007,12 @@ class VerifyService:
                 "migrations": self._migrations,
                 "sharded_dispatches": self._sharded_dispatches,
                 "concurrent_streams_max": self._concurrent_max,
+                # multi-tenant serving (ISSUE 15): chain→tenant labels +
+                # policy-driven placement moves
+                "tenant_map": {s.label: s.tenant
+                               for s in self._slots.values()
+                               if s.tenant is not None},
+                "tenant_rebalances": self._tenant_rebalances,
             }
 
     def set_background_paused(self, paused: bool) -> None:

@@ -109,6 +109,14 @@ class BoundedHTTPServer(HTTPServer):
         super().__init__(addr, handler_cls)
         self.admission = admission
         self.pool = _RestWorkerPool(workers, backlog)
+        # the pre-parse admission ticket of the request THIS worker
+        # thread is serving: the tenant gate attributes it once the
+        # route resolves the chain, so weighted fair queuing sees REST
+        # holdings too (one request per worker at a time by design)
+        self._serving = threading.local()
+
+    def current_ticket(self):
+        return getattr(self._serving, "ticket", None)
 
     def process_request(self, request, client_address):
         ticket = None
@@ -133,11 +141,13 @@ class BoundedHTTPServer(HTTPServer):
         self.shutdown_request(request)
 
     def _work(self, request, client_address, ticket) -> None:
+        self._serving.ticket = ticket
         try:
             self.finish_request(request, client_address)
         except Exception:
             self.handle_error(request, client_address)
         finally:
+            self._serving.ticket = None
             self.shutdown_request(request)
             if ticket is not None:
                 ticket.release()
@@ -339,6 +349,21 @@ class RestServer:
             return 200, info.to_json(), {}
         if len(parts) == 2 and parts[0] == "public":
             api_call_counter.labels("public").inc()
+            # multi-tenant quota gate (core/tenancy.py): the pre-parse
+            # shed can't see the chain-hash path segment, so the
+            # per-tenant rules (pause / rate bucket / over-quota early
+            # rung) run here, once the chain — hence the tenant — is
+            # known but before any store or device work.  Rejections are
+            # well-formed 429s carrying the tenant label, never silent.
+            shed = self._tenant_gate(bp)
+            if shed is not None:
+                import math
+                body = json.dumps(
+                    {"error": "tenant quota exceeded",
+                     "tenant": shed.tenant, "reason": shed.reason},
+                    separators=(",", ":")).encode()
+                return 429, body, {
+                    "Retry-After": str(max(1, math.ceil(shed.retry_after)))}
             round_ = 0 if parts[1] == "latest" else int(parts[1])
             beacon = self._bh(bp).get(round_, info)
             if beacon is None:
@@ -353,6 +378,27 @@ class RestServer:
                 return 304, b"", headers
             return 200, _beacon_json(beacon), headers
         return 404, b'{"error":"no such route"}', {}
+
+    def _tenant_gate(self, bp):
+        """Per-tenant read gate: resolve the chain's tenant and consult
+        the admission controller's tenant rules.  None (no registry, no
+        controller, or an admitted read) means serve."""
+        tenancy = getattr(self.daemon, "tenancy", None)
+        if tenancy is None or self.admission is None \
+                or not hasattr(self.admission, "check_tenant_read"):
+            return None
+        try:
+            tenant = tenancy.tenant_for_chain(bp.beacon_id)
+            # attribute the pre-parse ticket to the tenant FIRST, so the
+            # share check below (and concurrent admissions) count this
+            # request's token against the tenant's weighted share
+            ticket = self.httpd.current_ticket()
+            if ticket is not None \
+                    and hasattr(self.admission, "attribute"):
+                self.admission.attribute(ticket, tenant)
+            return self.admission.check_tenant_read(tenant)
+        except Exception:
+            return None     # the gate must never cost a healthy read
 
     def _health(self):
         """200 when the default chain's head is current (server.go health)."""
@@ -428,6 +474,19 @@ class RestServer:
                 "wait_p99": snap["wait_p99"],
                 "shed": sum(snap["shed"].values()),
             }
+        # multi-tenant serving (core/tenancy.py): per-tenant config +
+        # live quota level + admission/device counters, so a noisy
+        # neighbor (and the quota squeezing it) is visible without a
+        # metrics scrape.  Only present when tenants are registered —
+        # single-operator daemons keep their /health shape.
+        tenancy = getattr(self.daemon, "tenancy", None)
+        if tenancy is not None:
+            try:
+                tsnap = tenancy.snapshot()
+                if tsnap.get("tenants") or tsnap.get("load_error"):
+                    payload["tenants"] = tsnap
+            except Exception:
+                pass
         if svc is not None:
             payload["verify"] = svc.summary()
             # occupancy observability (ISSUE 10): deepest in-flight

@@ -267,6 +267,25 @@ handel_active_sessions = Gauge(
     "handel_active_sessions", "Live per-round Handel sessions",
     ["beacon_id"], registry=GROUP)
 
+# Multi-tenant serving (core/tenancy.py, ISSUE 15): per-tenant admission
+# decisions, measured device occupancy, and the quota level the
+# enforcement planes act on (>= 1 means the tenant is over its
+# device-time budget and sheds one degradation-ladder rung early).
+tenant_requests = Counter(
+    "tenant_requests_total",
+    "Admission decisions attributed to a tenant",
+    ["tenant", "decision"], registry=PRIVATE)
+tenant_device_seconds = Counter(
+    "tenant_device_seconds_total",
+    "Verify-service device seconds attributed to a tenant (measured off "
+    "the pack|queue|device latency split)",
+    ["tenant"], registry=PRIVATE)
+tenant_quota_level = Gauge(
+    "tenant_quota_level",
+    "Device-time quota level per tenant (used/allowed over the rolling "
+    "window; >= 1 is over quota)",
+    ["tenant"], registry=PRIVATE)
+
 
 def scrape(which: str = "group") -> bytes:
     reg = {"private": PRIVATE, "http": HTTP, "group": GROUP,

@@ -86,20 +86,30 @@ DEFAULT_RETRY_AFTER = float(
 REASON_LEVEL = "level"          # the degradation ladder said no
 REASON_CAPACITY = "capacity"    # no token free (and the class won't wait)
 REASON_PEER_CAP = "peer-cap"    # per-peer fair-share stream cap
+# multi-tenant sub-budgets (core/tenancy.py, ISSUE 15): every tenant shed
+# names the tenant so an over-quota rejection is attributable end to end
+REASON_TENANT_PAUSED = "tenant-paused"   # weight 0 / admin pause
+REASON_TENANT_RATE = "tenant-rate"       # per-tenant token bucket empty
+REASON_TENANT_LEVEL = "tenant-level"     # over-quota: shed one rung early
+REASON_TENANT_SHARE = "tenant-share"     # weighted fair share exceeded
 
 
 class Shed(Exception):
-    """A well-formed rejection: carries the class, the reason, and how
-    long the caller should back off.  The transports translate this into
-    HTTP 429 + `Retry-After` or gRPC `RESOURCE_EXHAUSTED` + a
-    `retry-after` trailer."""
+    """A well-formed rejection: carries the class, the reason, how long
+    the caller should back off, and (for tenant-attributed sheds) the
+    tenant label.  The transports translate this into HTTP 429 +
+    `Retry-After` or gRPC `RESOURCE_EXHAUSTED` + a `retry-after` trailer
+    (+ a `tenant` trailer / JSON field when the shed was tenant-scoped)."""
 
-    def __init__(self, cls: str, reason: str, retry_after: float):
+    def __init__(self, cls: str, reason: str, retry_after: float,
+                 tenant: Optional[str] = None):
         self.cls = cls
         self.reason = reason
         self.retry_after = max(0.0, retry_after)
+        self.tenant = tenant
+        label = f" [tenant={tenant}]" if tenant else ""
         super().__init__(
-            f"{cls} request shed ({reason}); retry after "
+            f"{cls} request shed ({reason}){label}; retry after "
             f"{self.retry_after:g}s")
 
 
@@ -108,15 +118,17 @@ class Ticket:
     explicit `release()`); normal-class streams additionally call
     `pace(n)` per streamed chunk for the fair-share token bucket."""
 
-    __slots__ = ("controller", "cls", "peer", "stream", "_released",
-                 "_sent", "_next_ok")
+    __slots__ = ("controller", "cls", "peer", "stream", "tenant",
+                 "_released", "_sent", "_next_ok")
 
     def __init__(self, controller: "AdmissionController", cls: str,
-                 peer: Optional[str], stream: bool):
+                 peer: Optional[str], stream: bool,
+                 tenant: Optional[str] = None):
         self.controller = controller
         self.cls = cls
         self.peer = peer
         self.stream = stream
+        self.tenant = tenant
         self._released = False
         self._sent = 0
         self._next_ok = 0.0
@@ -159,7 +171,8 @@ class AdmissionController:
                  dwell: float = 0.0, normal_wait: float = 0.0,
                  pace_rate: float = 0.0, pace_burst: int = 0,
                  retry_after: float = 0.0,
-                 background_hook: Optional[Callable[[bool], None]] = None):
+                 background_hook: Optional[Callable[[bool], None]] = None,
+                 tenancy=None):
         if clock is None:
             # deferred import: net must not hard-depend on beacon at
             # module scope (same softening as net/resilience.py)
@@ -179,6 +192,10 @@ class AdmissionController:
         self.pace_burst = pace_burst or DEFAULT_PACE_BURST
         self.retry_after_s = retry_after or DEFAULT_RETRY_AFTER
         self.background_hook = background_hook
+        # core/tenancy.py TenantRegistry (duck-typed: admission_view /
+        # weights / note_decision / resolve_metadata) — None keeps every
+        # pre-tenancy call site byte-identical in behavior
+        self.tenancy = tenancy
         self._cond = threading.Condition()
         self._inflight: Dict[str, int] = {c: 0 for c in CLASSES}
         self._peer_streams: Dict[str, int] = {}
@@ -193,68 +210,209 @@ class AdmissionController:
         self._shed: Dict[Tuple[str, str], int] = {}
         self._shed_log: List[Tuple[float, str, str]] = []
         self._paced_waits = 0
+        # per-tenant sub-budget state: NONCRITICAL tokens each tenant
+        # currently holds (the WFQ share check) and the per-tenant rate
+        # buckets ([tokens, last-refill stamp], injected clock)
+        self._tenant_inflight: Dict[str, int] = {}
+        self._tenant_buckets: Dict[str, List[float]] = {}
 
     # -- admission ------------------------------------------------------------
 
     def admit(self, cls: str, peer: Optional[str] = None,
-              stream: bool = False) -> Ticket:
+              stream: bool = False, tenant: Optional[str] = None) -> Ticket:
         """Admit or raise `Shed`.  Critical never sheds (the reserve
         guarantees it a token; even a reserve misconfigured to zero only
         costs accounting, never the partial).  Normal waits up to
-        `normal_wait` for a token; sheddable never waits."""
+        `normal_wait` for a token; sheddable never waits.
+
+        `tenant` (with a registry installed) applies the per-tenant
+        sub-budgets inside the class: the paused gate, the rate bucket,
+        the over-quota one-rung-early level bump, and the weighted fair
+        share on token contention.  `tenant=None` (or no registry) is
+        byte-identical to the pre-tenancy behavior."""
         if cls not in self._inflight:
             raise ValueError(f"unknown admission class {cls!r}")
         from ..metrics import (admission_inflight, admission_requests,
                                admission_wait_seconds)
+        # resolve the tenant OUTSIDE self._cond (registry holds its own
+        # lock; keep the order controller-after-registry impossible).
+        # `has_tenants` is a lock-free bool: an empty registry (the
+        # single-operator common case) costs zero registry round trips
+        # per request
+        view = weights = None
+        if self.tenancy is not None and tenant is not None \
+                and getattr(self.tenancy, "has_tenants", lambda: True)():
+            view = self.tenancy.admission_view(tenant)
+            weights = self.tenancy.weights()
         now0 = self.clock.monotonic()
         hook = None
         try:
             with self._cond:
                 hook = self._reassess_locked(now0)
-                self._check_level_locked(cls, now0)
+                self._check_tenant_locked(cls, view, now0)
+                self._check_level_locked(cls, now0, view=view)
                 if cls == CLASS_NORMAL and stream and peer is not None \
                         and self._peer_streams.get(peer, 0) \
                         >= self.max_streams_per_peer:
                     self._note_shed_locked(cls, REASON_PEER_CAP, now0)
-                    raise Shed(cls, REASON_PEER_CAP, self.retry_after_s)
-                waited = self._acquire_locked(cls, now0)
+                    raise Shed(cls, REASON_PEER_CAP, self.retry_after_s,
+                               tenant=view.name if view else None)
+                waited = self._acquire_locked(cls, now0, view=view,
+                                              weights=weights)
                 self._waits.append((self.clock.monotonic(), cls, waited))
                 self._inflight[cls] += 1
                 self._admitted[cls] += 1
+                if view is not None and cls != CLASS_CRITICAL:
+                    self._tenant_inflight[view.name] = \
+                        self._tenant_inflight.get(view.name, 0) + 1
                 if cls == CLASS_NORMAL and stream:
                     self._normal_streams += 1
                     if peer is not None:
                         self._peer_streams[peer] = \
                             self._peer_streams.get(peer, 0) + 1
                 hook = self._reassess_locked(self.clock.monotonic()) or hook
+        except Shed:
+            if view is not None:
+                self._note_tenant(view.name, False)
+            raise
         finally:
             self._run_hook(hook)
+        if view is not None:
+            self._note_tenant(view.name, True)
         admission_requests.labels(cls, "admitted").inc()
         admission_wait_seconds.labels(cls).observe(max(0.0, waited))
         admission_inflight.labels(cls).set(self._inflight[cls])
-        t = Ticket(self, cls, peer, stream)
+        t = Ticket(self, cls, peer, stream,
+                   tenant=view.name if view is not None else None)
         t._next_ok = self.clock.monotonic()
         return t
 
     def try_admit(self, cls: str, peer: Optional[str] = None,
-                  stream: bool = False) -> Tuple[Optional[Ticket],
-                                                 Optional[Shed]]:
+                  stream: bool = False,
+                  tenant: Optional[str] = None) -> Tuple[Optional[Ticket],
+                                                         Optional[Shed]]:
         """Non-raising admit for transports that translate the rejection
         themselves (the REST edge's pre-parse shed path)."""
         try:
-            return self.admit(cls, peer=peer, stream=stream), None
+            return self.admit(cls, peer=peer, stream=stream,
+                              tenant=tenant), None
         except Shed as s:
             return None, s
 
-    def _check_level_locked(self, cls: str, now: float) -> None:
-        if cls == CLASS_SHEDDABLE and self._level >= LEVEL_SHED_PUBLIC:
-            self._note_shed_locked(cls, REASON_LEVEL, now)
-            raise Shed(cls, REASON_LEVEL, self._retry_after_locked(now))
-        if cls == CLASS_NORMAL and self._level >= LEVEL_SHED_NORMAL:
-            self._note_shed_locked(cls, REASON_LEVEL, now)
-            raise Shed(cls, REASON_LEVEL, self._retry_after_locked(now))
+    def attribute(self, ticket: Ticket, tenant: Optional[str]) -> None:
+        """Late tenant attribution for tickets admitted BEFORE the
+        tenant was knowable — the REST edge admits pre-parse (the cheap
+        429 path cannot see the chain-hash segment), so its tokens used
+        to be invisible to weighted fair queuing: a REST flood held the
+        pool under tenant=None and the share check never engaged.  Once
+        the route resolves the chain, the edge attributes the held
+        ticket here; `release` already decrements the ledger.  No-op for
+        critical, already-attributed, or released tickets, and on
+        daemons with no tenants registered."""
+        if tenant is None or self.tenancy is None \
+                or not getattr(self.tenancy, "has_tenants",
+                               lambda: True)():
+            return
+        with self._cond:
+            if ticket._released or ticket.tenant is not None \
+                    or ticket.cls == CLASS_CRITICAL:
+                return
+            ticket.tenant = tenant
+            self._tenant_inflight[tenant] = \
+                self._tenant_inflight.get(tenant, 0) + 1
 
-    def _acquire_locked(self, cls: str, now0: float) -> float:
+    def _note_tenant(self, tenant: str, admitted: bool) -> None:
+        """Forward the decision to the registry's per-tenant counters +
+        tenant_requests_total (outside self._cond)."""
+        try:
+            self.tenancy.note_decision(tenant, admitted)
+        except Exception:
+            pass        # accounting must never cost the request
+
+    # -- per-tenant sub-budgets (core/tenancy.py, ISSUE 15) -------------------
+
+    def _check_tenant_locked(self, cls: str, view, now: float) -> None:
+        """The tenant gates that run BEFORE any token work: admin pause
+        (weight 0) sheds everything non-critical without touching a
+        token, and the per-tenant rate bucket bounds sheddable reads.
+        Critical is exempt by construction — a tenant's quota can slow
+        its readers, never its chain's liveness.  Caller holds the
+        lock."""
+        if view is None or cls == CLASS_CRITICAL:
+            return
+        if view.paused:
+            self._note_shed_locked(cls, REASON_TENANT_PAUSED, now)
+            raise Shed(cls, REASON_TENANT_PAUSED, self.retry_after_s,
+                       tenant=view.name)
+        if cls == CLASS_SHEDDABLE and view.rate > 0 \
+                and not self._tenant_bucket_ok_locked(view, now):
+            self._note_shed_locked(cls, REASON_TENANT_RATE, now)
+            raise Shed(cls, REASON_TENANT_RATE, self.retry_after_s,
+                       tenant=view.name)
+
+    def _tenant_bucket_ok_locked(self, view, now: float) -> bool:
+        """Per-tenant token bucket (rate/burst from the registry entry);
+        refilled on the injected clock.  Caller holds the lock."""
+        cap = float(view.burst) if view.burst else max(1.0, view.rate)
+        b = self._tenant_buckets.get(view.name)
+        if b is None:
+            b = self._tenant_buckets[view.name] = [cap, now]
+        tokens = min(cap, b[0] + max(0.0, now - b[1]) * view.rate)
+        if tokens >= 1.0:
+            b[0], b[1] = tokens - 1.0, now
+            return True
+        b[0], b[1] = tokens, now
+        return False
+
+    def _tenant_over_share_locked(self, view, weights) -> bool:
+        """Weighted fair queuing inside the class: under token
+        contention a REGISTERED tenant already holding at least its
+        weight-proportional share of the noncritical pool is shed
+        instead of waiting (or camping), so compliant tenants' requests
+        find the tokens the hog would otherwise absorb.  Every tenant
+        keeps a floor of one token.  The implicit default tenant (every
+        request on a daemon with no registry entry for its chain) is
+        exempt — its "share" would be the whole pool, and shedding it at
+        capacity would replace the pre-tenancy wait behavior (and the
+        timed-out-wait ladder signal) on single-operator daemons.
+        Caller holds the lock."""
+        if view is None or not view.known:
+            return False
+        held = self._tenant_inflight.get(view.name, 0)
+        if held == 0:
+            return False        # the one-token floor
+        limit = self.capacity - self.critical_reserve
+        weights = weights or {}
+        active = set(self._tenant_inflight) | {view.name}
+        total = sum(weights.get(t, 1.0) for t in active) or 1.0
+        mine = weights.get(view.name, view.weight or 1.0)
+        share = max(1, int(limit * mine / total))
+        return held >= share
+
+    def _check_level_locked(self, cls: str, now: float,
+                            view=None) -> None:
+        """The degradation-ladder gate.  An over-quota tenant (device
+        budget spent, core/tenancy.py quota level >= 1) is judged one
+        rung HIGHER than the ladder's actual level — over-quota tenants
+        shed strictly before compliant ones on every rung."""
+        bump = 1 if view is not None and view.over_quota else 0
+        level = self._level + bump
+        tenant = view.name if view is not None else None
+        if cls == CLASS_SHEDDABLE and level >= LEVEL_SHED_PUBLIC:
+            reason = REASON_LEVEL if self._level >= LEVEL_SHED_PUBLIC \
+                else REASON_TENANT_LEVEL
+            self._note_shed_locked(cls, reason, now)
+            raise Shed(cls, reason, self._retry_after_locked(now),
+                       tenant=tenant)
+        if cls == CLASS_NORMAL and level >= LEVEL_SHED_NORMAL:
+            reason = REASON_LEVEL if self._level >= LEVEL_SHED_NORMAL \
+                else REASON_TENANT_LEVEL
+            self._note_shed_locked(cls, reason, now)
+            raise Shed(cls, reason, self._retry_after_locked(now),
+                       tenant=tenant)
+
+    def _acquire_locked(self, cls: str, now0: float, view=None,
+                        weights=None) -> float:
         """Take a token; returns the measured wait (injected-clock
         seconds).  Caller holds the lock."""
         from time import perf_counter
@@ -269,11 +427,19 @@ class AdmissionController:
                 return self.clock.monotonic() - now0
             now = self.clock.monotonic()
             waited = now - now0
+            if self._tenant_over_share_locked(view, weights):
+                # WFQ: the pool is contended and this tenant already
+                # holds its weighted share — shed instead of competing
+                # for the tokens compliant tenants are waiting on
+                self._note_shed_locked(cls, REASON_TENANT_SHARE, now)
+                raise Shed(cls, REASON_TENANT_SHARE, self.retry_after_s,
+                           tenant=view.name)
             if cls == CLASS_SHEDDABLE:
                 # shed immediately and cheaply — public reads retry at
                 # the edge, they never queue inside the daemon
                 self._note_shed_locked(cls, REASON_CAPACITY, now)
-                raise Shed(cls, REASON_CAPACITY, self.retry_after_s)
+                raise Shed(cls, REASON_CAPACITY, self.retry_after_s,
+                           tenant=view.name if view else None)
             if waited >= self.normal_wait \
                     or perf_counter() - real0 >= self.WAIT_REAL_CAP:
                 # the timed-out wait IS the overload signal: record it so
@@ -281,8 +447,9 @@ class AdmissionController:
                 # tpu-vet: disable=lock  (caller holds self._cond, docstring)
                 self._waits.append((now, cls, max(waited, self.normal_wait)))
                 self._note_shed_locked(cls, REASON_CAPACITY, now)
-                raise Shed(cls, REASON_CAPACITY, self.retry_after_s)
-            self._check_level_locked(cls, now)
+                raise Shed(cls, REASON_CAPACITY, self.retry_after_s,
+                           tenant=view.name if view else None)
+            self._check_level_locked(cls, now, view=view)
             # cv-slice bounded in real time; released tokens notify
             self._cond.wait(0.05)
 
@@ -295,6 +462,12 @@ class AdmissionController:
             ticket._released = True
             self._inflight[ticket.cls] = max(
                 0, self._inflight[ticket.cls] - 1)
+            if ticket.tenant is not None and ticket.cls != CLASS_CRITICAL:
+                left = self._tenant_inflight.get(ticket.tenant, 1) - 1
+                if left <= 0:
+                    self._tenant_inflight.pop(ticket.tenant, None)
+                else:
+                    self._tenant_inflight[ticket.tenant] = left
             if ticket.cls == CLASS_NORMAL and ticket.stream:
                 self._normal_streams = max(0, self._normal_streams - 1)
                 if ticket.peer is not None:
@@ -418,6 +591,44 @@ class AdmissionController:
         it is never dropped)."""
         return self.level() >= LEVEL_PAUSE_BACKGROUND
 
+    def check_tenant_read(self, tenant: Optional[str]) -> Optional[Shed]:
+        """Post-parse tenant gate for the REST edge: the pre-parse shed
+        path cannot see the chain-hash path segment, so the tenant rules
+        (pause, rate bucket, over-quota early rung) run here once the
+        chain — and therefore the tenant — is known.  No concurrency
+        token changes hands (the caller already holds its pre-parse
+        ticket); returns the Shed instead of raising so the edge can
+        serialize it into a labelled 429."""
+        if self.tenancy is None or tenant is None \
+                or not getattr(self.tenancy, "has_tenants",
+                               lambda: True)():
+            return None
+        view = self.tenancy.admission_view(tenant)
+        weights = self.tenancy.weights()
+        now = self.clock.monotonic()
+        shed = None
+        with self._cond:
+            try:
+                self._check_tenant_locked(CLASS_SHEDDABLE, view, now)
+                self._check_level_locked(CLASS_SHEDDABLE, now, view=view)
+                # WFQ for the REST plane: with the noncritical pool
+                # contended, a tenant already holding its weighted share
+                # (REST tickets count — the edge attributes them before
+                # this gate) sheds here like a gRPC admit would
+                limit = self.capacity - self.critical_reserve
+                noncrit = (self._inflight[CLASS_NORMAL]
+                           + self._inflight[CLASS_SHEDDABLE])
+                if noncrit >= limit \
+                        and self._tenant_over_share_locked(view, weights):
+                    self._note_shed_locked(CLASS_SHEDDABLE,
+                                           REASON_TENANT_SHARE, now)
+                    raise Shed(CLASS_SHEDDABLE, REASON_TENANT_SHARE,
+                               self.retry_after_s, tenant=view.name)
+            except Shed as s:
+                shed = s
+        self._note_tenant(view.name, shed is None)
+        return shed
+
     def wait_p99(self, cls: Optional[str] = None) -> float:
         with self._cond:
             return self._p99_locked(self.clock.monotonic(), cls)
@@ -433,6 +644,7 @@ class AdmissionController:
                 "shed": {f"{c}/{r}": v
                          for (c, r), v in sorted(self._shed.items())},
                 "peer_streams": dict(self._peer_streams),
+                "tenant_inflight": dict(self._tenant_inflight),
                 "paced_waits": self._paced_waits,
                 "wait_p99": {c: round(self._p99_locked(
                     self.clock.monotonic(), c), 4) for c in CLASSES},
@@ -485,8 +697,13 @@ def classify_method(method: str) -> Optional[str]:
 
 def _shed_abort(context, shed: Shed):
     import grpc
-    context.set_trailing_metadata((
-        ("retry-after", f"{shed.retry_after:g}"),))
+    trailers = [("retry-after", f"{shed.retry_after:g}")]
+    if shed.tenant:
+        # over-quota rejections carry the tenant label end to end: a
+        # multi-tenant client (or its operator) must be able to tell
+        # "your quota" from "the daemon is overloaded"
+        trailers.append(("tenant", shed.tenant))
+    context.set_trailing_metadata(tuple(trailers))
     context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(shed))
 
 
@@ -516,13 +733,26 @@ class AdmissionInterceptor:
         import grpc
         ctrl = self.controller
 
+        def tenant_of(request) -> Optional[str]:
+            # the tenant is named by the chain the request addresses —
+            # beaconID (or chain hash) in the standard drand metadata;
+            # resolution is one dict lookup in the registry
+            tenancy = ctrl.tenancy
+            if tenancy is None:
+                return None
+            try:
+                return tenancy.resolve_metadata(
+                    getattr(request, "metadata", None))
+            except Exception:
+                return None
+
         if handler.unary_unary is not None:
             inner = handler.unary_unary
 
             def unary(request, context):
                 try:
                     ticket = ctrl.admit(cls, peer=peer_identity(
-                        context.peer()))
+                        context.peer()), tenant=tenant_of(request))
                 except Shed as s:
                     _shed_abort(context, s)
                 with ticket:
@@ -538,7 +768,8 @@ class AdmissionInterceptor:
             def stream(request, context):
                 try:
                     ticket = ctrl.admit(cls, peer=peer_identity(
-                        context.peer()), stream=True)
+                        context.peer()), stream=True,
+                        tenant=tenant_of(request))
                 except Shed as s:
                     _shed_abort(context, s)
 

@@ -58,4 +58,10 @@ CONTROL = ServiceSpec("drand.Control", [
            server_stream=True),
     Method("BackupDatabase", pb.BackupDBRequest, pb.BackupDBResponse),
     Method("RemoteStatus", pb.RemoteStatusRequest, pb.RemoteStatusResponse),
+    # Multi-tenant serving (core/tenancy.py, ISSUE 15): tenant
+    # add/update/remove without a daemon restart.  Control plane only —
+    # tenancy is operator configuration, never a peer-reachable surface.
+    Method("TenantSet", pb.TenantConfigPacket, pb.TenantListResponse),
+    Method("TenantRemove", pb.TenantRequest, pb.TenantListResponse),
+    Method("TenantList", pb.TenantRequest, pb.TenantListResponse),
 ])

@@ -48,6 +48,8 @@ import os
 import random
 import threading
 import types
+
+import numpy as np
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -2158,3 +2160,226 @@ class HandelByzantineScenario:
             full_weights=[len(sessions[i].verified)
                           for i in sorted(sessions)],
             digest=digest)
+
+
+# ---------------------------------------------------------------------------
+# Multi-tenant noisy neighbor (core/tenancy.py, ISSUE 15): an aggressor
+# tenant floods sheddable reads and saturates its device-time quota on an
+# expensive chain while a victim tenant's rounds must keep flowing.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NoisyNeighborResult:
+    victim_rounds: int
+    victim_rounds_baseline: int       # same seed, aggressor absent
+    victim_reads_served: int
+    victim_partials_p99: float
+    period: float
+    aggro_reads_served: int
+    aggro_reads_shed: int
+    aggro_quota_peak: float           # max quota level the aggressor hit
+    aggro_quota_sheds: int            # tenant-labelled over-quota sheds
+    sheds_well_formed: bool           # every shed: reason + retry + tenant
+    silent_drops: int                 # sheds that carried NO tenant label
+    placement: Dict[str, int] = field(default_factory=dict)
+    device_seconds: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def throughput_ratio(self) -> float:
+        return (self.victim_rounds
+                / max(1, self.victim_rounds_baseline))
+
+    @property
+    def ok(self) -> bool:
+        return (self.victim_partials_p99 < self.period
+                and self.throughput_ratio >= 0.8
+                and self.victim_reads_served > 0
+                and self.aggro_reads_shed > 0
+                and self.aggro_quota_peak >= 1.0
+                and self.aggro_quota_sheds > 0
+                and self.sheds_well_formed
+                and self.silent_drops == 0
+                and len(set(self.placement.values())) >= 2)
+
+
+class NoisyNeighborScenario:
+    """Two tenants on ONE daemon stack (registry + admission controller +
+    verify service on an injected clock, zero network I/O):
+
+      * ``victim`` — a cheap chain on a modest period; every fake second
+        it admits one critical partial, verifies its round batch through
+        the service's LIVE lane, and serves one read.  A round counts
+        only if all three succeed inside the period.
+      * ``aggro``  — an EXPENSIVE chain (each dispatch burns ~40x the
+        victim's device time — the G2-vs-G1 cost asymmetry the flat
+        per-class budget cannot see) plus a seeded flood of sheddable
+        reads, with a small device-time budget and a read-rate bucket.
+
+    The scenario runs the identical seeded timeline twice — with and
+    without the aggressor — and compares the victim's per-round
+    throughput (acceptance: within 20%) and critical-partials admission
+    p99 (under the period).  Enforcement must be visible on the aggressor
+    (rate/quota sheds with the tenant label, quota level >= 1) and
+    invisible to the victim; every rejection must be well-formed (the
+    Shed the transports map to 429 / RESOURCE_EXHAUSTED), never a silent
+    drop; and placement must keep the two tenants' chains on different
+    device groups."""
+
+    def __init__(self, seed: int, seconds: int = 45, period: float = 10.0,
+                 flood_rate: int = 20):
+        self.seed = seed
+        self.seconds = seconds
+        self.period = period
+        self.flood_rate = flood_rate
+
+    # backend device costs (fake seconds per dispatch)
+    VICTIM_COST = 0.005
+    AGGRO_COST = 0.2
+
+    def _run_timeline(self, with_aggressor: bool):
+        import types as _types
+
+        from drand_tpu.core.tenancy import TenantConfig, TenantRegistry
+        from drand_tpu.crypto.device_pool import DevicePool
+        from drand_tpu.crypto.verify_service import (LANE_BACKGROUND,
+                                                     LANE_LIVE,
+                                                     VerifyService)
+        from drand_tpu.net.admission import (AdmissionController,
+                                             CLASS_CRITICAL,
+                                             CLASS_SHEDDABLE, Shed)
+
+        clock = AutoClock(start=2_000.0)
+        rng = random.Random(stable_seed(self.seed, "noisy-neighbor",
+                                        with_aggressor))
+        registry = TenantRegistry(clock=clock, device_window=10.0)
+        registry.set_tenant(TenantConfig(
+            name="victim", weight=2.0, device_budget=1.0,
+            chains=("victim-chain",), anti_affinity=True))
+        registry.set_tenant(TenantConfig(
+            name="aggro", weight=1.0, rate=4.0, burst=8,
+            device_budget=0.05, chains=("aggro-chain",)))
+        vpk, apk = b"\x01" * 48, b"\x02" * 48
+        registry.register_chain("victim-chain", pk=vpk)
+        registry.register_chain("aggro-chain", pk=apk)
+
+        class _Dev:
+            pass
+
+        pool = DevicePool(devices=[_Dev() for _ in range(2)])
+        ctrl = AdmissionController(
+            clock=clock, capacity=16, critical_reserve=4,
+            shed_wait=0.5, recover_wait=0.05, dwell=4.0, tenancy=registry)
+        svc = VerifyService(clock=clock, pad=8, background_window=0.0,
+                            pool=pool)
+        svc.set_tenancy(registry)
+
+        def backend(cost):
+            class _B:
+                kind = "stub"
+
+                def verify_batch(self, rounds, sigs, prev_sigs=None):
+                    clock.jump(cost)        # the measured device interval
+                    return np.ones(len(rounds), dtype=bool)
+            return _B()
+
+        scheme = _types.SimpleNamespace(id="noisy-stub")
+        state = {"v_rounds": 0, "v_reads": 0, "a_served": 0, "a_shed": 0,
+                 "a_quota_sheds": 0, "malformed": 0, "silent": 0,
+                 "quota_peak": 0.0}
+        holds: List[tuple] = []
+
+        def well_formed(s: Shed, expect_tenant: Optional[str]) -> bool:
+            if s.retry_after <= 0 or not s.reason:
+                return False
+            if expect_tenant is not None and s.tenant != expect_tenant:
+                return False
+            return True
+
+        try:
+            h_victim = svc.handle(scheme, vpk, backend=backend(
+                self.VICTIM_COST))
+            h_aggro = svc.handle(scheme, apk, backend=backend(
+                self.AGGRO_COST))
+            placement = {"victim": h_victim.gid, "aggro": h_aggro.gid}
+            for sec in range(self.seconds):
+                now = clock.monotonic()
+                holds[:] = [(at, t) for at, t in holds
+                            if at > now or (t.release() and False)]
+                if with_aggressor:
+                    # the flood: seeded burst of sheddable reads, some
+                    # held for a few fake seconds to pressure the pool
+                    for i in range(rng.randrange(self.flood_rate // 2,
+                                                 self.flood_rate * 2)):
+                        ticket, s = ctrl.try_admit(CLASS_SHEDDABLE,
+                                                   peer=f"edge{i % 4}",
+                                                   tenant="aggro")
+                        if ticket is not None:
+                            state["a_served"] += 1
+                            holds.append((now + rng.uniform(1.0, 3.0),
+                                          ticket))
+                        else:
+                            state["a_shed"] += 1
+                            if s.tenant is None:
+                                state["silent"] += 1
+                            if not well_formed(s, None):
+                                state["malformed"] += 1
+                            if s.reason in ("tenant-level",
+                                            "tenant-rate",
+                                            "tenant-share"):
+                                state["a_quota_sheds"] += 1
+                    # the expensive chain: one background batch per
+                    # second, burning ~4x the aggressor's device budget
+                    h_aggro.verify_batch(list(range(sec * 8, sec * 8 + 8)),
+                                         [b"a"] * 8,
+                                         lane=LANE_BACKGROUND)
+                    state["quota_peak"] = max(state["quota_peak"],
+                                              registry.quota_level("aggro"))
+                # the victim's round: critical partial + live verify +
+                # one served read, all inside the period
+                t0 = clock.monotonic()
+                pt = ctrl.admit(CLASS_CRITICAL, peer="signer",
+                                tenant="victim")
+                pt.release()
+                verdict = h_victim.verify_batch(
+                    list(range(sec * 4, sec * 4 + 4)), [b"v"] * 4,
+                    lane=LANE_LIVE)
+                read, s = ctrl.try_admit(CLASS_SHEDDABLE, peer="vclient",
+                                         tenant="victim")
+                if read is not None:
+                    state["v_reads"] += 1
+                    read.release()
+                elif s is not None and not well_formed(s, None):
+                    state["malformed"] += 1
+                if verdict.all() and read is not None \
+                        and clock.monotonic() - t0 <= self.period:
+                    state["v_rounds"] += 1
+                clock.jump(1.0)
+            partials_p99 = ctrl.wait_p99(CLASS_CRITICAL)
+            device = {t: round(registry.device_seconds_total(t), 3)
+                      for t in ("victim", "aggro")}
+        finally:
+            for _, t in holds:
+                t.release()
+            svc.stop()
+        return state, partials_p99, placement, device
+
+    def run(self) -> NoisyNeighborResult:
+        base, _, _, _ = self._run_timeline(with_aggressor=False)
+        loud, p99, placement, device = self._run_timeline(
+            with_aggressor=True)
+        return NoisyNeighborResult(
+            victim_rounds=loud["v_rounds"],
+            victim_rounds_baseline=base["v_rounds"],
+            victim_reads_served=loud["v_reads"],
+            victim_partials_p99=p99,
+            period=self.period,
+            aggro_reads_served=loud["a_served"],
+            aggro_reads_shed=loud["a_shed"],
+            aggro_quota_peak=loud["quota_peak"],
+            aggro_quota_sheds=loud["a_quota_sheds"],
+            sheds_well_formed=loud["malformed"] == 0
+            and loud["a_shed"] > 0,
+            silent_drops=loud["silent"],
+            placement=placement,
+            device_seconds=device)

@@ -1,0 +1,27 @@
+"""Seeded bounds-checker violation for the tenancy scope (rel path
+`core/tenancy.py` — the registry joined the serving-path scope in ISSUE
+15: it sits on every admission decision, so queues/executors grown there
+are flood-reachable).
+
+One BAD line must be caught; the OK lines must stay silent."""
+
+import queue
+from concurrent.futures import ThreadPoolExecutor
+
+
+def registry_event_fanout():
+    events = queue.Queue()                 # BAD: unbounded on the registry
+    return events
+
+
+def bounded_fanout():
+    events = queue.Queue(maxsize=64)       # OK: bounded
+    pool = ThreadPoolExecutor(max_workers=2)   # OK: bounded
+    return events, pool
+
+
+def audit_log_spool():
+    # justified: drained synchronously under the registry lock before the
+    # next Control-plane edit returns; never request-reachable
+    spool = queue.Queue()   # tpu-vet: disable=bounds
+    return spool

@@ -128,14 +128,55 @@ def _mk_chain(n, start=0):
     return out
 
 
+def _real_pg_store(request):
+    """Cross-backend contract suite against a REAL postgres (ROADMAP
+    item 6 remaining): opt-in via the DRAND_TEST_PG_DSN env var — the
+    CI `storage-pg` job sets it (see COMPONENTS.md "Storage integrity");
+    everywhere else the param skips cleanly.  Each test gets its own
+    beacon_id namespace and tears its rows down, so a shared dev server
+    stays usable."""
+    import os
+    import uuid
+    dsn = os.environ.get("DRAND_TEST_PG_DSN")
+    if not dsn:
+        pytest.skip("DRAND_TEST_PG_DSN not set (real-postgres contract "
+                    "suite is opt-in)")
+    pytest.importorskip("psycopg2",
+                        reason="psycopg2 missing; DRAND_TEST_PG_DSN needs it")
+    from drand_tpu.chain.postgresdb import PostgresStore
+    bid = f"contract-{uuid.uuid4().hex[:12]}"
+    s = PostgresStore(dsn, beacon_id=bid,
+                      require_previous=request.param.endswith("prev"))
+
+    def cleanup():
+        try:
+            with s._write_lock, s.conn, s.conn.cursor() as cur:
+                cur.execute("DELETE FROM beacons WHERE beacon_id=%s",
+                            (s.bid,))
+                cur.execute("DELETE FROM beacons_quarantine "
+                            "WHERE beacon_id=%s", (s.bid,))
+                cur.execute("DELETE FROM beacon_ids WHERE id=%s", (s.bid,))
+        finally:
+            s.close()
+
+    request.addfinalizer(cleanup)
+    return s
+
+
 @pytest.fixture(params=["memdb", "sqlite", "sqlite-prev",
-                        "postgres", "postgres-prev"])
+                        "postgres", "postgres-prev",
+                        "pg-real", "pg-real-prev"])
 def store(request, tmp_path):
     """The reference's storage matrix (Makefile:61-75: the same suite over
     bolt/memdb/postgres).  The postgres store runs its real CRUD/cursor
-    SQL through the embedded DBAPI shim (chain/_pgcompat.py)."""
+    SQL through the embedded DBAPI shim (chain/_pgcompat.py); the
+    pg-real params run the SAME suite against a live server when
+    DRAND_TEST_PG_DSN is set and skip cleanly otherwise."""
     if request.param == "memdb":
         s = MemDBStore(buffer_size=100)
+    elif request.param.startswith("pg-real"):
+        yield _real_pg_store(request)
+        return
     elif request.param.startswith("postgres"):
         from drand_tpu.chain import _pgcompat
         from drand_tpu.chain.postgresdb import PostgresStore

@@ -679,3 +679,86 @@ def test_pubpoly_prime_prefills_memo(monkeypatch):
     monkeypatch.setattr(fresh.group.curve, "mul",
                         lambda *a: pytest.fail("primed eval hit the curve"))
     assert fresh.eval(5) == expect
+
+
+# ---------------------------------------------------------------------------
+# sender-identity binding (ROADMAP 3d): the claimed sender_index must map
+# to the transport-level peer's host, or the packet is rejected at
+# ingress — score demotion cannot be griefed by impersonation
+# ---------------------------------------------------------------------------
+
+
+def test_peer_host_parses_transport_and_node_addresses():
+    assert H.peer_host("ipv4:10.0.0.1:52644") == "10.0.0.1"
+    assert H.peer_host("ipv6:[::1]:52644") == "[::1]"
+    assert H.peer_host("10.0.0.1:8080") == "10.0.0.1"
+    assert H.peer_host("node-a:443") == "node-a"
+    assert H.peer_host("[::1]:8080") == "[::1]"
+    assert H.peer_host("bare-name") == "bare-name"
+
+
+def _bound_coordinator(received):
+    scheme = scheme_from_name("pedersen-bls-chained")
+    addrs = {i: f"10.0.0.{i + 1}:8080" for i in range(8)}
+    c = H.HandelCoordinator(
+        group_n=8, me=0, threshold=5, scheme=scheme,
+        verifier=StubVerifier(), transport=lambda i, p: None,
+        on_complete=lambda r, p, parts: None, clock=FakeClock(0),
+        cfg=H.HandelConfig(min_group=2, window=8, bad_limit=3),
+        score_key=lambda i: addrs[i], beacon_id="bind")
+    c.submit_own(1, None, _partial(0))
+    return c, addrs
+
+
+def test_handel_rejects_impersonated_sender_index():
+    """A packet claiming index 3 but arriving from node 5's host is
+    rejected with ValueError (INVALID_ARGUMENT upstream) and contributes
+    NOTHING — no session state, no demotion attributable to node 3."""
+    c, addrs = _bound_coordinator({})
+    sender, block = 3, H.own_block(8, 3, 2)
+    pkt = H.to_packet(1, None, 2, sender,
+                      H.Aggregate({i: _partial(i) for i in block}), 8, "bind")
+    with pytest.raises(ValueError, match="registered at"):
+        c.receive(pkt, peer="ipv4:10.0.0.6:41234")     # node 5's host
+    # the victim's demotion counter never moved: a later burst of forged
+    # packets cannot push index 3 over bad_limit
+    sess = c._sessions[(1, b"")]
+    assert sess._bad.get(sender, 0) == 0
+    # the same packet from the REGISTERED host is accepted
+    c.receive(pkt, peer="ipv4:10.0.0.4:55555")
+    assert sess._pending, "genuine candidate must enter the session"
+
+
+def test_handel_binding_skipped_without_transport_peer():
+    """In-process delivery (loopback tests, submit_own echoes) passes no
+    peer — the binding check only fires on real gRPC ingress."""
+    c, addrs = _bound_coordinator({})
+    block = H.own_block(8, 3, 2)
+    pkt = H.to_packet(1, None, 2, 3,
+                      H.Aggregate({i: _partial(i) for i in block}), 8, "bind")
+    c.receive(pkt)              # no peer: accepted as before
+    assert c._sessions[(1, b"")]._pending
+
+
+def test_handel_binding_skips_dns_named_rosters():
+    """gRPC's context.peer() is always a numeric IP, so a roster
+    registered under DNS names can never match host-for-host — the
+    binding must SKIP (trust model: DNS rosters bind with mTLS), not
+    reject every honest packet."""
+    scheme = scheme_from_name("pedersen-bls-chained")
+    addrs = {i: f"node-{i}.example.com:443" for i in range(8)}
+    c = H.HandelCoordinator(
+        group_n=8, me=0, threshold=5, scheme=scheme,
+        verifier=StubVerifier(), transport=lambda i, p: None,
+        on_complete=lambda r, p, parts: None, clock=FakeClock(0),
+        cfg=H.HandelConfig(min_group=2, window=8, bad_limit=3),
+        score_key=lambda i: addrs[i], beacon_id="dns")
+    c.submit_own(1, None, _partial(0))
+    block = H.own_block(8, 3, 2)
+    pkt = H.to_packet(1, None, 2, 3,
+                      H.Aggregate({i: _partial(i) for i in block}), 8, "dns")
+    c.receive(pkt, peer="ipv4:10.2.3.4:41234")     # any source host
+    assert c._sessions[(1, b"")]._pending
+    assert not H.sender_binding_enforceable("node-3.example.com:443")
+    assert H.sender_binding_enforceable("10.0.0.4:8080")
+    assert H.sender_binding_enforceable("[::1]:8080")

@@ -1,0 +1,751 @@
+"""Multi-tenant serving (core/tenancy.py, ISSUE 15): the tenant
+registry, the admission sub-budgets, tenant-aware device placement, the
+REST/Control surfaces, and the quota edge cases the issue names (paused
+tenant, mid-flight removal, torn registry file)."""
+
+import json
+import os
+import threading
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from drand_tpu.beacon.clock import FakeClock
+from drand_tpu.core.tenancy import (DEFAULT_TENANT, TenantConfig,
+                                    TenantRegistry, registry_path)
+from drand_tpu.net.admission import (AdmissionController, Shed,
+                                     CLASS_CRITICAL, CLASS_NORMAL,
+                                     CLASS_SHEDDABLE, LEVEL_SHED_PUBLIC,
+                                     REASON_LEVEL, REASON_TENANT_LEVEL,
+                                     REASON_TENANT_PAUSED,
+                                     REASON_TENANT_RATE,
+                                     REASON_TENANT_SHARE)
+
+SCHEME = types.SimpleNamespace(id="stub-scheme")
+
+
+def pk(i: int) -> bytes:
+    return bytes([i]) * 48
+
+
+def mk_registry(tmp_path, clock=None, window=30.0):
+    return TenantRegistry(path=str(tmp_path / "tenants.json"),
+                          clock=clock or FakeClock(1000.0),
+                          device_window=window)
+
+
+def mk_ctrl(reg, clock, **kw):
+    kw.setdefault("capacity", 8)
+    kw.setdefault("critical_reserve", 2)
+    return AdmissionController(clock=clock, tenancy=reg, **kw)
+
+
+# ---------------------------------------------------------------------------
+# registry: CRUD, resolution, persistence, torn-write recovery
+# ---------------------------------------------------------------------------
+
+
+def test_registry_crud_and_resolution(tmp_path):
+    clock = FakeClock(1000.0)
+    reg = mk_registry(tmp_path, clock)
+    reg.set_tenant(TenantConfig(name="acme", weight=2.0,
+                                chains=("default", "c2")))
+    reg.register_chain("default", pk=pk(1), chain_hash="ab" * 32)
+    assert reg.tenant_for_chain("default") == "acme"
+    assert reg.tenant_for_chain("c2") == "acme"
+    assert reg.tenant_for_hash("ab" * 32) == "acme"
+    assert reg.tenant_for_pk(pk(1)) == "acme"
+    # unknown chains belong to the implicit default tenant
+    assert reg.tenant_for_chain("other") == DEFAULT_TENANT
+    assert reg.tenant_for_pk(pk(9)) == DEFAULT_TENANT
+    # update (upsert) replaces; remove falls back to default
+    reg.set_tenant(TenantConfig(name="acme", weight=5.0, chains=("c2",)))
+    assert reg.get("acme").weight == 5.0
+    assert reg.tenant_for_chain("default") == DEFAULT_TENANT
+    assert reg.remove_tenant("acme") and not reg.remove_tenant("acme")
+    assert reg.tenant_for_chain("c2") == DEFAULT_TENANT
+
+
+def test_registry_resolve_grpc_metadata(tmp_path):
+    reg = mk_registry(tmp_path)
+    reg.set_tenant(TenantConfig(name="t", chains=("beta",)))
+    reg.register_chain("beta", chain_hash="cd" * 32)
+    meta = types.SimpleNamespace(beaconID="beta", chain_hash=b"")
+    assert reg.resolve_metadata(meta) == "t"
+    meta = types.SimpleNamespace(beaconID="", chain_hash=bytes.fromhex(
+        "cd" * 32))
+    assert reg.resolve_metadata(meta) == "t"
+    assert reg.resolve_metadata(None) == DEFAULT_TENANT
+
+
+def test_registry_persists_atomically_and_reloads(tmp_path):
+    reg = mk_registry(tmp_path)
+    reg.set_tenant(TenantConfig(name="acme", weight=2.0, rate=10.0,
+                                burst=5, device_budget=0.25,
+                                chains=("default",), pin_group=3,
+                                anti_affinity=True))
+    path = str(tmp_path / "tenants.json")
+    assert os.path.exists(path)
+    # no stray temp files: fs.write_atomic cleans up after itself
+    leftovers = [f for f in os.listdir(tmp_path) if f != "tenants.json"]
+    assert leftovers == []
+    fresh = mk_registry(tmp_path)
+    cfg = fresh.get("acme")
+    assert cfg is not None and cfg.weight == 2.0 and cfg.rate == 10.0
+    assert cfg.burst == 5 and cfg.device_budget == 0.25
+    assert cfg.chains == ("default",) and cfg.pin_group == 3
+    assert cfg.anti_affinity and not cfg.paused
+    assert fresh.tenant_for_chain("default") == "acme"
+
+
+def test_registry_torn_write_recovery(tmp_path):
+    """A torn/corrupt tenants.json (out-of-band writer, disk fault —
+    our own writes ride fs.write_atomic) must not brick the daemon: the
+    bytes are parked at .corrupt, the registry starts empty (unmetered),
+    the load error is visible in the snapshot, and the next save writes
+    a clean file."""
+    path = str(tmp_path / "tenants.json")
+    with open(path, "w") as f:
+        f.write('{"version": 1, "tenants": [{"name": "ac')   # torn
+    reg = mk_registry(tmp_path)
+    assert reg.names() == []
+    assert os.path.exists(path + ".corrupt")
+    assert not os.path.exists(path)
+    assert "load_error" in reg.snapshot()
+    reg.set_tenant(TenantConfig(name="fresh"))
+    assert mk_registry(tmp_path).names() == ["fresh"]
+
+
+def test_registry_change_listeners_fire_outside_lock(tmp_path):
+    reg = mk_registry(tmp_path)
+    seen = []
+    reg.on_change(lambda: seen.append(reg.names()))   # re-enters registry
+    reg.set_tenant(TenantConfig(name="a"))
+    reg.remove_tenant("a")
+    assert seen == [["a"], []]
+
+
+# ---------------------------------------------------------------------------
+# admission sub-budgets
+# ---------------------------------------------------------------------------
+
+
+def test_paused_tenant_sheds_well_formed_without_device_time(tmp_path):
+    """The zero-quota (admin-paused) edge case: everything non-critical
+    sheds with a well-formed, tenant-labelled rejection; critical is
+    exempt; and nothing of the tenant's touches device time."""
+    clock = FakeClock(1000.0)
+    reg = mk_registry(tmp_path, clock)
+    reg.set_tenant(TenantConfig(name="z", weight=0.0, chains=("zc",)))
+    ctrl = mk_ctrl(reg, clock)
+    for cls in (CLASS_SHEDDABLE, CLASS_NORMAL):
+        with pytest.raises(Shed) as ei:
+            ctrl.admit(cls, tenant="z")
+        s = ei.value
+        assert s.reason == REASON_TENANT_PAUSED
+        assert s.tenant == "z" and s.retry_after > 0
+        assert "z" in str(s) and s.cls == cls
+    # critical (the chain's own partials) is never shed on quota grounds
+    ctrl.admit(CLASS_CRITICAL, tenant="z").release()
+    # paused tenant accumulated zero device seconds: placement weighs it
+    # at 0 and its reads never reached a verify handle
+    assert reg.device_seconds("z") == 0.0
+    snap = reg.snapshot()["tenants"]["z"]
+    assert snap["paused"] and snap["shed"] == 2 and snap["admitted"] == 1
+    assert snap["device_seconds_total"] == 0.0
+
+
+def test_tenant_rate_bucket_refills_on_injected_clock(tmp_path):
+    clock = FakeClock(1000.0)
+    reg = mk_registry(tmp_path, clock)
+    reg.set_tenant(TenantConfig(name="r", rate=2.0, burst=2))
+    ctrl = mk_ctrl(reg, clock)
+    ctrl.admit(CLASS_SHEDDABLE, tenant="r").release()
+    ctrl.admit(CLASS_SHEDDABLE, tenant="r").release()
+    with pytest.raises(Shed) as ei:
+        ctrl.admit(CLASS_SHEDDABLE, tenant="r")
+    assert ei.value.reason == REASON_TENANT_RATE
+    assert ei.value.tenant == "r"
+    clock.advance(0.5)          # 2/s x 0.5 s -> one token back
+    ctrl.admit(CLASS_SHEDDABLE, tenant="r").release()
+    with pytest.raises(Shed):
+        ctrl.admit(CLASS_SHEDDABLE, tenant="r")
+    # the bucket is per tenant: another tenant is untouched
+    ctrl.admit(CLASS_SHEDDABLE, tenant="other").release()
+
+
+def test_over_quota_tenant_sheds_one_rung_early(tmp_path):
+    """Device budget spent -> the tenant is judged one ladder rung higher
+    than the actual level: its sheddable reads shed at nominal while a
+    compliant tenant's are served, and the reason distinguishes the
+    tenant bump (tenant-level) from real ladder pressure (level)."""
+    clock = FakeClock(1000.0)
+    reg = mk_registry(tmp_path, clock, window=10.0)
+    reg.set_tenant(TenantConfig(name="pig", device_budget=0.1))   # 1 s/10 s
+    ctrl = mk_ctrl(reg, clock)
+    ctrl.admit(CLASS_SHEDDABLE, tenant="pig").release()   # under quota: ok
+    reg.account_device_time("pig", 5.0)                   # 5x the budget
+    assert reg.quota_level("pig") >= 1.0
+    with pytest.raises(Shed) as ei:
+        ctrl.admit(CLASS_SHEDDABLE, tenant="pig")
+    assert ei.value.reason == REASON_TENANT_LEVEL
+    assert ei.value.tenant == "pig"
+    # compliant tenants still flow at nominal
+    ctrl.admit(CLASS_SHEDDABLE, tenant="nice").release()
+    # at a real ladder level the reason is the plain ladder one
+    with ctrl._cond:
+        ctrl._level = LEVEL_SHED_PUBLIC
+    with pytest.raises(Shed) as ei:
+        ctrl.admit(CLASS_SHEDDABLE, tenant="pig")
+    assert ei.value.reason == REASON_LEVEL
+    # the quota window rolls: the spend ages out and the tenant recovers
+    with ctrl._cond:
+        ctrl._level = 0
+    clock.advance(11.0)
+    assert reg.quota_level("pig") == 0.0
+    ctrl.admit(CLASS_SHEDDABLE, tenant="pig").release()
+
+
+def test_weighted_fair_share_under_contention(tmp_path):
+    """WFQ inside the class: with the noncritical pool full, a tenant
+    already holding its weight-proportional share is shed immediately
+    (tenant-share) instead of camping on the wait, and the token a
+    compliant tenant was waiting for reaches it."""
+    clock = FakeClock(1000.0)
+    reg = mk_registry(tmp_path, clock)
+    reg.set_tenant(TenantConfig(name="hog", weight=1.0))
+    reg.set_tenant(TenantConfig(name="fair", weight=1.0))
+    ctrl = mk_ctrl(reg, clock, capacity=6, critical_reserve=2,
+                   normal_wait=30.0)
+    limit = ctrl.capacity - ctrl.critical_reserve       # 4 tokens
+    held = [ctrl.admit(CLASS_NORMAL, tenant="hog") for _ in range(limit)]
+    # the hog's next request finds the pool full AND itself over-share:
+    # immediate tenant-share shed, no normal_wait camp
+    t0 = clock.monotonic()
+    with pytest.raises(Shed) as ei:
+        ctrl.admit(CLASS_NORMAL, tenant="hog")
+    assert ei.value.reason == REASON_TENANT_SHARE
+    assert ei.value.tenant == "hog"
+    assert clock.monotonic() == t0          # no fake-time wait burned
+    # a compliant tenant (zero holdings) waits and wins the next release
+    got = []
+
+    def fair():
+        got.append(ctrl.admit(CLASS_NORMAL, tenant="fair"))
+
+    th = threading.Thread(target=fair, daemon=True)
+    th.start()
+    threading.Event().wait(0.1)
+    assert not got                          # pool genuinely full
+    held.pop().release()
+    th.join(timeout=5)
+    assert got, "released token must reach the under-share tenant"
+    got[0].release()
+    for t in held:
+        t.release()
+    assert ctrl.snapshot()["tenant_inflight"] == {}
+
+
+def test_tenant_removal_mid_flight_requeues_nothing(tmp_path):
+    """Quota edge case: a tenant removed while its requests are in
+    flight — the held ticket releases cleanly, later accounting for the
+    dead name lands on the implicit default view (never a KeyError, no
+    resurrection of the dead entry), and new requests resolve against
+    default."""
+    clock = FakeClock(1000.0)
+    reg = mk_registry(tmp_path, clock)
+    reg.set_tenant(TenantConfig(name="gone", rate=100.0, chains=("gc",)))
+    ctrl = mk_ctrl(reg, clock)
+    held = ctrl.admit(CLASS_SHEDDABLE, tenant="gone")
+    assert ctrl.snapshot()["tenant_inflight"] == {"gone": 1}
+    assert reg.remove_tenant("gone")
+    held.release()          # in-flight ticket of a dead entry: clean
+    assert ctrl.snapshot()["tenant_inflight"] == {}
+    # device time attributed to the dead name is absorbed, not requeued
+    # into a registry entry (and never raises)
+    reg.account_device_time("gone", 1.0)
+    assert reg.quota_level("gone") == 0.0
+    assert "gone" not in reg.snapshot()["tenants"]
+    # the chain now resolves to the implicit default tenant
+    assert reg.tenant_for_chain("gc") == DEFAULT_TENANT
+    ctrl.admit(CLASS_SHEDDABLE, tenant=reg.tenant_for_chain("gc")).release()
+
+
+def test_untenanted_call_sites_unchanged(tmp_path):
+    """tenant=None (every pre-tenancy call site) never consults the
+    registry — behavior stays byte-identical."""
+    clock = FakeClock(1000.0)
+    reg = mk_registry(tmp_path, clock)
+    reg.set_tenant(TenantConfig(name="z", weight=0.0))
+    ctrl = mk_ctrl(reg, clock)
+    ctrl.admit(CLASS_SHEDDABLE).release()
+    ctrl.admit(CLASS_NORMAL).release()
+    assert ctrl.snapshot()["tenant_inflight"] == {}
+
+
+# ---------------------------------------------------------------------------
+# placement: weight-proportional groups, pinning, anti-affinity, rebalance
+# ---------------------------------------------------------------------------
+
+
+class _Dev:
+    pass
+
+
+@pytest.fixture
+def fake_pool():
+    from drand_tpu.crypto.device_pool import (DevicePool,
+                                              _reset_inventory_for_tests)
+    _reset_inventory_for_tests([_Dev() for _ in range(4)])
+    yield DevicePool()          # 4 groups of 1
+    _reset_inventory_for_tests(None)
+
+
+def test_pool_weight_proportional_assignment(fake_pool):
+    pool = fake_pool
+    g_heavy = pool.assign("heavy", tenant="big", weight=3.0)
+    # the weight-3 chain loads its group 3x: the next three weight-1
+    # chains all land elsewhere before anyone shares with it
+    light = [pool.assign(f"l{i}", tenant="small", weight=1.0)
+             for i in range(3)]
+    assert all(g.gid != g_heavy.gid for g in light)
+    loads = pool.loads()
+    assert loads[g_heavy.gid] == 3.0
+
+
+def test_pool_pin_and_anti_affinity(fake_pool):
+    pool = fake_pool
+    pool.assign("a", tenant="ta", weight=1.0)
+    pool.assign("b", tenant="tb", weight=1.0)
+    pinned = pool.assign("p", tenant="prem", weight=1.0, pin=3)
+    assert pinned.gid == 3
+    # anti-affinity prefers a group no OTHER tenant occupies
+    iso = pool.assign("i", tenant="iso", weight=1.0, anti_affinity=True)
+    assert iso.gid not in {pool.gid_of("a"), pool.gid_of("b"),
+                           pool.gid_of("p")}
+    # out-of-range pin is ignored, not an error
+    ok = pool.assign("q", tenant="prem", weight=1.0, pin=99)
+    assert 0 <= ok.gid < 4
+    snap = pool.snapshot()
+    assert snap[3]["tenants"] == ["prem"]
+
+
+def test_service_places_and_accounts_by_tenant(tmp_path, fake_pool):
+    """End to end through the verify service: the handle lands on the
+    tenant's pinned group, and a dispatch's measured device time is
+    attributed to the tenant off the pack|queue|device split."""
+    from drand_tpu.crypto.verify_service import (LANE_LIVE, VerifyService)
+    clock = FakeClock(1000.0)
+    reg = mk_registry(tmp_path, clock, window=100.0)
+    reg.set_tenant(TenantConfig(name="prem", device_budget=1.0,
+                                chains=("premchain",), pin_group=2))
+    reg.register_chain("premchain", pk=pk(7))
+    svc = VerifyService(clock=clock, pad=8, background_window=0.0,
+                        pool=fake_pool)
+    svc.set_tenancy(reg)
+
+    class CostedBackend:
+        kind = "stub"
+
+        def verify_batch(self, rounds, sigs, prev_sigs=None):
+            clock.advance(0.25)         # the measured "device" interval
+            return np.ones(len(rounds), dtype=bool)
+
+    try:
+        h = svc.handle(SCHEME, pk(7), backend=CostedBackend())
+        assert h.gid == 2, "tenant pin must drive handle placement"
+        out = h.verify_batch([1, 2, 3], [b"s"] * 3, lane=LANE_LIVE)
+        assert out.all()
+        assert reg.device_seconds("prem") == pytest.approx(0.25)
+        assert svc.stats()["tenant_map"] == {
+            f"stub-scheme:{pk(7)[:4].hex()}": "prem"}
+        assert svc.stats()["group_map"][
+            f"stub-scheme:{pk(7)[:4].hex()}"] == 2
+    finally:
+        svc.stop()
+
+
+def test_service_rebalances_on_pin_change(tmp_path, fake_pool):
+    """Tenant update moves a pinned chain: rebalance_tenants rebuilds the
+    backend on the target group (the _migrate discipline) and the pool
+    affinity follows."""
+    from drand_tpu.crypto.verify_service import VerifyService
+    clock = FakeClock(1000.0)
+    reg = mk_registry(tmp_path, clock)
+    reg.set_tenant(TenantConfig(name="mv", chains=("mvchain",),
+                                pin_group=0))
+    reg.register_chain("mvchain", pk=pk(5))
+    svc = VerifyService(clock=clock, pad=8, background_window=0.0,
+                        pool=fake_pool)
+    svc.set_tenancy(reg)
+    built = []
+
+    def factory(group):
+        built.append(group.gid)
+
+        class B:
+            kind = "stub"
+
+            def verify_batch(self, rounds, sigs, prev_sigs=None):
+                return np.ones(len(rounds), dtype=bool)
+        return B()
+
+    try:
+        h = svc.handle(SCHEME, pk(5), backend_factory=factory)
+        assert h.gid == 0 and built == [0]
+        reg.set_tenant(TenantConfig(name="mv", chains=("mvchain",),
+                                    pin_group=3))
+        moved = svc.rebalance_tenants()
+        assert moved == 1 and built == [0, 3]
+        assert svc._slots[h.key].gid == 3
+        assert fake_pool.gid_of(h.key) == 3
+        assert svc.stats()["tenant_rebalances"] == 1
+        # verdicts still flow on the rebuilt backend
+        assert h.verify_batch([1], [b"x"]).all()
+        # removing the tenant un-labels the slot (implicit default pays
+        # no accounting) and moves nothing (sticky affinity)
+        reg.remove_tenant("mv")
+        assert svc.rebalance_tenants() == 0
+        assert svc._slots[h.key].tenant is None
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# REST gate + /health tenants block
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def rest_edge(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from chaos import TrueChain
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    from loadgen import _shim_daemon
+
+    from drand_tpu.http_server import RestServer
+
+    clock = FakeClock(1000.0)
+    reg = mk_registry(tmp_path, clock)
+    chain = TrueChain(n=4)
+    daemon = _shim_daemon(chain, head=4)
+    daemon.tenancy = reg
+    ctrl = AdmissionController(clock=clock, capacity=16,
+                               critical_reserve=2, tenancy=reg)
+    server = RestServer(daemon, "127.0.0.1:0", admission=ctrl)
+    server.start()
+    yield reg, server, ctrl
+    server.stop()
+
+
+def _rest_get(server, path):
+    url = f"http://127.0.0.1:{server.port}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def test_rest_tenant_gate_and_health_block(rest_edge):
+    reg, server, ctrl = rest_edge
+    # untenanted chain serves normally
+    code, obj, _ = _rest_get(server, "/public/1")
+    assert code == 200 and obj["round"] == 1
+    # pause the chain's tenant: well-formed 429 with the tenant label
+    # and Retry-After, BEFORE any store work
+    reg.set_tenant(TenantConfig(name="acme", paused=True,
+                                chains=("default",)))
+    code, obj, headers = _rest_get(server, "/public/1")
+    assert code == 429
+    assert obj["tenant"] == "acme" and obj["reason"] == "tenant-paused"
+    assert int(headers["Retry-After"]) >= 1
+    # /health carries the tenants block
+    code, health, _ = _rest_get(server, "/health")
+    t = health["tenants"]["tenants"]["acme"]
+    assert t["paused"] and t["shed"] >= 1
+    # unpause: reads flow again
+    reg.set_tenant(TenantConfig(name="acme", chains=("default",)))
+    code, obj, _ = _rest_get(server, "/public/1")
+    assert code == 200
+
+
+# ---------------------------------------------------------------------------
+# Control plane: tenant add/update/remove without restart
+# ---------------------------------------------------------------------------
+
+
+def test_control_plane_tenant_crud(tmp_path):
+    from drand_tpu.core.config import Config
+    from drand_tpu.core.daemon import DrandDaemon
+    from drand_tpu.net import ControlClient
+    from drand_tpu.net import convert
+    from drand_tpu.protos import drand_pb2 as pb
+
+    cfg = Config(folder=str(tmp_path / "d"), control_port=0,
+                 private_listen="127.0.0.1:0", db_engine="memdb")
+    d = DrandDaemon(cfg)
+    d.start()
+    try:
+        cc = ControlClient(d.control.port)
+        resp = cc.stub.tenant_set(pb.TenantConfigPacket(
+            name="acme", weight=2.0, rate=50.0, burst=10,
+            device_budget=0.5, chains=["default"], pin_group=1,
+            anti_affinity=True, metadata=convert.metadata()))
+        assert [t.name for t in resp.tenants] == ["acme"]
+        assert resp.tenants[0].pin_group == 1
+        # live in both enforcement planes, no restart
+        assert d.tenancy.get("acme").rate == 50.0
+        assert d.admission.tenancy is d.tenancy
+        assert d.tenancy.tenant_for_chain("default") == "acme"
+        # persisted beside the multibeacon layout
+        assert os.path.exists(registry_path(cfg.folder))
+        # update
+        resp = cc.stub.tenant_set(pb.TenantConfigPacket(
+            name="acme", weight=1.0, pin_group=-1, chains=["default"]))
+        assert resp.tenants[0].pin_group == -1
+        assert d.tenancy.get("acme").pin_group is None
+        # list + remove
+        resp = cc.stub.tenant_list(pb.TenantRequest())
+        assert len(resp.tenants) == 1
+        resp = cc.stub.tenant_remove(pb.TenantRequest(name="acme"))
+        assert len(resp.tenants) == 0
+        import grpc
+        with pytest.raises(grpc.RpcError) as ei:
+            cc.stub.tenant_remove(pb.TenantRequest(name="acme"))
+        assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+        cc.close()
+    finally:
+        d.stop()
+
+
+def test_config_wires_registry_into_planes(tmp_path):
+    from drand_tpu.core.config import Config
+    cfg = Config(folder=str(tmp_path / "d"), db_engine="memdb")
+    reg = cfg.tenancy()
+    assert cfg.tenancy() is reg
+    assert cfg.admission().tenancy is reg
+    svc = cfg.verify_service()
+    try:
+        assert svc._tenancy is reg
+    finally:
+        cfg.stop_verify_service()
+
+
+# ---------------------------------------------------------------------------
+# the noisy-neighbor acceptance (tests/chaos.py; smoke: --tenant)
+# ---------------------------------------------------------------------------
+
+
+def test_noisy_neighbor_scenario():
+    """ISSUE 15 acceptance: with an aggressor tenant flooding sheddable
+    reads and saturating its device-time quota on an expensive chain,
+    the victim's partials p99 stays under its period, its per-round
+    throughput stays within 20% of the aggressor-free run (same seed),
+    over-quota rejections are well-formed and tenant-labelled, never
+    silent, and placement keeps the tenants on different groups."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from chaos import NoisyNeighborScenario
+    r = NoisyNeighborScenario(seed=42).run()
+    assert r.ok, r
+    assert r.victim_partials_p99 < r.period
+    assert r.throughput_ratio >= 0.8
+    assert r.aggro_quota_peak >= 1.0 and r.aggro_quota_sheds > 0
+    assert r.sheds_well_formed and r.silent_drops == 0
+    assert r.placement["victim"] != r.placement["aggro"]
+    # same seed, same verdict (deterministic)
+    r2 = NoisyNeighborScenario(seed=42).run()
+    assert (r2.victim_rounds, r2.aggro_reads_shed, r2.aggro_reads_served) \
+        == (r.victim_rounds, r.aggro_reads_shed, r.aggro_reads_served)
+
+
+def test_wfq_exempts_implicit_default_tenant(tmp_path):
+    """A daemon whose chains have no registry entry resolves every
+    request to the implicit default tenant, whose 'share' would be the
+    whole pool — WFQ must not turn the pre-tenancy wait behavior into
+    an instant shed there (normal still rides out a brief squeeze via
+    normal_wait, and its timed-out wait stays the ladder signal)."""
+    clock = FakeClock(1000.0)
+    reg = mk_registry(tmp_path, clock)
+    reg.set_tenant(TenantConfig(name="someone", chains=("elsewhere",)))
+    ctrl = mk_ctrl(reg, clock, capacity=6, critical_reserve=2,
+                   normal_wait=2.0)
+    limit = ctrl.capacity - ctrl.critical_reserve
+    held = [ctrl.admit(CLASS_NORMAL, tenant=DEFAULT_TENANT)
+            for _ in range(limit)]
+    got = []
+
+    def late():
+        got.append(ctrl.admit(CLASS_NORMAL, tenant=DEFAULT_TENANT))
+
+    th = threading.Thread(target=late, daemon=True)
+    th.start()
+    threading.Event().wait(0.1)
+    assert not got and th.is_alive()    # waiting, NOT tenant-share shed
+    held.pop().release()
+    th.join(timeout=5)
+    assert got
+    got[0].release()
+    for t in held:
+        t.release()
+
+
+def test_empty_registry_costs_no_registry_round_trips(tmp_path):
+    """No tenants registered -> the admission hot path never consults
+    the registry (has_tenants() is a lock-free bool)."""
+    clock = FakeClock(1000.0)
+    reg = mk_registry(tmp_path, clock)
+    assert not reg.has_tenants()
+    calls = []
+    orig = reg.admission_view
+    reg.admission_view = lambda t: calls.append(t) or orig(t)
+    ctrl = mk_ctrl(reg, clock)
+    ctrl.admit(CLASS_SHEDDABLE, tenant=DEFAULT_TENANT).release()
+    assert ctrl.check_tenant_read(DEFAULT_TENANT) is None
+    assert calls == []
+    reg.set_tenant(TenantConfig(name="t"))
+    assert reg.has_tenants()
+    ctrl.admit(CLASS_SHEDDABLE, tenant="t").release()
+    assert calls == ["t"]
+    reg.remove_tenant("t")
+    assert not reg.has_tenants()
+
+
+def test_late_chain_registration_relabels_slots(tmp_path, fake_pool):
+    """Daemon-restart ordering: verify handles are created by
+    start_beacon BEFORE the daemon registers the chain hash — the
+    registry's register_chain fires the change listeners, so the
+    already-created slot picks up its tenant (device-time accounting
+    live) and the tenant's pin is applied."""
+    from drand_tpu.crypto.verify_service import VerifyService
+    clock = FakeClock(1000.0)
+    reg = mk_registry(tmp_path, clock, window=100.0)
+    reg.set_tenant(TenantConfig(name="prem", device_budget=1.0,
+                                chains=("pchain",), pin_group=3))
+    svc = VerifyService(clock=clock, pad=8, background_window=0.0,
+                        pool=fake_pool)
+    svc.set_tenancy(reg)
+    reg.on_change(svc.rebalance_tenants)    # the Config wiring
+
+    def factory(group):
+        class B:
+            kind = "stub"
+
+            def verify_batch(self, rounds, sigs, prev_sigs=None):
+                clock.advance(0.5)
+                return np.ones(len(rounds), dtype=bool)
+        return B()
+
+    try:
+        # handle created BEFORE the chain is indexed (restart order)
+        h = svc.handle(SCHEME, pk(9), backend_factory=factory)
+        assert svc._slots[h.key].tenant in (None, DEFAULT_TENANT)
+        # the daemon registers the chain -> listeners relabel + pin
+        reg.register_chain("pchain", pk=pk(9))
+        slot = svc._slots[h.key]
+        assert slot.tenant == "prem"
+        assert slot.gid == 3 and fake_pool.gid_of(h.key) == 3
+        # device time now lands on the tenant's ledger
+        h.verify_batch([1, 2], [b"x"] * 2)
+        assert reg.device_seconds("prem") == pytest.approx(0.5)
+        # re-registering the same mapping is a no-op (no churn)
+        moves = svc.stats()["tenant_rebalances"]
+        reg.register_chain("pchain", pk=pk(9))
+        assert svc.stats()["tenant_rebalances"] == moves
+    finally:
+        svc.stop()
+
+
+def test_rest_tickets_count_toward_wfq_share(tmp_path):
+    """REST admits pre-parse with tenant=None; once the route resolves
+    the chain, the held ticket is ATTRIBUTED to the tenant so weighted
+    fair queuing sees REST holdings — with the pool contended, the
+    flooding tenant's next read sheds tenant-share at the gate while a
+    compliant tenant's read passes."""
+    clock = FakeClock(1000.0)
+    reg = mk_registry(tmp_path, clock)
+    reg.set_tenant(TenantConfig(name="hog", weight=1.0, chains=("hc",)))
+    reg.set_tenant(TenantConfig(name="fair", weight=1.0, chains=("fc",)))
+    ctrl = mk_ctrl(reg, clock, capacity=6, critical_reserve=2)
+    limit = ctrl.capacity - ctrl.critical_reserve
+    # the flood: pre-parse (untenanted) tickets filling the pool, each
+    # attributed to the hog when its route resolved
+    held = []
+    for _ in range(limit):
+        t = ctrl.admit(CLASS_SHEDDABLE)         # tenant unknown pre-parse
+        ctrl.attribute(t, "hog")
+        held.append(t)
+    assert ctrl.snapshot()["tenant_inflight"] == {"hog": limit}
+    # pool full + hog over its share -> its gate check sheds with the
+    # tenant label; the compliant tenant's gate stays open
+    shed = ctrl.check_tenant_read("hog")
+    assert shed is not None and shed.reason == REASON_TENANT_SHARE
+    assert shed.tenant == "hog"
+    assert ctrl.check_tenant_read("fair") is None
+    # attribution is once-only and release unwinds the ledger
+    ctrl.attribute(held[0], "fair")             # no-op: already labelled
+    assert ctrl.snapshot()["tenant_inflight"] == {"hog": limit}
+    for t in held:
+        t.release()
+    assert ctrl.snapshot()["tenant_inflight"] == {}
+    # uncontended pool: holding a share is fine, nothing sheds
+    t = ctrl.admit(CLASS_SHEDDABLE)
+    ctrl.attribute(t, "hog")
+    assert ctrl.check_tenant_read("hog") is None
+    t.release()
+
+
+def test_quota_gauge_tracks_window_drain(tmp_path):
+    """The tenant_quota_level gauge must follow the rolling window down
+    when a tenant goes idle — admission_view and snapshot() both refresh
+    it, so dashboards agree with /health."""
+    from drand_tpu.metrics import tenant_quota_level
+    clock = FakeClock(1000.0)
+    reg = mk_registry(tmp_path, clock, window=10.0)
+    reg.set_tenant(TenantConfig(name="spiky", device_budget=0.1))
+    reg.account_device_time("spiky", 5.0)       # 5x the window budget
+    gauge = tenant_quota_level.labels("spiky")
+    assert gauge._value.get() >= 1.0
+    clock.advance(11.0)                         # window drains, no traffic
+    reg.snapshot()                              # a /health scrape
+    assert gauge._value.get() == 0.0
+    reg.account_device_time("spiky", 5.0)
+    clock.advance(11.0)
+    reg.admission_view("spiky")                 # an admission consult
+    assert gauge._value.get() == 0.0
+
+
+def test_unregistered_chain_slot_stays_unlabelled(tmp_path, fake_pool):
+    """A chain resolving to the implicit default gets tenant=None on its
+    slot: no per-dispatch registry accounting, no tenant_* series — the
+    placement mirror of the admission plane's has_tenants early-out."""
+    from drand_tpu.crypto.verify_service import VerifyService
+    clock = FakeClock(1000.0)
+    reg = mk_registry(tmp_path, clock)
+    reg.set_tenant(TenantConfig(name="someone", chains=("elsewhere",)))
+    svc = VerifyService(clock=clock, pad=8, background_window=0.0,
+                        pool=fake_pool)
+    svc.set_tenancy(reg)
+
+    class B:
+        kind = "stub"
+
+        def verify_batch(self, rounds, sigs, prev_sigs=None):
+            clock.advance(0.25)
+            return np.ones(len(rounds), dtype=bool)
+
+    try:
+        h = svc.handle(SCHEME, pk(11), backend=B())
+        assert svc._slots[h.key].tenant is None
+        h.verify_batch([1], [b"x"])
+        assert reg.device_seconds(DEFAULT_TENANT) == 0.0
+        assert svc.stats()["tenant_map"] == {}
+    finally:
+        svc.stop()

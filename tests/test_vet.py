@@ -321,12 +321,28 @@ def test_atomic_checker_scoped_to_key_plane(tmp_path):
 
 
 def test_bounds_checker_scoped_to_serving_paths(tmp_path):
-    """An unbounded queue OUTSIDE net//http_server.py/relay.py is not
-    this checker's business (internal planes are bounded upstream)."""
+    """An unbounded queue OUTSIDE net//http_server.py/relay.py/
+    core/tenancy.py is not this checker's business (internal planes are
+    bounded upstream)."""
     src = tmp_path / "beacon_thing.py"
     src.write_text("import queue\nQ = queue.Queue()\n")
     report = run_vet([str(src)], checkers=by_names(["bounds"]))
     assert report.findings == []
+
+
+def test_bounds_checker_covers_tenancy(tmp_path):
+    """ISSUE 15: the tenant registry joined the bounds scope — the
+    seeded fixture violation at rel path core/tenancy.py is caught, the
+    bounded constructs stay silent, and the justified spool is a
+    suppression, not a finding."""
+    report = _fixture_report("bounds")
+    codes = _codes(report, "core/tenancy.py")
+    assert ("core/tenancy.py", "bounds-unbounded-queue") in codes
+    lines = {f.line for f in report.findings
+             if f.path == "core/tenancy.py"}
+    assert len(lines) == 1, sorted(lines)       # exactly the seeded BAD
+    assert len([f for f in report.suppressed
+                if f.path == "core/tenancy.py"]) == 1
 
 
 def test_wait_checker_exempts_test_code(tmp_path):

@@ -196,6 +196,45 @@ def overload_main(args) -> int:
     return 0 if result.ok else 1
 
 
+def tenant_main(args) -> int:
+    """--tenant mode: the multi-tenant noisy-neighbor scenario — an
+    aggressor tenant floods sheddable reads and saturates its device-time
+    quota on an expensive chain while a victim tenant's rounds keep
+    flowing.  The victim's partials p99 must stay under its period and
+    its per-round throughput within 20% of the aggressor-free run (same
+    seed); every over-quota rejection must be well-formed and carry the
+    tenant label, never a silent drop."""
+    from chaos import NoisyNeighborScenario
+
+    r = NoisyNeighborScenario(seed=args.seed).run()
+    print(f"seed            : {args.seed}")
+    print(f"victim rounds   : {r.victim_rounds}/{r.victim_rounds_baseline}"
+          f" (ratio {r.throughput_ratio:.2f}, floor 0.80)")
+    print(f"victim partials : p99 {r.victim_partials_p99:.3f}s "
+          f"(period {r.period:.0f}s)")
+    print(f"victim reads    : {r.victim_reads_served} served")
+    print(f"aggro reads     : {r.aggro_reads_served} served, "
+          f"{r.aggro_reads_shed} shed "
+          f"({r.aggro_quota_sheds} tenant-labelled)")
+    print(f"aggro quota     : peak level {r.aggro_quota_peak:.2f} "
+          f"(>=1 = over budget)")
+    print(f"sheds well-formed: {r.sheds_well_formed} "
+          f"(silent drops: {r.silent_drops})")
+    print(f"placement       : {r.placement} "
+          f"(distinct groups: {len(set(r.placement.values())) >= 2})")
+    print(f"device seconds  : {r.device_seconds}")
+
+    from drand_tpu.metrics import scrape
+    lines = [l for l in scrape("private").decode().splitlines()
+             if l.startswith(("tenant_requests_total",
+                              "tenant_device_seconds_total",
+                              "tenant_quota_level"))]
+    print("tenant series   :")
+    for line in lines:
+        print(f"  {line}")
+    return 0 if r.ok else 1
+
+
 def handel_main(args) -> int:
     """--handel mode: the committee-scale Handel overlay under seeded
     Byzantine members (invalid candidates, equivocation, out-of-block
@@ -261,6 +300,11 @@ def main() -> int:
                          "level-budget convergence) instead of the "
                          "network chaos scenario; --nodes/--byzantine "
                          "scale the committee (min 16)")
+    ap.add_argument("--tenant", action="store_true",
+                    help="run the multi-tenant noisy-neighbor scenario "
+                         "(aggressor tenant flood + device-quota "
+                         "saturation vs a victim tenant's live rounds) "
+                         "instead of the network chaos scenario")
     args = ap.parse_args()
 
     if args.storage:
@@ -273,6 +317,8 @@ def main() -> int:
         return reshare_main(args)
     if args.handel:
         return handel_main(args)
+    if args.tenant:
+        return tenant_main(args)
 
     from chaos import ChaosScenario
 

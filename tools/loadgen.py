@@ -57,6 +57,8 @@ class LoadReport:
     malformed_sheds: int = 0
     latencies: List[float] = field(default_factory=list)
     admission: Optional[dict] = None
+    # --tenants: per-chain breakdown (key = chain hash / tenant label)
+    by_tenant: Optional[dict] = None
 
     @property
     def rounds_served_per_s(self) -> float:
@@ -88,13 +90,18 @@ class LoadReport:
             "latency_p50_s": round(self._pct(0.50), 4),
             "latency_p99_s": round(self._pct(0.99), 4),
             "admission": self.admission,
+            **({"by_tenant": self.by_tenant} if self.by_tenant else {}),
         }
 
     def render(self) -> str:
         d = self.to_dict()
-        lines = [f"{k:22}: {v}" for k, v in d.items() if k != "admission"]
+        lines = [f"{k:22}: {v}" for k, v in d.items()
+                 if k not in ("admission", "by_tenant")]
         if d["admission"]:
             lines.append(f"{'admission':22}: {json.dumps(d['admission'])}")
+        for tenant, counts in (d.get("by_tenant") or {}).items():
+            lines.append(f"{'tenant ' + tenant[:12]:22}: "
+                         f"{json.dumps(counts)}")
         return "\n".join(lines)
 
 
@@ -102,7 +109,8 @@ class LoadReport:
 
 
 def _rest_once(base: str, path: str, report: LoadReport,
-               lock: threading.Lock) -> None:
+               lock: threading.Lock, tenant_key: Optional[str] = None
+               ) -> None:
     t0 = time.perf_counter()
     status, retry_after = 0, None
     try:
@@ -118,15 +126,28 @@ def _rest_once(base: str, path: str, report: LoadReport,
     dt = time.perf_counter() - t0
     with lock:
         report.attempted += 1
+        if tenant_key is not None:
+            if report.by_tenant is None:
+                report.by_tenant = {}
+            t = report.by_tenant.setdefault(
+                tenant_key, {"attempted": 0, "ok": 0, "shed": 0,
+                             "errors": 0})
+            t["attempted"] += 1
         if status in (200, 304):
             report.ok += 1
             report.latencies.append(dt)
+            if tenant_key is not None:
+                t["ok"] += 1
         elif status == 429:
             report.shed += 1
             if retry_after is None:
                 report.malformed_sheds += 1
+            if tenant_key is not None:
+                t["shed"] += 1
         else:
             report.errors += 1
+            if tenant_key is not None:
+                t["errors"] += 1
 
 
 def _grpc_once(client, peer, report: LoadReport,
@@ -297,6 +318,13 @@ def main() -> int:
                     help="in-process flood against a tiny admission pool "
                          "(no daemon needed); exit 0 iff served+shed+"
                          "well-formed")
+    ap.add_argument("--tenants",
+                    help="comma-separated chain hashes (multi-tenant "
+                         "daemon): REST requests round-robin across "
+                         "/{hash}/public/latest and the report breaks "
+                         "ok/shed down per chain — drive one tenant's "
+                         "hash hot to watch its quota shed while the "
+                         "others keep serving")
     args = ap.parse_args()
 
     if args.selftest:
@@ -307,9 +335,23 @@ def main() -> int:
     rc = 0
     if args.rest:
         base = args.rest.rstrip("/")
+        if args.tenants:
+            hashes = [h.strip() for h in args.tenants.split(",")
+                      if h.strip()]
+            rr = {"i": 0}
+            rr_lock = threading.Lock()
+
+            def fire(rep, lock):
+                with rr_lock:
+                    h = hashes[rr["i"] % len(hashes)]
+                    rr["i"] += 1
+                _rest_once(base, f"/{h}/public/latest", rep, lock,
+                           tenant_key=h)
+        else:
+            def fire(rep, lock):
+                _rest_once(base, "/public/latest", rep, lock)
         report = run_load(
-            lambda rep, lock: _rest_once(base, "/public/latest", rep, lock),
-            target=base, mode=args.mode, clients=args.clients,
+            fire, target=base, mode=args.mode, clients=args.clients,
             rate=args.rate, duration=args.duration)
         report.admission = _fetch_admission(base)
         print(json.dumps(report.to_dict()) if args.json
