@@ -1,5 +1,7 @@
 """Checker registry.  Each checker is a class with `name` (the
-suppression token), `description`, and `check(module) -> findings`."""
+suppression token), `description`, and `check(module) -> findings`;
+interprocedural checkers set `uses_project = True` and take
+`check(module, project)` (see analysis/project.py)."""
 
 from .clock import ClockChecker
 from .locks import LockChecker
@@ -10,10 +12,15 @@ from .verifier import VerifierChecker
 from .wait import WaitChecker
 from .bounds import BoundsChecker
 from .atomicwrite import AtomicWriteChecker
+from .recompile import RecompileChecker
+from .deadline import DeadlineChecker
+from .threadlife import ThreadLifeChecker
+from .metriclabel import MetricLabelChecker
 
 ALL_CHECKERS = (ClockChecker, LockChecker, SecretChecker, TraceChecker,
                 StoreChecker, VerifierChecker, WaitChecker, BoundsChecker,
-                AtomicWriteChecker)
+                AtomicWriteChecker, RecompileChecker, DeadlineChecker,
+                ThreadLifeChecker, MetricLabelChecker)
 
 
 def checker_names():

@@ -14,10 +14,16 @@ variants), resolved through import aliases (`import time as _t`;
 steered by a fake clock.  Allowlist: the Clock implementations
 themselves (beacon/clock.py) and log.py (timestamps on log records are
 wall-clock by definition).
+
+Interprocedural (v2): with a phase-1 `Project`, calls to helpers whose
+return value is wall-clock-tainted (`def wall_now(): return time.time()`
+in another module) are flagged too — laundering the read through a
+utility function no longer hides it.  Helpers defined in the allowlisted
+Clock modules are the sanctioned route and stay exempt.
 """
 
 import ast
-from typing import Iterator
+from typing import Iterator, Optional
 
 from ..core import Finding
 from ..symbols import ModuleInfo, dotted
@@ -32,14 +38,19 @@ BANNED = {
 ALLOWED_FILES = ("beacon/clock.py", "log.py")
 
 
+def _allowed_rel(rel: str) -> bool:
+    return any(rel == a or rel.endswith("/" + a) for a in ALLOWED_FILES)
+
+
 class ClockChecker:
     name = "clock"
-    description = ("direct time.time()/monotonic()/sleep() outside the "
-                   "injected-Clock implementations")
+    description = ("direct (or helper-laundered) time.time()/monotonic()/"
+                   "sleep() outside the injected-Clock implementations")
+    uses_project = True
 
-    def check(self, module: ModuleInfo) -> Iterator[Finding]:
-        if any(module.rel == a or module.rel.endswith("/" + a)
-               for a in ALLOWED_FILES):
+    def check(self, module: ModuleInfo,
+              project: Optional[object] = None) -> Iterator[Finding]:
+        if _allowed_rel(module.rel):
             return
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call):
@@ -51,4 +62,17 @@ class ClockChecker:
                     message=(f"direct call to {qual}(); route through the "
                              "injected Clock (beacon/clock.py) so chaos "
                              "tests stay deterministic"),
+                    path=module.rel, line=node.lineno, col=node.col_offset)
+                continue
+            if project is None:
+                continue
+            callee = project.resolve_call(module, node)
+            if callee is not None and callee.returns_wallclock \
+                    and not _allowed_rel(callee.rel):
+                yield Finding(
+                    checker=self.name, code="clock-interproc-call",
+                    message=(f"call to {callee.display} returns a raw "
+                             "wall-clock value; route through the injected "
+                             "Clock (beacon/clock.py) so chaos tests stay "
+                             "deterministic"),
                     path=module.rel, line=node.lineno, col=node.col_offset)

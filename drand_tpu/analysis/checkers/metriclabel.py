@@ -1,0 +1,149 @@
+"""metriclabel: metric label values come from provably bounded sets.
+
+Prometheus stores one time series per label combination; a peer address,
+a round number, or a tenant-supplied string as a label value turns a
+gauge into an unbounded allocation in every scrape target downstream.
+The repo's convention is that label values are either literals, one of
+the known bounded identifiers below (`beacon_id`, `lane`, `scope`, ...),
+or pass through `metrics.registered_label(...)` — the cardinality-capping
+sanitizer that maps out-of-registry values to a fallback bucket.
+
+A value expression is **bounded** when it is:
+
+  * a literal, or an f-string / `str()` / concatenation of bounded parts;
+  * a name or attribute whose terminal identifier is in the bounded
+    registry (or is ALL-UPPERCASE — module constants);
+  * a call to a sanctioner (`registered_label` / `bounded_label`);
+  * a conditional / `or`-chain whose branches are all bounded;
+  * a local assigned from a bounded expression (one hop).
+
+Everything else that reaches `.labels(...)` is flagged
+(``metriclabel-unbounded``).  Test code is exempt.
+"""
+
+import ast
+import os
+from typing import Iterator, Optional, Set
+
+from ..core import Finding
+from ..symbols import ModuleInfo, dotted, walk_scope
+
+# identifiers whose values are bounded by construction in this codebase:
+# config enums, registry keys, small fixed sets
+BOUNDED_TERMINALS = {
+    "beacon_id", "scope", "lane", "cls", "kind", "phase", "direction",
+    "result", "verdict", "decision", "trigger", "state", "gid", "db",
+    "op", "scheme", "label", "api_method", "route", "db_engine",
+    "engine", "outcome", "status", "reason", "stage", "mode", "tier",
+}
+
+# sanitizers that produce registry-capped values no matter the input
+SANCTIONERS = {"registered_label", "bounded_label"}
+
+# casts that preserve boundedness of their (bounded) argument
+CASTS = {"str", "int", "len", "repr", "format"}
+
+
+def _is_test_code(rel: str) -> bool:
+    base = os.path.basename(rel)
+    return base.startswith("test_") or base.endswith("_test.py") \
+        or rel.startswith("tests/") or "/tests/" in rel \
+        or base in ("conftest.py", "chaos.py")
+
+
+def _terminal(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _bounded_name(term: str) -> bool:
+    """A terminal identifier reads as bounded when it IS a registered
+    bounded word, ends with one (`drain_lane`, `peer_cls` — the naming
+    convention that documents boundedness at the use site), or is an
+    ALL-CAPS module constant."""
+    if term in BOUNDED_TERMINALS:
+        return True
+    if any(term.endswith("_" + w) for w in BOUNDED_TERMINALS):
+        return True
+    return term.isupper() and len(term) > 1
+
+
+class MetricLabelChecker:
+    name = "metriclabel"
+    description = ("metric label values must come from provably bounded "
+                   "sets — no peer address, round number, or tenant string")
+
+    def _bounded(self, module: ModuleInfo, node: ast.AST,
+                 locals_: Set[str]) -> bool:
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in locals_ or _bounded_name(node.id)
+        if isinstance(node, ast.Attribute):
+            return _bounded_name(node.attr)
+        if isinstance(node, ast.Call):
+            fname = _terminal(dotted(node.func) or "")
+            if fname in SANCTIONERS:
+                return True
+            if fname in CASTS:
+                return all(self._bounded(module, a, locals_)
+                           for a in node.args)
+            # `"x".join(...)`-style method on a bounded receiver
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "format":
+                return all(self._bounded(module, a, locals_)
+                           for a in node.args)
+            return False
+        if isinstance(node, ast.IfExp):
+            return self._bounded(module, node.body, locals_) \
+                and self._bounded(module, node.orelse, locals_)
+        if isinstance(node, ast.BoolOp):
+            return all(self._bounded(module, v, locals_)
+                       for v in node.values)
+        if isinstance(node, ast.JoinedStr):
+            return all(self._bounded(module, v.value, locals_)
+                       for v in node.values
+                       if isinstance(v, ast.FormattedValue))
+        if isinstance(node, ast.BinOp):
+            return self._bounded(module, node.left, locals_) \
+                and self._bounded(module, node.right, locals_)
+        if isinstance(node, ast.Subscript):
+            # a lookup INTO a bounded table yields one of its (bounded)
+            # values — STATE_NAMES[new] — whatever the index is
+            return self._bounded(module, node.value, locals_)
+        return False
+
+    def _bounded_locals(self, module: ModuleInfo, fn: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for node in walk_scope(fn):
+            if isinstance(node, ast.Assign):
+                if self._bounded(module, node.value, out):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out.add(t.id)
+        return out
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if _is_test_code(module.rel):
+            return
+        for cls, fn in module.functions():
+            locals_ = self._bounded_locals(module, fn)
+            for node in walk_scope(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "labels"):
+                    continue
+                for arg in list(node.args) \
+                        + [kw.value for kw in node.keywords]:
+                    if self._bounded(module, arg, locals_):
+                        continue
+                    shown = dotted(arg) or type(arg).__name__
+                    yield Finding(
+                        checker=self.name, code="metriclabel-unbounded",
+                        message=(f"label value `{shown}` is not provably "
+                                 "bounded; a per-peer/per-round/per-tenant "
+                                 "label value is one time series per "
+                                 "distinct value — use a bounded "
+                                 "identifier or metrics.registered_label()"),
+                        path=module.rel, line=node.lineno,
+                        col=node.col_offset)

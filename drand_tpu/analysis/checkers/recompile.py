@@ -1,0 +1,197 @@
+"""recompile: jit cache-key hygiene — no fresh program flavors per call.
+
+PR 11's watchdog-floor incident was a single callsite deriving a Python
+int from device data (`.item()`) and passing it where the jitted kernel
+treated it as a trace-time constant: every distinct value minted a fresh
+program flavor, compile time ate the round budget, and the watchdog
+fired on a healthy chip.  The kernels in `crypto/` and `ops/` hold the
+cache-key discipline by construction (flavor constants are config-derived
+at factory time, placement is centralized in
+`crypto/device_pool.build_round_sharding`); this checker keeps it held.
+
+Scope: `crypto/` + `ops/` for the dispatch-hygiene codes; the placement
+code applies everywhere (a per-call `Mesh(...)` in `beacon/` would churn
+compilation just the same).
+
+Codes:
+
+  * ``recompile-data-dependent-static`` — a static-arg slot of a jitted
+    function receives `.item()` / `.tolist()` / `int(x)` / `float(x)`
+    of a runtime value: every distinct value is a fresh program flavor.
+    (Cross-function: the static slots come from the callee's phase-1
+    summary, so the callsite and the `@jit(static_argnums=...)` def can
+    live in different modules.)
+  * ``recompile-data-dependent-flavor`` — same data-dependent shapes
+    passed to a `jit_factory` function (one that returns `jax.jit(...)`):
+    each call already builds a fresh program; feeding it data-dependent
+    flavor constants makes the cache key unbounded.
+  * ``recompile-unhashable-static`` — a list/dict/set display (or a
+    mutable default on a static param) in a static-arg slot: jit hashes
+    static args, unhashables raise at dispatch, and "fixing" it with
+    id()-keyed wrappers silently unbounds the cache.
+  * ``recompile-per-call-placement`` — `Mesh` / `NamedSharding` /
+    `PositionalSharding` constructed outside `crypto/device_pool.py`, or
+    inside any loop: placement objects belong in the one cached factory,
+    not on the dispatch path.
+"""
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import Finding
+from ..symbols import ModuleInfo, dotted
+
+SCOPES = ("crypto/", "ops/")
+
+# constructors that mint placement objects; allowed only in the pool
+PLACEMENT_CTORS = {
+    "Mesh", "jax.sharding.Mesh", "sharding.Mesh", "maps.Mesh",
+    "NamedSharding", "jax.sharding.NamedSharding", "sharding.NamedSharding",
+    "PositionalSharding", "jax.sharding.PositionalSharding",
+    "sharding.PositionalSharding",
+}
+PLACEMENT_HOME = "crypto/device_pool.py"
+
+# conversions that turn runtime (device) data into Python scalars
+SCALAR_EXTRACTORS = {"item", "tolist"}
+SCALAR_CASTS = {"int", "float"}
+
+
+def _in_scope(rel: str) -> bool:
+    return any(rel.startswith(s) or f"/{s}" in f"/{rel}" for s in SCOPES)
+
+
+def _data_dependent(node: ast.AST) -> Optional[str]:
+    """A human label when `node` derives a Python scalar from runtime
+    data; None otherwise.  Shape reads (`x.shape[0]`, `len(x)`) are
+    exempt — shapes legitimately select program flavors."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            if isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in SCALAR_EXTRACTORS:
+                return f".{sub.func.attr}()"
+            if isinstance(sub.func, ast.Name) \
+                    and sub.func.id in SCALAR_CASTS \
+                    and len(sub.args) == 1 \
+                    and isinstance(sub.args[0], (ast.Name, ast.Attribute)):
+                inner = dotted(sub.args[0]) or ""
+                if ".shape" not in f".{inner}":
+                    return f"{sub.func.id}({inner})"
+    return None
+
+
+def _unhashable(node: ast.AST) -> bool:
+    return isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp))
+
+
+class RecompileChecker:
+    name = "recompile"
+    description = ("jit cache-key hygiene: data-dependent flavor constants, "
+                   "unhashable static args, per-call placement construction")
+    uses_project = True
+
+    def check(self, module: ModuleInfo,
+              project: Optional[object] = None) -> Iterator[Finding]:
+        yield from self._placement(module)
+        if not _in_scope(module.rel):
+            return
+        yield from self._static_defaults(module, project)
+        if project is None:
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = project.resolve_call(module, node)
+            if callee is None:
+                continue
+            if callee.static_args:
+                yield from self._static_site(module, node, callee)
+            if callee.jit_factory:
+                yield from self._factory_site(module, node, callee)
+
+    # -- placement ------------------------------------------------------------
+
+    def _placement(self, module: ModuleInfo) -> Iterator[Finding]:
+        at_home = module.rel == PLACEMENT_HOME \
+            or module.rel.endswith("/" + PLACEMENT_HOME)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = module.resolve(dotted(node.func) or "")
+            if qual not in PLACEMENT_CTORS:
+                continue
+            in_loop = module.enclosing(node, ast.For, ast.While,
+                                       ast.AsyncFor) is not None
+            if at_home and not in_loop:
+                continue
+            where = "inside a loop" if in_loop else \
+                f"outside {PLACEMENT_HOME}"
+            yield Finding(
+                checker=self.name, code="recompile-per-call-placement",
+                message=(f"{qual}(...) constructed {where}; placement "
+                         "objects belong in crypto/device_pool."
+                         "build_round_sharding (cached), not on the "
+                         "dispatch path"),
+                path=module.rel, line=node.lineno, col=node.col_offset)
+
+    # -- static-arg hygiene ---------------------------------------------------
+
+    def _static_defaults(self, module: ModuleInfo,
+                         project) -> Iterator[Finding]:
+        """Mutable default on a static param of a jitted def."""
+        if project is None:
+            return
+        for (rel, qual), s in project.functions.items():
+            if rel != module.rel or not s.static_args:
+                continue
+            for p in s.static_args:
+                d = s.defaults.get(p)
+                if d is not None and _unhashable(d):
+                    yield Finding(
+                        checker=self.name,
+                        code="recompile-unhashable-static",
+                        message=(f"static arg `{p}` of {s.display} has an "
+                                 "unhashable default; jit hashes static "
+                                 "args — use a tuple or None"),
+                        path=module.rel, line=d.lineno, col=d.col_offset)
+
+    def _static_site(self, module: ModuleInfo, call: ast.Call,
+                     callee) -> Iterator[Finding]:
+        for p in sorted(callee.static_args):
+            bound = callee.arg_param(call, p)
+            if bound is None:
+                continue
+            if _unhashable(bound):
+                yield Finding(
+                    checker=self.name, code="recompile-unhashable-static",
+                    message=(f"unhashable value passed as static arg `{p}` "
+                             f"of {callee.display}; jit hashes static args "
+                             "— pass a tuple"),
+                    path=module.rel, line=call.lineno, col=call.col_offset)
+                continue
+            label = _data_dependent(bound)
+            if label:
+                yield Finding(
+                    checker=self.name,
+                    code="recompile-data-dependent-static",
+                    message=(f"data-dependent scalar ({label}) passed as "
+                             f"static arg `{p}` of {callee.display}; every "
+                             "distinct value mints a fresh program flavor "
+                             "(the PR 11 watchdog-floor class)"),
+                    path=module.rel, line=call.lineno, col=call.col_offset)
+
+    def _factory_site(self, module: ModuleInfo, call: ast.Call,
+                      callee) -> Iterator[Finding]:
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            label = _data_dependent(arg)
+            if label:
+                yield Finding(
+                    checker=self.name,
+                    code="recompile-data-dependent-flavor",
+                    message=(f"data-dependent scalar ({label}) passed to "
+                             f"jit factory {callee.display}; factory args "
+                             "are trace-time flavor constants — derive "
+                             "them from config, not device data"),
+                    path=module.rel, line=call.lineno, col=call.col_offset)
+                break

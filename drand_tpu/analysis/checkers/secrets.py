@@ -24,8 +24,17 @@ Taint-lite, intra-function:
     `__repr__`/`__str__`/`__format__`.
 
 One assignment hop is tracked (`s = self._share` then `log.info(x=s)`);
-deeper interprocedural flow is out of scope — the point is catching the
-direct and one-hop cases that code review keeps missing.
+within one function that is the scope.  With a phase-1 `Project`
+(interprocedural v2) two cross-function flows join in:
+
+  * sources — a call to ANY function whose summary says it returns
+    secret material (`def current_material(vault): return
+    vault.get_share()` makes `current_material(v)` a source at every
+    resolved call site), not just the two hard-coded getter names.
+  * sinks — passing a secret expression into a callee parameter that the
+    callee's summary says reaches a log/print sink
+    (`secret-interproc-log`): the leak happens one frame down, the bug
+    is at the call site.
 """
 
 import ast
@@ -56,7 +65,12 @@ def _terminal(name: str) -> str:
 class SecretChecker:
     name = "secret"
     description = ("secret/share/private-key values flowing into logging, "
-                   "exception messages, or __repr__")
+                   "exception messages, or __repr__ (cross-function with "
+                   "the v2 engine)")
+    uses_project = True
+
+    def __init__(self):
+        self._project = None
 
     # -- taint predicates ----------------------------------------------------
 
@@ -69,6 +83,10 @@ class SecretChecker:
                 return None
             if _terminal(fname) in ("get_share", "load_share"):
                 return f"{fname}()"
+            if self._project is not None:
+                callee = self._project.resolve_call(module, node)
+                if callee is not None and callee.returns_secret:
+                    return f"{fname}()"
             for arg in list(node.args) + [kw.value for kw in node.keywords]:
                 hit = self._is_source(module, arg, tainted)
                 if hit:
@@ -143,7 +161,9 @@ class SecretChecker:
         return _terminal(recv) in ("log", "logger", "LOG", "DEFAULT") \
             or recv.endswith(".log")
 
-    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+    def check(self, module: ModuleInfo,
+              project=None) -> Iterator[Finding]:
+        self._project = project
         for cls, fn in module.functions():
             tainted = self._taint_pass(module, fn)
             for node in ast.walk(fn):
@@ -152,6 +172,9 @@ class SecretChecker:
                     is_print = isinstance(node.func, ast.Name) \
                         and node.func.id == "print"
                     if not (is_log or is_print):
+                        for finding in self._interproc_sink(module, node,
+                                                            tainted):
+                            yield finding
                         continue
                     for arg in list(node.args) \
                             + [kw.value for kw in node.keywords]:
@@ -194,3 +217,27 @@ class SecretChecker:
                                          " output"),
                                 path=module.rel, line=node.lineno,
                                 col=node.col_offset)
+
+    def _interproc_sink(self, module: ModuleInfo, node: ast.Call,
+                        tainted: Set[str]) -> Iterator[Finding]:
+        """v2 sink: a secret expression bound to a callee parameter the
+        callee's summary logs — the leak is one frame down, the bug is
+        at this call site."""
+        if self._project is None:
+            return
+        callee = self._project.resolve_call(module, node)
+        if callee is None or not callee.logged_params:
+            return
+        for param in sorted(callee.logged_params):
+            bound = callee.arg_param(node, param)
+            if bound is None:
+                continue
+            hit = self._is_source(module, bound, tainted)
+            if hit:
+                yield Finding(
+                    checker=self.name, code="secret-interproc-log",
+                    message=(f"secret-bearing value `{hit}` is passed as "
+                             f"`{param}` to {callee.display}, which logs "
+                             "that parameter"),
+                    path=module.rel, line=node.lineno,
+                    col=node.col_offset)

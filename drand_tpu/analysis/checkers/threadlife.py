@@ -1,0 +1,327 @@
+"""threadlife: every thread has a registered name and a stop path.
+
+PRs 7, 8, and 12 each hand-fixed the same leak: a service thread started
+in one place with no join reachable from the owner's `stop()`, found
+only when the chaos harness counted threads at teardown.  The checker
+moves that from runtime archaeology to lint time.
+
+Rules (non-test code only; pytest owns thread hygiene in tests):
+
+  * ``threadlife-unnamed`` — `threading.Thread(...)` without ``name=``:
+    an anonymous thread in a stack dump is unattributable.
+  * ``threadlife-unregistered-name`` — the static prefix of the name
+    (the literal part, for f-strings the leading literal) is not in the
+    project registry below.  The registry is the debugging contract:
+    `py-spy dump` output groups by these prefixes.
+  * ``threadlife-no-join`` — a thread stored on ``self`` whose ``join``
+    is not reachable from a stop root (`stop`/`close`/`shutdown`/
+    `terminate`/`abort`/`__exit__`) by walking intra-class `self.`
+    calls.  A class with a thread attribute and no stop root at all is
+    flagged too.
+  * ``threadlife-orphan`` — a fire-and-forget start: an unbound
+    `threading.Thread(...).start()`, or a local thread that is started
+    but never joined, returned, stored, or handed to another call.
+    Returning the thread transfers ownership to the caller — and with
+    the phase-1 project, a local assigned from a function whose summary
+    says ``returns_thread`` is held to the same rules as a local
+    constructed here.
+"""
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Set
+
+from ..core import Finding
+from ..symbols import ClassInfo, ModuleInfo, dotted, walk_scope
+
+THREAD_CTOR = "threading.Thread"
+
+STOP_ROOTS = {"stop", "close", "shutdown", "terminate", "abort", "__exit__"}
+
+# the project thread-name registry: every service thread's name starts
+# with one of these (py-spy/faulthandler dumps group by prefix)
+REGISTERED_PREFIXES = (
+    "rest-",            # http_server workers + edge
+    "thr-mon-",         # metrics threshold monitor
+    "metrics-",         # metrics exporter http
+    "relay-", "s3-", "http-",      # relay pumps + servers
+    "verify-",          # verify service scheduler/watchdog/probe
+    "aggregator", "watch-",        # chainstore/client aggregation
+    "sync-",            # sync manager + stream pump
+    "handel-",          # handel aggregation overlay
+    "ticker",           # round ticker
+    "handler-", "catchup-",        # beacon node
+    "callback-",        # store callback fan-out
+    "speed-test",       # optimizing client prober
+    "integrity-", "transition-",   # beacon process maintenance
+    "dkg-",             # DKG session/broadcast
+    "check-chain", "follow-",      # daemon utilities
+    "partial-",         # partial-signature send fan-out
+    "stop-",            # async stop trampolines
+    "loadgen-", "bench-",          # operator tools
+    "probe-",           # preflight probes
+)
+
+
+def _is_test_code(rel: str) -> bool:
+    base = os.path.basename(rel)
+    return base.startswith("test_") or base.endswith("_test.py") \
+        or rel.startswith("tests/") or "/tests/" in rel \
+        or base in ("conftest.py", "chaos.py")
+
+
+def _is_thread_ctor(module: ModuleInfo, node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) \
+        and module.resolve(dotted(node.func) or "") == THREAD_CTOR
+
+
+def _static_prefix(name_expr: ast.AST) -> Optional[str]:
+    """The literal leading part of a name expression; None when the name
+    is fully dynamic (flagged — a registry cannot match it)."""
+    if isinstance(name_expr, ast.Constant) and isinstance(name_expr.value,
+                                                          str):
+        return name_expr.value
+    if isinstance(name_expr, ast.JoinedStr) and name_expr.values:
+        head = name_expr.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value
+    return None
+
+
+class ThreadLifeChecker:
+    name = "threadlife"
+    description = ("threads must carry registered name prefixes and a "
+                   "join/stop path reachable from the owner's stop()/close()")
+    uses_project = True
+
+    def check(self, module: ModuleInfo,
+              project: Optional[object] = None) -> Iterator[Finding]:
+        if _is_test_code(module.rel):
+            return
+        yield from self._names(module)
+        for info in module.classes:
+            yield from self._join_paths(module, info)
+        for cls, fn in module.functions():
+            yield from self._orphans(module, fn, project)
+
+    # -- naming ---------------------------------------------------------------
+
+    def _names(self, module: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not _is_thread_ctor(module, node):
+                continue
+            name_expr = None
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    name_expr = kw.value
+            if name_expr is None:
+                yield Finding(
+                    checker=self.name, code="threadlife-unnamed",
+                    message=("threading.Thread(...) without name=; an "
+                             "anonymous thread in a py-spy dump is "
+                             "unattributable — use a registered prefix"),
+                    path=module.rel, line=node.lineno, col=node.col_offset)
+                continue
+            prefix = _static_prefix(name_expr)
+            if prefix is None or not any(
+                    prefix.startswith(p) for p in REGISTERED_PREFIXES):
+                shown = prefix if prefix is not None else "<dynamic>"
+                yield Finding(
+                    checker=self.name, code="threadlife-unregistered-name",
+                    message=(f"thread name `{shown}...` does not start "
+                             "with a registered prefix (see "
+                             "analysis/checkers/threadlife.py registry)"),
+                    path=module.rel, line=node.lineno, col=node.col_offset)
+
+    # -- join reachability ----------------------------------------------------
+
+    def _join_paths(self, module: ModuleInfo,
+                    info: ClassInfo) -> Iterator[Finding]:
+        thread_attrs = [a for a, k in info.attr_kinds.items()
+                        if k == "thread"]
+        if not thread_attrs:
+            return
+        # method -> methods it calls via self.
+        edges: Dict[str, Set[str]] = {}
+        join_sites: Dict[str, Set[str]] = {}     # attr -> methods joining it
+        for mname, fn in info.methods.items():
+            edges[mname] = set()
+            # local -> thread attrs it may alias.  Collected BEFORE the
+            # join scan (walk order is not source order) and through the
+            # idioms the codebase actually uses: plain `t = self._thread`,
+            # the swap `t, self._thread = self._thread, None`, and a
+            # for-loop over a collection holding aliases
+            # (`for t in threads + [wd, probe]: t.join(...)`).
+            aliases: Dict[str, Set[str]] = {}
+
+            def note_alias(target: ast.AST, value: ast.AST) -> None:
+                if not isinstance(target, ast.Name):
+                    return
+                d = dotted(value) or ""
+                if d.startswith("self.") and d.count(".") == 1 \
+                        and d[5:] in thread_attrs:
+                    aliases.setdefault(target.id, set()).add(d[5:])
+
+            for _ in range(2):       # second pass closes alias-of-alias
+                for node in walk_scope(fn):
+                    if isinstance(node, ast.Assign):
+                        for t in node.targets:
+                            if isinstance(t, ast.Tuple) \
+                                    and isinstance(node.value, ast.Tuple) \
+                                    and len(t.elts) == len(node.value.elts):
+                                for te, ve in zip(t.elts, node.value.elts):
+                                    note_alias(te, ve)
+                            else:
+                                note_alias(t, node.value)
+                    elif isinstance(node, ast.For) \
+                            and isinstance(node.target, ast.Name):
+                        hit: Set[str] = set()
+                        for sub in ast.walk(node.iter):
+                            if isinstance(sub, ast.Name) \
+                                    and sub.id in aliases:
+                                hit |= aliases[sub.id]
+                            d = dotted(sub) or ""
+                            if d.startswith("self.") and d.count(".") == 1 \
+                                    and d[5:] in thread_attrs:
+                                hit.add(d[5:])
+                        if hit:
+                            aliases.setdefault(node.target.id,
+                                               set()).update(hit)
+            for node in walk_scope(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func) or ""
+                if d.startswith("self.") and d.count(".") == 1 \
+                        and d[5:] in info.methods:
+                    edges[mname].add(d[5:])
+                if d.endswith(".join"):
+                    recv = d[:-len(".join")]
+                    attrs: Set[str] = set()
+                    if recv.startswith("self.") and recv.count(".") == 1 \
+                            and recv[5:] in thread_attrs:
+                        attrs.add(recv[5:])
+                    attrs |= aliases.get(recv, set())
+                    for attr in attrs:
+                        join_sites.setdefault(attr, set()).add(mname)
+        roots = [m for m in STOP_ROOTS if m in info.methods]
+        reachable: Set[str] = set(roots)
+        frontier = list(roots)
+        while frontier:
+            cur = frontier.pop()
+            for nxt in edges.get(cur, ()):
+                if nxt not in reachable:
+                    reachable.add(nxt)
+                    frontier.append(nxt)
+        for attr in sorted(thread_attrs):
+            line, col = info.node.lineno, info.node.col_offset
+            for mname, fn in info.methods.items():
+                for node in walk_scope(fn):
+                    if isinstance(node, ast.Assign) \
+                            and _is_thread_ctor(module, node.value) \
+                            and any((dotted(t) or "") == f"self.{attr}"
+                                    for t in node.targets):
+                        line, col = node.lineno, node.col_offset
+            if not roots:
+                yield Finding(
+                    checker=self.name, code="threadlife-no-join",
+                    message=(f"class {info.name} owns thread `self.{attr}` "
+                             "but has no stop()/close()/shutdown() method "
+                             "to join it from"),
+                    path=module.rel, line=line, col=col)
+            elif not (join_sites.get(attr, set()) & reachable):
+                yield Finding(
+                    checker=self.name, code="threadlife-no-join",
+                    message=(f"thread `self.{attr}` of {info.name} has no "
+                             "join reachable from "
+                             f"{'/'.join(sorted(roots))}() — the PR 7/8/12 "
+                             "leak class"),
+                    path=module.rel, line=line, col=col)
+
+    # -- orphans --------------------------------------------------------------
+
+    def _orphans(self, module: ModuleInfo, fn: ast.AST,
+                 project) -> Iterator[Finding]:
+        def is_threadish(value: ast.AST) -> bool:
+            if _is_thread_ctor(module, value):
+                return True
+            if project is not None and isinstance(value, ast.Call):
+                callee = project.resolve_call(module, value)
+                if callee is not None and callee.returns_thread:
+                    return True
+            return False
+
+        locals_: Dict[str, ast.AST] = {}
+        list_locals: Set[str] = set()
+        started: Set[str] = set()
+        released: Set[str] = set()     # joined / returned / stored / passed
+        any_join = False
+        # pass 1 — bind thread locals (walk order is not source order, so
+        # a use must never be judged before its binding is seen)
+        for node in walk_scope(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tgt = node.targets[0].id
+                if is_threadish(node.value):
+                    locals_[tgt] = node
+                elif isinstance(node.value, (ast.ListComp, ast.List)):
+                    elts = node.value.elts \
+                        if isinstance(node.value, ast.List) \
+                        else [node.value.elt]
+                    if any(is_threadish(e) for e in elts):
+                        list_locals.add(tgt)
+        # pass 2 — starts, joins, ownership transfers
+        for node in walk_scope(fn):
+            if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+                call = node.value
+                if isinstance(call.func, ast.Attribute) \
+                        and call.func.attr == "start" \
+                        and is_threadish(call.func.value):
+                    yield Finding(
+                        checker=self.name, code="threadlife-orphan",
+                        message=("fire-and-forget threading.Thread(...)"
+                                 ".start(); bind the thread and join it, "
+                                 "or hand it to an owner with a stop path"),
+                        path=module.rel, line=node.lineno,
+                        col=node.col_offset)
+            if isinstance(node, ast.Call):
+                d = dotted(node.func) or ""
+                base, _, meth = d.rpartition(".")
+                if meth == "start" and base in locals_:
+                    started.add(base)
+                elif meth == "join":
+                    any_join = True
+                    if base in locals_:
+                        released.add(base)
+                # a local handed to any other call transfers ownership
+                for arg in list(node.args) \
+                        + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id in locals_:
+                        released.add(arg.id)
+            if isinstance(node, ast.Return) and node.value is not None:
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Name) and sub.id in locals_:
+                        released.add(sub.id)
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        for sub in ast.walk(node.value):
+                            if isinstance(sub, ast.Name) \
+                                    and sub.id in locals_:
+                                released.add(sub.id)
+        for name in sorted(started - released):
+            node = locals_[name]
+            yield Finding(
+                checker=self.name, code="threadlife-orphan",
+                message=(f"local thread `{name}` is started but never "
+                         "joined, returned, or stored — nothing can stop "
+                         "or await it"),
+                path=module.rel, line=node.lineno, col=node.col_offset)
+        if list_locals and not any_join:
+            node = fn
+            yield Finding(
+                checker=self.name, code="threadlife-orphan",
+                message=(f"thread list(s) {sorted(list_locals)} built in "
+                         f"{getattr(fn, 'name', '?')}() with no join "
+                         "anywhere in the function"),
+                path=module.rel, line=getattr(fn, "lineno", 1),
+                col=getattr(fn, "col_offset", 0))

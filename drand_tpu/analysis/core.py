@@ -2,9 +2,19 @@
 
 Checker-facing contract: a checker is an object with a ``name`` (the
 suppression token), a ``description``, and ``check(module) ->
-Iterable[Finding]`` where ``module`` is a `symbols.ModuleInfo`.  The
-framework owns everything around that — which files are scanned, which
-findings are suppressed or baselined, and how the result is rendered.
+Iterable[Finding]`` where ``module`` is a `symbols.ModuleInfo`.
+Interprocedural checkers additionally set ``uses_project = True`` and
+accept ``check(module, project)`` where ``project`` is the phase-1
+`project.Project` built over ALL scanned files — call graph, cross-
+module resolution, return-taint/deadline summaries.  The framework owns
+everything around that — which files are scanned, which findings are
+suppressed or baselined, and how the result is rendered.
+
+The run is two-phase: every file is parsed FIRST (phase 1, building the
+Project), then checkers run per file (phase 2).  ``context_paths`` adds
+files to phase 1 only — they inform cross-module resolution but are
+never themselves checked or reported, which is what makes ``--changed``
+incremental runs interprocedurally honest.
 
 Finding identity (the baseline key) is deliberately line-free:
 ``path|checker|code|message``.  Messages therefore name symbols, not
@@ -84,6 +94,47 @@ class Report:
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    def to_sarif(self) -> str:
+        """SARIF 2.1.0 — one run, one rule per checker/code pair, so CI
+        diff-annotation tooling can ingest the findings directly."""
+        rules: Dict[str, dict] = {}
+        results = []
+        for f in sorted(self.findings,
+                        key=lambda f: (f.path, f.line, f.code)):
+            rule_id = f"tpu-vet/{f.code}"
+            rules.setdefault(rule_id, {
+                "id": rule_id,
+                "name": f.code,
+                "properties": {"checker": f.checker},
+            })
+            results.append({
+                "ruleId": rule_id,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": max(f.line, 1),
+                                   "startColumn": max(f.col, 0) + 1},
+                    },
+                }],
+            })
+        doc = {
+            "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                        "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+            "version": "2.1.0",
+            "runs": [{
+                "tool": {"driver": {
+                    "name": "tpu-vet",
+                    "informationUri": "https://example.invalid/tpu-vet",
+                    "rules": sorted(rules.values(),
+                                    key=lambda r: r["id"]),
+                }},
+                "results": results,
+            }],
+        }
+        return json.dumps(doc, indent=2, sort_keys=True)
 
     def render_text(self) -> str:
         lines = [f.render() for f in sorted(
@@ -204,39 +255,76 @@ def _iter_files(path: str, excludes: Sequence[str]):
             yield full, _package_rel(full) or os.path.relpath(full, path)
 
 
-def run_vet(paths: Sequence[str], checkers: Optional[Iterable] = None,
-            baseline: Optional[Dict[str, int]] = None,
-            excludes: Sequence[str] = DEFAULT_EXCLUDES) -> Report:
-    """Run `checkers` (default: all five) over every .py file under
-    `paths` and split raw findings into actionable / suppressed /
-    baselined."""
-    if checkers is None:
-        from .checkers import ALL_CHECKERS
-        checkers = [c() for c in ALL_CHECKERS]
-    report = Report()
-    budget = dict(baseline or {})
+def _parse_tree(paths: Sequence[str], excludes: Sequence[str],
+                errors: Optional[List[str]] = None) -> List[ModuleInfo]:
+    """Phase-1 parse of every .py under `paths` (dedup by abspath)."""
+    modules: List[ModuleInfo] = []
+    seen_paths = set()
     for root in paths:
         for full, rel in _iter_files(root, excludes):
-            report.files += 1
+            if full in seen_paths:
+                continue
+            seen_paths.add(full)
             try:
                 with open(full, "r", encoding="utf-8") as f:
                     source = f.read()
-                module = ModuleInfo(full, rel, source)
+                modules.append(ModuleInfo(full, rel, source))
             except (SyntaxError, UnicodeDecodeError, OSError) as e:
-                report.errors.append(f"{rel}: {e}")
-                continue
-            supp = Suppressions(module.lines)
-            seen = set()        # nested defs are walked by both their own
-            for checker in checkers:    # pass and the enclosing one
-                for finding in checker.check(module):
-                    if finding in seen:
-                        continue
-                    seen.add(finding)
-                    if supp.covers(finding):
-                        report.suppressed.append(finding)
-                    elif budget.get(finding.key, 0) > 0:
-                        budget[finding.key] -= 1
-                        report.baselined.append(finding)
-                    else:
-                        report.findings.append(finding)
+                if errors is not None:
+                    errors.append(f"{rel}: {e}")
+    return modules
+
+
+def run_vet(paths: Sequence[str], checkers: Optional[Iterable] = None,
+            baseline: Optional[Dict[str, int]] = None,
+            excludes: Sequence[str] = DEFAULT_EXCLUDES,
+            context_paths: Sequence[str] = ()) -> Report:
+    """Run `checkers` (default: all registered) over every .py file under
+    `paths` and split raw findings into actionable / suppressed /
+    baselined.
+
+    Two-phase: all files parse first and feed the project-wide call
+    graph; then checkers run per file.  Files under `context_paths` join
+    phase 1 (cross-module resolution sees them) but are never checked —
+    the incremental `--changed` mode passes the full package there so a
+    two-file diff is still judged against the whole call graph.
+    """
+    if checkers is None:
+        from .checkers import ALL_CHECKERS
+        checkers = [c() for c in ALL_CHECKERS]
+    else:
+        checkers = list(checkers)
+    report = Report()
+    budget = dict(baseline or {})
+
+    modules = _parse_tree(paths, excludes, report.errors)
+    report.files = len(modules) + len(report.errors)
+    checked_paths = {m.path for m in modules}
+    context = [m for m in _parse_tree(context_paths, excludes)
+               if m.path not in checked_paths]
+
+    project = None
+    if any(getattr(c, "uses_project", False) for c in checkers):
+        from .project import Project
+        project = Project(modules + context)
+
+    for module in modules:
+        supp = Suppressions(module.lines)
+        seen = set()            # nested defs are walked by both their own
+        for checker in checkers:        # pass and the enclosing one
+            if getattr(checker, "uses_project", False):
+                found = checker.check(module, project)
+            else:
+                found = checker.check(module)
+            for finding in found:
+                if finding in seen:
+                    continue
+                seen.add(finding)
+                if supp.covers(finding):
+                    report.suppressed.append(finding)
+                elif budget.get(finding.key, 0) > 0:
+                    budget[finding.key] -= 1
+                    report.baselined.append(finding)
+                else:
+                    report.findings.append(finding)
     return report

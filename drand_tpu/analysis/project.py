@@ -1,0 +1,499 @@
+"""Phase 1 of the two-phase tpu-vet engine: the project-wide view.
+
+`symbols.ModuleInfo` answers questions about ONE file; this module joins
+every scanned file into a `Project` — a cross-module symbol table, a
+call graph, and per-function summaries — so phase-2 checkers can follow
+a value across a call boundary: a share flowing through a helper into a
+log line, a `time.time()` value returned by a utility and consumed as a
+deadline, a blocking RPC whose timeout parameter no caller ever threads.
+Both failure shapes burned real campaigns (r06's 42 hung probes, the
+PRs 7/8/12 thread leaks) and are invisible to a per-function pass.
+
+Resolution is deliberately name-shaped, like everything else in this
+framework: imports are rewritten through each module's import table
+(`ModuleInfo.resolve`), then matched against module dotted paths by
+suffix, so `from ..net import client` and `from drand_tpu.net import
+client` meet at the same `net/client.py` entry.  `self.method()` resolves
+through the enclosing class; `self.attr.method()` through the class's
+typed attribute constructors.  Anything unresolvable is simply absent
+from the graph — summaries only ever ADD findings a per-function pass
+misses, never suppress one.
+
+Summaries (computed to a fixed point over the call graph):
+
+  * ``returns_secret``    — the function returns key material (or the
+    result of a function that does).
+  * ``returns_wallclock`` — the function returns a raw ``time.time()/
+    monotonic()`` value (or launders one through another function).
+  * ``returns_thread``    — the function hands ownership of a
+    ``threading.Thread`` to its caller.
+  * ``jit_factory``       — the function returns a ``jax.jit(...)``
+    product (each call is a fresh program flavor).
+  * ``logged_params``     — parameters whose values reach a log/print
+    sink inside the function.
+  * ``required_deadline`` — ``timeout/deadline/budget`` parameters that
+    default to None and flow BARE (no ``or``-fallback, no None-guard)
+    into a blocking primitive or a callee's required deadline — callers
+    that omit them run unbounded.
+  * ``static_args``       — static argument names/positions of jitted
+    definitions (cache-key slots for the ``recompile`` checker).
+
+The taxonomies shared with the per-function checkers (secret
+identifiers, log sinks, wall-clock calls, blocking primitives) live HERE
+and the checkers import them, so phase-1 summaries and phase-2 matching
+cannot drift apart.
+"""
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .symbols import ClassInfo, ModuleInfo, dotted, walk_scope
+
+# -- shared taxonomies (checkers import these) -------------------------------
+
+SECRET_IDS = re.compile(
+    r"^(secret|secrets|sk|pri_key|private|private_key|secret_key|"
+    r"longterm|share|_share|new_share|old_share|dist_share)$")
+SAFE_IDS = {"secret_proof", "share_index", "sharemap", "shares_total"}
+SANITIZERS = {"hash_secret", "len", "type", "bool", "id", "index_of"}
+SECRET_GETTERS = {"get_share", "load_share"}
+LOG_METHODS = {"debug", "info", "warn", "warning", "error", "exception",
+               "critical", "rate_limited_info"}
+LOG_RECEIVERS = ("log", "logger", "LOG", "DEFAULT")
+
+WALLCLOCK_CALLS = {"time.time", "time.time_ns",
+                   "time.monotonic", "time.monotonic_ns"}
+
+JIT_NAMES = {"jit", "jax.jit", "pjit", "jax.pjit"}
+
+THREAD_CTOR = "threading.Thread"
+
+# timeout/deadline/budget-shaped parameter names (the deadline checker's
+# threading contract keys on these)
+DEADLINE_PARAM = re.compile(
+    r"(^|_)(timeout|deadline|budget|wait|ttl)(_|$)|"
+    r"(timeout|deadline|budget)s?$")
+
+# blocking primitives that default to "forever": resolved qualname ->
+# (timeout kwarg name, positional index of that timeout, or None)
+BLOCKING_CALLS = {
+    "subprocess.run": ("timeout", None),
+    "subprocess.call": ("timeout", None),
+    "subprocess.check_call": ("timeout", None),
+    "subprocess.check_output": ("timeout", None),
+    "urllib.request.urlopen": ("timeout", 2),
+    "socket.create_connection": ("timeout", 1),
+}
+# method-shaped blocking calls (receiver type unknowable to an AST pass;
+# these names are unambiguous in practice — Popen.communicate)
+BLOCKING_METHODS = {
+    "communicate": ("timeout", 0),
+}
+
+
+def is_log_call(node: ast.Call) -> bool:
+    """Logger-style sink: `.debug/.info/...` on a log-ish receiver."""
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    if node.func.attr not in LOG_METHODS:
+        return False
+    recv = dotted(node.func.value) or ""
+    return recv.rsplit(".", 1)[-1] in LOG_RECEIVERS or recv.endswith(".log")
+
+
+def blocking_call(module: ModuleInfo, node: ast.Call
+                  ) -> Optional[Tuple[str, Optional[ast.AST]]]:
+    """(label, timeout-value-expr-or-None) when `node` is a recognized
+    blocking primitive; None otherwise.  An explicit ``timeout=None``
+    counts as absent."""
+    qual = module.resolve(dotted(node.func) or "")
+    spec = BLOCKING_CALLS.get(qual)
+    label = qual
+    if spec is None and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in BLOCKING_METHODS:
+        spec = BLOCKING_METHODS[node.func.attr]
+        label = f".{node.func.attr}()"
+    if spec is None:
+        return None
+    kwarg, pos = spec
+    expr = None
+    for kw in node.keywords:
+        if kw.arg == kwarg:
+            expr = kw.value
+    if expr is None and pos is not None and len(node.args) > pos:
+        expr = node.args[pos]
+    if isinstance(expr, ast.Constant) and expr.value is None:
+        expr = None
+    return label, expr
+
+
+# -- per-function summary -----------------------------------------------------
+
+
+@dataclass
+class FunctionSummary:
+    module: ModuleInfo
+    cls: Optional[ClassInfo]
+    node: ast.AST
+    qual: str                        # "fname" or "Class.method"
+    params: List[str] = field(default_factory=list)
+    defaults: Dict[str, ast.AST] = field(default_factory=dict)
+    returns_secret: bool = False
+    returns_wallclock: bool = False
+    returns_thread: bool = False
+    jit_factory: bool = False
+    logged_params: Set[str] = field(default_factory=set)
+    required_deadline: Set[str] = field(default_factory=set)
+    static_args: Dict[str, int] = field(default_factory=dict)
+    # resolved call sites inside this function: (call node, callee key)
+    calls: List[Tuple[ast.Call, Optional[Tuple[str, str]]]] = \
+        field(default_factory=list)
+
+    @property
+    def rel(self) -> str:
+        return self.module.rel
+
+    @property
+    def display(self) -> str:
+        return f"{self.rel}::{self.qual}"
+
+    def arg_param(self, call: ast.Call, param: str) -> Optional[ast.AST]:
+        """The expression a call site binds to `param`, or None if the
+        call omits it (keyword, or positional with `self` accounted)."""
+        for kw in call.keywords:
+            if kw.arg == param:
+                return kw.value
+        try:
+            idx = self.params.index(param)
+        except ValueError:
+            return None
+        if self.cls is not None and self.params[:1] == ["self"]:
+            idx -= 1                       # bound call: self not at the site
+        if 0 <= idx < len(call.args):
+            arg = call.args[idx]
+            return None if isinstance(arg, ast.Starred) else arg
+        return None
+
+
+class Project:
+    """The project-wide call graph + summaries (phase 1)."""
+
+    def __init__(self, modules: Iterable[ModuleInfo]):
+        self.modules: List[ModuleInfo] = list(modules)
+        self.functions: Dict[Tuple[str, str], FunctionSummary] = {}
+        self._dotted: Dict[str, List[ModuleInfo]] = {}
+        for m in self.modules:
+            self._dotted.setdefault(m.dotted, []).append(m)
+        self._collect()
+        self._resolve_calls()
+        self._summarize()
+
+    # -- construction --------------------------------------------------------
+
+    def _collect(self) -> None:
+        for m in self.modules:
+            for qual, (cls, fn) in m.defs_by_qual().items():
+                args = fn.args
+                params = [a.arg for a in args.posonlyargs + args.args]
+                kw_params = [a.arg for a in args.kwonlyargs]
+                s = FunctionSummary(module=m, cls=cls, node=fn, qual=qual,
+                                    params=params + kw_params)
+                pos_defaults = args.defaults
+                for name, d in zip(params[len(params) - len(pos_defaults):],
+                                   pos_defaults):
+                    s.defaults[name] = d
+                for name, d in zip(kw_params, args.kw_defaults):
+                    if d is not None:
+                        s.defaults[name] = d
+                s.static_args = self._static_args(m, fn, params)
+                self.functions[(m.rel, qual)] = s
+
+    def _static_args(self, m: ModuleInfo, fn: ast.AST,
+                     params: List[str]) -> Dict[str, int]:
+        """static_argnums/static_argnames of a jit-decorated def."""
+        out: Dict[str, int] = {}
+        for dec in getattr(fn, "decorator_list", ()):
+            call = dec if isinstance(dec, ast.Call) else None
+            if call is None:
+                continue
+            head = m.resolve(dotted(call.func) or "")
+            if head not in JIT_NAMES and not (
+                    head.endswith("partial") and call.args
+                    and m.resolve(dotted(call.args[0]) or "") in JIT_NAMES):
+                continue
+            for kw in call.keywords:
+                if kw.arg == "static_argnums":
+                    vals = kw.value.elts \
+                        if isinstance(kw.value, (ast.Tuple, ast.List)) \
+                        else [kw.value]
+                    for e in vals:
+                        if isinstance(e, ast.Constant) \
+                                and isinstance(e.value, int) \
+                                and 0 <= e.value < len(params):
+                            out[params[e.value]] = e.value
+                elif kw.arg == "static_argnames":
+                    vals = kw.value.elts \
+                        if isinstance(kw.value, (ast.Tuple, ast.List)) \
+                        else [kw.value]
+                    for e in vals:
+                        if isinstance(e, ast.Constant) \
+                                and str(e.value) in params:
+                            out[str(e.value)] = params.index(str(e.value))
+        return out
+
+    # -- cross-module resolution ---------------------------------------------
+
+    def _module_for(self, modname: str) -> Optional[ModuleInfo]:
+        """Match a dotted module path by suffix; the package prefix of an
+        absolute import ("drand_tpu.net.client") and the anchored rel
+        ("net.client") meet here."""
+        hit = self._dotted.get(modname)
+        if hit:
+            return hit[0]
+        for d, mods in self._dotted.items():
+            if d.endswith("." + modname) or modname.endswith("." + d):
+                return mods[0]
+        return None
+
+    def _lookup(self, module: ModuleInfo, name: str
+                ) -> Optional[Tuple[str, str]]:
+        """Resolve a dotted symbol (already import-rewritten) to a
+        (rel, qual) function key."""
+        if not name:
+            return None
+        if (module.rel, name) in self.functions:      # local fn / Cls.meth
+            return (module.rel, name)
+        parts = name.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            m2 = self._module_for(".".join(parts[:i]))
+            if m2 is None:
+                continue
+            qual = ".".join(parts[i:])
+            if (m2.rel, qual) in self.functions:
+                return (m2.rel, qual)
+        return None
+
+    def resolve_call(self, module: ModuleInfo, call: ast.Call,
+                     cls: Optional[ClassInfo] = None
+                     ) -> Optional[FunctionSummary]:
+        """The FunctionSummary a call site dispatches to, if the name
+        analysis can prove one."""
+        d = dotted(call.func)
+        if d is None:
+            return None
+        if cls is None:
+            cls = module.enclosing_class(call)
+        if d.startswith("self.") and cls is not None:
+            parts = d.split(".")
+            if len(parts) == 2:                       # self.method()
+                key = self._lookup(module, f"{cls.name}.{parts[1]}")
+                return self.functions.get(key) if key else None
+            if len(parts) == 3:                       # self.attr.method()
+                ctor = cls.attr_ctors.get(parts[1], "")
+                key = self._lookup(module, f"{ctor}.{parts[2]}") \
+                    if ctor else None
+                return self.functions.get(key) if key else None
+            return None
+        key = self._lookup(module, module.resolve(d))
+        return self.functions.get(key) if key else None
+
+    def _resolve_calls(self) -> None:
+        for s in self.functions.values():
+            for node in walk_scope(s.node):
+                if isinstance(node, ast.Call):
+                    callee = self.resolve_call(s.module, node, s.cls)
+                    s.calls.append(
+                        (node, (callee.rel, callee.qual) if callee else None))
+
+    def callee(self, key: Optional[Tuple[str, str]]
+               ) -> Optional[FunctionSummary]:
+        return self.functions.get(key) if key else None
+
+    # -- summaries ------------------------------------------------------------
+
+    def _summarize(self) -> None:
+        for s in self.functions.values():
+            s.logged_params = self._logged_params(s)
+        # return-taint + deadline fixed point: a pass can only flip flags
+        # from False to True, so iteration is monotone and converges
+        for _ in range(4):
+            changed = False
+            for s in self.functions.values():
+                changed |= self._return_taint(s)
+                changed |= self._deadline_pass(s)
+            if not changed:
+                break
+
+    # names whose values flow into this expression (through containers,
+    # f-strings, binops and non-sanitizer calls)
+    def _flowing_names(self, node: ast.AST, out: Set[str]) -> None:
+        if isinstance(node, ast.Call):
+            fname = dotted(node.func) or ""
+            if fname.rsplit(".", 1)[-1] in SANITIZERS:
+                return
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                self._flowing_names(a, out)
+        elif isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    self._flowing_names(v.value, out)
+        elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            for e in node.elts:
+                self._flowing_names(e, out)
+        elif isinstance(node, ast.Dict):
+            for v in node.values:
+                self._flowing_names(v, out)
+        elif isinstance(node, ast.BinOp):
+            self._flowing_names(node.left, out)
+            self._flowing_names(node.right, out)
+        elif isinstance(node, ast.Name):
+            out.add(node.id)
+
+    def _logged_params(self, s: FunctionSummary) -> Set[str]:
+        params = set(s.params) - {"self"}
+        hit: Set[str] = set()
+        for node in walk_scope(s.node):
+            if not isinstance(node, ast.Call):
+                continue
+            is_print = isinstance(node.func, ast.Name) \
+                and node.func.id == "print"
+            if not (is_print or is_log_call(node)):
+                continue
+            names: Set[str] = set()
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                self._flowing_names(a, names)
+            hit |= names & params
+        return hit
+
+    def _secretish(self, module: ModuleInfo, node: ast.AST) -> bool:
+        """Is this return expression secret-bearing?  Terminal-identifier
+        match, a known getter, or a call into a returns_secret summary."""
+        if isinstance(node, ast.Call):
+            fname = dotted(node.func) or ""
+            leaf = fname.rsplit(".", 1)[-1]
+            if leaf in SANITIZERS:
+                return False
+            if leaf in SECRET_GETTERS:
+                return True
+            callee = self.resolve_call(module, node)
+            if callee is not None and callee.returns_secret:
+                return True
+            return False
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            d = dotted(node) or ""
+            term = d.rsplit(".", 1)[-1]
+            return term not in SAFE_IDS and bool(SECRET_IDS.match(term))
+        if isinstance(node, ast.Tuple):
+            return any(self._secretish(module, e) for e in node.elts)
+        return False
+
+    def _wallclockish(self, module: ModuleInfo, node: ast.AST,
+                      tainted: Set[str]) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                qual = module.resolve(dotted(sub.func) or "")
+                if qual in WALLCLOCK_CALLS:
+                    return True
+                callee = self.resolve_call(module, sub)
+                if callee is not None and callee.returns_wallclock:
+                    return True
+            elif isinstance(sub, ast.Name) and sub.id in tainted:
+                return True
+        return False
+
+    def _threadish(self, module: ModuleInfo, node: ast.AST,
+                   tainted: Set[str]) -> bool:
+        if isinstance(node, ast.Call):
+            if module.resolve(dotted(node.func) or "") == THREAD_CTOR:
+                return True
+            callee = self.resolve_call(module, node)
+            return callee is not None and callee.returns_thread
+        return isinstance(node, ast.Name) and node.id in tainted
+
+    def _return_taint(self, s: FunctionSummary) -> bool:
+        """One monotone pass over s's returns; True when a flag flipped."""
+        m = s.module
+        clock_taint: Set[str] = set()
+        thread_taint: Set[str] = set()
+        for node in walk_scope(s.node):      # one assignment hop
+            if isinstance(node, ast.Assign):
+                names = [t.id for t in node.targets
+                         if isinstance(t, ast.Name)]
+                if names and self._wallclockish(m, node.value, clock_taint):
+                    clock_taint.update(names)
+                if names and self._threadish(m, node.value, thread_taint):
+                    thread_taint.update(names)
+        changed = False
+        for node in walk_scope(s.node):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            v = node.value
+            if not s.returns_secret and self._secretish(m, v):
+                s.returns_secret = changed = True
+            if not s.returns_wallclock \
+                    and self._wallclockish(m, v, clock_taint):
+                s.returns_wallclock = changed = True
+            if not s.returns_thread and self._threadish(m, v, thread_taint):
+                s.returns_thread = changed = True
+            if not s.jit_factory and isinstance(v, ast.Call) \
+                    and m.resolve(dotted(v.func) or "") in JIT_NAMES:
+                s.jit_factory = changed = True
+        return changed
+
+    # -- deadline threading ---------------------------------------------------
+
+    @staticmethod
+    def _has_fallback(fn: ast.AST, param: str) -> bool:
+        """`p or default`, `if p is None`, or a reassignment of p — the
+        function bounds itself, callers need not thread the deadline."""
+        for node in walk_scope(fn):
+            if isinstance(node, ast.BoolOp) and isinstance(node.op, ast.Or):
+                if any(isinstance(v, ast.Name) and v.id == param
+                       for v in node.values):
+                    return True
+            elif isinstance(node, ast.Compare) \
+                    and isinstance(node.left, ast.Name) \
+                    and node.left.id == param \
+                    and any(isinstance(op, (ast.Is, ast.IsNot))
+                            for op in node.ops):
+                return True
+            elif isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name) and t.id == param
+                            for t in node.targets):
+                return True
+        return False
+
+    def _deadline_pass(self, s: FunctionSummary) -> bool:
+        candidates = [
+            p for p in s.params
+            if p not in s.required_deadline and DEADLINE_PARAM.search(p)
+            and isinstance(s.defaults.get(p), ast.Constant)
+            and s.defaults[p].value is None]
+        if not candidates:
+            return False
+        changed = False
+        for p in candidates:
+            if self._has_fallback(s.node, p):
+                continue
+            if self._param_reaches_blocking(s, p):
+                s.required_deadline.add(p)
+                changed = True
+        return changed
+
+    def _param_reaches_blocking(self, s: FunctionSummary, p: str) -> bool:
+        for call, key in s.calls:
+            info = blocking_call(s.module, call)
+            if info is not None:
+                _, expr = info
+                if isinstance(expr, ast.Name) and expr.id == p:
+                    return True
+            callee = self.callee(key)
+            if callee is None:
+                continue
+            for req in callee.required_deadline:
+                bound = callee.arg_param(call, req)
+                if isinstance(bound, ast.Name) and bound.id == p:
+                    return True
+        return False

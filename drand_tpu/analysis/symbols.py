@@ -60,6 +60,20 @@ class ClassInfo:
         return [a for a, k in self.attr_kinds.items() if k in LOCK_KINDS]
 
 
+def walk_scope(fn: ast.AST):
+    """Walk a function's OWN body without descending into nested function
+    definitions — each nested def is its own scope (a jitted nested `run`
+    must not be judged by its enclosing factory's rules, a closure's
+    returns are not the factory's returns)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
 class ModuleInfo:
     """One parsed file + the symbol facts checkers share."""
 
@@ -74,6 +88,31 @@ class ModuleInfo:
         self.classes: List[ClassInfo] = []
         self.module_defs: set = set()      # top-level def/class/assign names
         self._build()
+
+    @property
+    def dotted(self) -> str:
+        """Module path as a dotted name relative to the scanned root
+        ("net/client.py" -> "net.client", "crypto/__init__.py" ->
+        "crypto") — the key the project-wide symbol table matches import
+        targets against (by suffix, so absolute and relative spellings of
+        the same module meet at one entry)."""
+        rel = self.rel[:-3] if self.rel.endswith(".py") else self.rel
+        if rel.endswith("/__init__"):
+            rel = rel[:-len("/__init__")]
+        return rel.replace("/", ".")
+
+    def defs_by_qual(self) -> Dict[str, Tuple[Optional[ClassInfo], ast.AST]]:
+        """Project-addressable definitions: top-level functions by name,
+        class methods as "Class.method".  Nested defs are closures — not
+        addressable across modules — and stay out."""
+        out: Dict[str, Tuple[Optional[ClassInfo], ast.AST]] = {}
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[node.name] = (None, node)
+        for info in self.classes:
+            for mname, fn in info.methods.items():
+                out[f"{info.name}.{mname}"] = (info, fn)
+        return out
 
     # -- construction --------------------------------------------------------
 

@@ -271,7 +271,11 @@ class Handler:
         # we are not part of the new group: leave the network (outside
         # the lock — stop() joins the very threads that may be parked on
         # _maybe_transition's lock right now)
-        threading.Thread(target=self.stop, daemon=True).start()
+        # intentional fire-and-forget: the trampoline's whole job is to
+        # run stop() outside this lock, and stop() joins every owned thread
+        # tpu-vet: disable=threadlife
+        threading.Thread(target=self.stop, daemon=True,
+                         name="stop-async-node").start()
 
     def broadcast_next_partial(self, last: Beacon) -> None:
         """Sign our partial for last.round+1 and fan it out

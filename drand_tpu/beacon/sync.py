@@ -687,6 +687,11 @@ class _IdleTimeoutIter:
         """Consumer is done with the stream: stop the pump + cancel the RPC."""
         self._dead = True
         self._cancel()
+        # the pump exits within one queue-put timeout of _dead flipping;
+        # bounded join so a close() during teardown reaps it
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2)
 
     def _cancel(self):
         cancel = getattr(self._source, "cancel", None)

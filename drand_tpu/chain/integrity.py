@@ -207,8 +207,9 @@ class IntegrityScanner:
             raise ValueError(f"unknown scan mode {mode!r}")
         if mode == MODE_FULL and self.verifier is None:
             raise ValueError("full-mode scan needs a verifier")
-        vkind = verifier_kind(self.verifier) if mode == MODE_FULL else "none"
-        report = ScanReport(mode=mode, verifier=vkind)
+        vfy_kind = (verifier_kind(self.verifier)
+                    if mode == MODE_FULL else "none")
+        report = ScanReport(mode=mode, verifier=vfy_kind)
 
         try:
             head = self.store.last().round
@@ -252,7 +253,7 @@ class IntegrityScanner:
                 buf_prevs.clear()
             if unflushed:
                 integrity_beacons_scanned.labels(
-                    self.beacon_id, vkind, self.trigger).inc(unflushed)
+                    self.beacon_id, vfy_kind, self.trigger).inc(unflushed)
                 unflushed = 0
             # watermark: commit only while the scan is STILL clean — the
             # first finding freezes the checkpoint at the previous flush,

@@ -92,6 +92,12 @@ class WatchAggregator(Client):
 
     def close(self) -> None:
         self._stop.set()
+        with self._lock:
+            pump, self._pump = self._pump, None
+        if pump is not None:
+            # the pump wakes from inner.watch/backoff on the stop event;
+            # bounded join so a wedged upstream can't hang close()
+            pump.join(timeout=2)
         self.inner.close()
 
 

@@ -166,5 +166,8 @@ class OptimizingClient(Client):
 
     def close(self) -> None:
         self._stop.set()
+        prober, self._prober = self._prober, None
+        if prober is not None:
+            prober.join(timeout=2)
         for s in self.sources:
             s.client.close()

@@ -8,7 +8,7 @@ from typing import Iterator, Optional
 
 from ..chain.beacon import Beacon
 from ..chain.info import Info
-from ..metrics import client_http_heartbeat
+from ..metrics import client_http_heartbeat, registered_label
 from ..net import Peer, ProtocolClient
 from ..net import convert
 from .interface import Client, Result
@@ -62,7 +62,12 @@ class HttpTransport(Client):
     def _fetch(self, path: str) -> dict:
         url = f"{self.base}{path}"
         with urllib.request.urlopen(url, timeout=self.timeout) as r:
-            client_http_heartbeat.labels(self.base).inc()
+            # endpoints come from operator config, but cap the series set
+            # anyway — a misconfigured rotating gateway URL must not mint
+            # a fresh time series per request
+            client_http_heartbeat.labels(
+                registered_label(self.base, ns="client-endpoint",
+                                 limit=16)).inc()
             return json.loads(r.read())
 
     def get(self, round_: int = 0) -> Result:

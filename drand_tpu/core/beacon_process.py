@@ -281,7 +281,12 @@ class BeaconProcess:
                     self._on_sync_needed(packet.round)
 
         for peer in peers:
-            threading.Thread(target=send, args=(peer,), daemon=True).start()
+            # intentional fire-and-forget fan-out: the beacon loop must
+            # not block on any peer; each send is bounded by the client
+            # RPC timeout and exits
+            # tpu-vet: disable=threadlife
+            threading.Thread(target=send, args=(peer,), daemon=True,
+                             name=f"partial-send-{packet.round}").start()
 
     def _maybe_start_handel(self) -> None:
         """Committee-scale selection (caller holds the lock, handler is
@@ -682,9 +687,10 @@ class BeaconProcess:
         self._transition_stop.set()
         self._transition_stop = threading.Event()
         with self._lock:
+            scan_t, self._scan_thread = self._scan_thread, None
+            repair_t, self._repair_thread = self._repair_thread, None
             if self._scan_stop is not None:
                 self._scan_stop.set()
-                self._scan_thread = None
             if self.handel is not None:
                 self.handel.stop()
                 self.handel = None
@@ -702,6 +708,13 @@ class BeaconProcess:
             if self.store is not None:
                 self.store.close()
             self.handler = None
+        # join outside the lock (the workers take self._lock on their way
+        # out).  The repair budget is minutes, so this is a bounded
+        # courtesy wait for the common fast exit, not a completion
+        # guarantee — both are daemon threads already signalled to stop
+        for t in (scan_t, repair_t):
+            if t is not None and t is not threading.current_thread():
+                t.join(timeout=2)
 
     # -- RPC ingress (routed here by the daemon services) --------------------
 
@@ -1150,6 +1163,10 @@ class BeaconProcess:
             if commit:
                 self._commit_pending_transition(group, self.share)
             self.start_beacon(catchup=True)
+        # intentional fire-and-forget: the waiter parks on
+        # _transition_stop, which stop() sets — reaping is by event, not
+        # join, per the docstring above
+        # tpu-vet: disable=threadlife
         threading.Thread(target=waiter, daemon=True,
                          name=f"transition-{self.beacon_id}").start()
 

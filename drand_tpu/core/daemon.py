@@ -460,7 +460,12 @@ class ControlService:
         return convert.group_to_proto(bp.group, bp.beacon_id)
 
     def shutdown(self, req, context):
-        threading.Thread(target=self.daemon.stop, daemon=True).start()
+        # intentional fire-and-forget: the RPC must return before the
+        # daemon tears down the gRPC server it arrived on; daemon.stop()
+        # joins every owned thread
+        # tpu-vet: disable=threadlife
+        threading.Thread(target=self.daemon.stop, daemon=True,
+                         name="stop-async-daemon").start()
         return pb.ShutdownResponse(metadata=convert.metadata())
 
     def load_beacon(self, req, context):
@@ -523,6 +528,9 @@ class ControlService:
             if ev is None:
                 break
             yield pb.SyncProgress(current=ev[0], target=ev[1])
+        # the None sentinel comes from the worker's finally: it is already
+        # unwinding, so this join is a bounded courtesy, not a wait
+        t.join(timeout=2)
         if "error" in result:
             context.abort(grpc.StatusCode.ABORTED,
                           f"check failed: {result['error']}")

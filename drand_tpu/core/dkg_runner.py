@@ -128,6 +128,10 @@ def run_dkg_bounded(gen: D.DistKeyGenerator, board, clock,
         finally:
             done.set()
 
+    # deliberately never joined: on the deadline path the worker may be
+    # wedged inside board.collect — joining would re-introduce the exact
+    # hang this budget exists to escape; `live` mutes the abandoned worker
+    # tpu-vet: disable=threadlife
     t = threading.Thread(target=worker, daemon=True, name="dkg-session")
     t.start()
     import time as _t                 # real-seconds cap only; waits below

@@ -129,6 +129,7 @@ def follow_chain(daemon, bp, nodes: List[str], is_tls: bool, up_to: int,
         # yield): the sync and stores must be torn down on every exit path;
         # facade.stop() closes the decorator chain down to the backend
         syncm.stop()
+        t.join(timeout=2)      # stop() unwedges sync; the worker exits
         facade.stop()
     if err:
         raise err[0]

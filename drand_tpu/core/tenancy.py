@@ -397,8 +397,9 @@ class TenantRegistry:
             # keep the gauge live as the window drains: without this an
             # idle over-quota tenant's gauge froze at its last spike and
             # disagreed with /health's recomputed level forever
-            from ..metrics import tenant_quota_level
-            tenant_quota_level.labels(name).set(level)
+            from ..metrics import registered_label, tenant_quota_level
+            tenant_quota_level.labels(
+                registered_label(name, ns="tenant")).set(level)
         return AdmissionView(
             name=name, known=True, paused=cfg.effectively_paused,
             weight=cfg.weight, rate=cfg.rate, burst=cfg.burst,
@@ -414,7 +415,7 @@ class TenantRegistry:
         """Per-tenant admission bookkeeping + the tenant_requests_total
         series (called by the controller on every tenant-labelled
         decision)."""
-        from ..metrics import tenant_requests
+        from ..metrics import registered_label, tenant_requests
         name = tenant or DEFAULT_TENANT
         with self._lock:
             u = self._usage.setdefault(name, _Usage())
@@ -422,7 +423,7 @@ class TenantRegistry:
                 u.admitted += 1
             else:
                 u.shed += 1
-        tenant_requests.labels(name,
+        tenant_requests.labels(registered_label(name, ns="tenant"),
                                "admitted" if admitted else "shed").inc()
 
     # -- device-time accounting (the placement plane's write) ----------------
@@ -443,8 +444,10 @@ class TenantRegistry:
             cfg = self._tenants.get(name)
             level = self._quota_level_locked(name, cfg) \
                 if cfg is not None else 0.0
-        tenant_device_seconds.labels(name).inc(float(seconds))
-        tenant_quota_level.labels(name).set(level)
+        from ..metrics import registered_label
+        lbl = registered_label(name, ns="tenant")
+        tenant_device_seconds.labels(lbl).inc(float(seconds))
+        tenant_quota_level.labels(lbl).set(level)
 
     def device_seconds(self, tenant: str,
                        window: Optional[float] = None) -> float:
@@ -531,7 +534,9 @@ class TenantRegistry:
                     # refresh the gauge on every /health scrape too (the
                     # idle-tenant freeze fix, for tenants with no
                     # admission traffic at all)
-                    tenant_quota_level.labels(name).set(level)
+                    from ..metrics import registered_label
+                    tenant_quota_level.labels(
+                        registered_label(name, ns="tenant")).set(level)
                 out[name] = {
                     "weight": cfg.weight,
                     "chains": list(cfg.chains),

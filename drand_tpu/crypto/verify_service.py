@@ -915,8 +915,9 @@ class VerifyService:
                     stream = self._stream_locked(self._gid_for_locked(r))
                     stream.queues[r.lane].appendleft(r)
                     self._ensure_threads_locked(stream)
-                for ln in LANES:
-                    verify_queue_depth.labels(ln).set(self._qdepth_locked(ln))
+                for lane in LANES:
+                    verify_queue_depth.labels(lane).set(
+                        self._qdepth_locked(lane))
             self._cond.notify_all()
         for r in drained:
             if not r.future.done():
@@ -1031,17 +1032,17 @@ class VerifyService:
             verify_queue_depth.labels(lane).set(self._qdepth_locked(lane))
             return _Batch(lane, call=head, stream=stream)
         requests = []
-        for ln in (lane,) + tuple(l for l in LANES if l != lane):
+        for drain_lane in (lane,) + tuple(l for l in LANES if l != lane):
             keep: deque = deque()
-            for r in stream.queues[ln]:
+            for r in stream.queues[drain_lane]:
                 if r is head or (r.kind == "batch" and r.key == head.key
                                  and r.sharded == head.sharded):
                     requests.append(r)
                 else:
                     keep.append(r)
             # tpu-vet: disable=lock  (caller holds self._cond, see docstring)
-            stream.queues[ln] = keep
-            verify_queue_depth.labels(ln).set(self._qdepth_locked(ln))
+            stream.queues[drain_lane] = keep
+            verify_queue_depth.labels(drain_lane).set(self._qdepth_locked(drain_lane))
         slot = self._slots.get(head.key)
         if head.sharded and slot is not None \
                 and slot.pool_backend is not None:

@@ -24,7 +24,7 @@ from .chain.beacon import Beacon
 from .chain.errors import ErrNoBeaconSaved, ErrNoBeaconStored
 from .chain.timing import time_of_round
 from .log import Logger
-from .metrics import api_call_counter, http_latency
+from .metrics import api_call_counter, http_latency, registered_label
 from .net.admission import CLASS_SHEDDABLE, Shed
 
 LONG_POLL_TIMEOUT = 60.0
@@ -297,7 +297,13 @@ class RestServer:
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
-                http_latency.labels(self.path.split("/")[-1] or "root") \
+                # path leaves include round numbers (/public/1234) — fold
+                # everything outside the fixed route set into one bucket
+                route = registered_label(
+                    self.path.split("/")[-1] or "root",
+                    known=("root", "health", "chains", "info", "latest",
+                           "metrics"))
+                http_latency.labels(route) \
                     .observe(time.perf_counter() - t0)
 
         self.httpd = BoundedHTTPServer((host or "127.0.0.1", int(port)),

@@ -30,6 +30,38 @@ HTTP = CollectorRegistry()
 GROUP = CollectorRegistry()
 CLIENT = CollectorRegistry()
 
+# -- label cardinality control ----------------------------------------------
+# Prometheus allocates one time series per label combination, so every
+# label value must come from a bounded set (the metriclabel lint rule).
+# Naturally-unbounded values (peer addresses, tenant names, request-path
+# leaves) pass through registered_label(), which caps distinct values per
+# namespace and folds the tail into a fallback bucket — a scrape sees the
+# first `limit` real values and one "other" series, never an explosion.
+
+_label_sets: Dict[str, set] = {}
+_label_lock = threading.Lock()
+
+
+def registered_label(value, known=None, ns: str = "default",
+                     limit: int = 64, fallback: str = "other") -> str:
+    """Bound a metric label value.
+
+    With `known`, membership decides: values outside the set collapse to
+    `fallback`.  Without it, a first-come registry per `ns` admits up to
+    `limit` distinct values; later unseen values collapse to `fallback`.
+    """
+    v = str(value)
+    if known is not None:
+        return v if v in known else fallback
+    with _label_lock:
+        seen = _label_sets.setdefault(ns, set())
+        if v in seen:
+            return v
+        if len(seen) < limit:
+            seen.add(v)
+            return v
+    return fallback
+
 beacon_discrepancy_latency = Gauge(
     "beacon_discrepancy_latency",
     "Difference between the expected round time and the storage time (ms)",
@@ -335,7 +367,11 @@ class ThresholdMonitor:
                               failures=len(failing), nodes=",".join(failing))
 
     def report_failure(self, addr: str) -> None:
-        error_sending_partial.labels(self.beacon_id, addr).inc()
+        # committee peers are bounded by the group file, but addresses
+        # churn across reshares — cap the series set regardless
+        error_sending_partial.labels(
+            self.beacon_id,
+            registered_label(addr, ns="peer-address", limit=256)).inc()
         with self._lock:
             self._failed[addr] = True
 

@@ -161,13 +161,17 @@ class CircuitBreaker:
         self._state = new
         self._last_transition = self.clock.now()
         self._export_state()
-        from ..metrics import breaker_transitions
-        breaker_transitions.labels(self.scope, self.key,
-                                   _STATE_NAMES[new]).inc()
+        from ..metrics import breaker_transitions, registered_label
+        breaker_transitions.labels(
+            self.scope, registered_label(self.key, ns="peer-address",
+                                         limit=256),
+            _STATE_NAMES[new]).inc()
 
     def _export_state(self) -> None:
-        from ..metrics import breaker_state
-        breaker_state.labels(self.scope, self.key).set(self._state)
+        from ..metrics import breaker_state, registered_label
+        breaker_state.labels(
+            self.scope, registered_label(self.key, ns="peer-address",
+                                         limit=256)).set(self._state)
 
     def next_probe_at(self) -> float:
         """Earliest clock time a call could be admitted (now for closed /

@@ -185,6 +185,9 @@ class GrpcRelayNode:
 
     def stop(self) -> None:
         self._stop.set()
+        pump, self._thread = self._thread, None
+        if pump is not None:
+            pump.join(timeout=5)
         self.listener.stop()
         if self.client is not None:
             self.client.close()
@@ -517,6 +520,9 @@ class ObjectStoreRelay:
 
     def stop(self) -> None:
         self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
 
 
 # ---------------------------------------------------------------------------
@@ -587,4 +593,7 @@ class HttpRelay:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
         self.client.close()

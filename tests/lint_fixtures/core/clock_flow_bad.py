@@ -1,0 +1,15 @@
+"""Cross-function wall-clock reads (clock-interproc-call): caught by v2,
+missed by the v1 per-function pass."""
+
+from core.clock_util import boot_label, wall_now
+
+
+def deadline_for_round(period):
+    # BAD (v2 only): wall_now() launders time.time() through a helper in
+    # another module — chaos determinism breaks just the same
+    return wall_now() + period
+
+
+def tag():
+    # OK: not a wall-clock value
+    return boot_label()

@@ -1,0 +1,17 @@
+"""Wall-clock laundering helper — the clock checker's cross-function
+pair's helper half (tests/test_vet.py).
+
+The direct read below is deliberately suppressed: the POINT of this
+fixture is the return value.  Phase 1 marks `wall_now()`
+``returns_wallclock``, so v2 flags its *callers* (core/clock_flow_bad.py)
+while the v1 per-function pass sees only this suppressed line."""
+
+import time
+
+
+def wall_now():
+    return time.time()  # tpu-vet: disable=clock
+
+
+def boot_label():
+    return "boot"

@@ -1,0 +1,105 @@
+"""Seeded thread-lifecycle violations: anonymous/unregistered names,
+join-less owners, fire-and-forget orphans — the PR 7/8/12 leak class."""
+
+import threading
+
+
+def make_pump(fn):
+    """OK: constructs but does not start — ownership (and the phase-1
+    ``returns_thread`` summary) transfers to the caller."""
+    return threading.Thread(target=fn, daemon=True, name="relay-pump")
+
+
+def start_made_pump(fn):
+    # BAD (v2 only): make_pump() hands back a thread (returns_thread);
+    # starting and dropping it is the same leak as constructing it here,
+    # but v1 sees an opaque call and misses it (threadlife-orphan)
+    t = make_pump(fn)
+    t.start()
+
+
+class LeakyOwner:
+    """BAD x2: `_pump` has no join anywhere; `_probe` is joined only
+    from a method stop() never reaches (threadlife-no-join)."""
+
+    def start(self, fn):
+        self._pump = threading.Thread(target=fn, daemon=True,
+                                      name="relay-pump")
+        self._pump.start()
+        self._probe = threading.Thread(target=fn, daemon=True,
+                                       name="probe-net")
+        self._probe.start()
+
+    def _reap_probe(self):
+        self._probe.join(timeout=2)
+
+    def stop(self):
+        self._pump = None          # dropped, never joined
+
+
+class NoStopOwner:
+    """BAD: owns a thread but has no stop()/close()/shutdown() at all."""
+
+    def launch(self, fn):
+        self._ticker = threading.Thread(target=fn, daemon=True,
+                                        name="ticker")
+        self._ticker.start()
+
+
+class CleanOwner:
+    """OK: the tuple-swap + bounded-join idiom the codebase uses."""
+
+    def launch(self, fn):
+        self._pump = threading.Thread(target=fn, daemon=True,
+                                      name="relay-pump")
+        self._pump.start()
+
+    def close(self):
+        t, self._pump = self._pump, None
+        if t is not None:
+            t.join(timeout=2)
+
+
+def fire_and_forget(fn):
+    # BAD: unbound start — nothing can stop or await it
+    # (threadlife-orphan)
+    threading.Thread(target=fn, daemon=True, name="relay-oneshot").start()
+
+
+def local_leak(fn):
+    # BAD: started and dropped (threadlife-orphan)
+    t = threading.Thread(target=fn, daemon=True, name="relay-drop")
+    t.start()
+
+
+def local_joined(fn):
+    # OK: bounded-join before returning
+    t = threading.Thread(target=fn, daemon=True, name="relay-scoped")
+    t.start()
+    t.join(timeout=3)
+
+
+def handed_off(fn, registry):
+    # OK: ownership handed to the registry
+    t = threading.Thread(target=fn, daemon=True, name="relay-handoff")
+    t.start()
+    registry.adopt(t)
+
+
+def bad_names(fn):
+    # BAD: anonymous (threadlife-unnamed)
+    t = threading.Thread(target=fn, daemon=True)
+    # BAD: unregistered prefix (threadlife-unregistered-name)
+    u = threading.Thread(target=fn, daemon=True, name="mystery-pump")
+    # BAD: fully dynamic name — no static prefix for the registry
+    v = threading.Thread(target=fn, daemon=True, name=fn.__name__)
+    for w in (t, u, v):
+        w.start()
+    for w in (t, u, v):
+        w.join(timeout=1)
+
+
+def justified_oneshot(fn):
+    # the interpreter-exit path cannot join across teardown, justified:
+    # tpu-vet: disable=threadlife
+    threading.Thread(target=fn, daemon=True, name="stop-oneshot").start()

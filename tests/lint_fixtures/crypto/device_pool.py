@@ -12,3 +12,18 @@ def sanctioned_enumeration():
 
 def sanctioned_local_enumeration():
     return jax.local_devices()                      # allowed (the pool)
+
+
+def sanctioned_mesh(devices):
+    from jax.sharding import Mesh
+    return Mesh(devices, ("lanes",))                # allowed (the home)
+
+
+def churny_mesh(device_lists):
+    from jax.sharding import Mesh
+    out = []
+    for devs in device_lists:
+        # BAD even at home: a placement object per loop iteration
+        # (recompile-per-call-placement)
+        out.append(Mesh(devs, ("lanes",)))
+    return out

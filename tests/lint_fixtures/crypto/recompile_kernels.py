@@ -1,0 +1,32 @@
+"""Jitted kernels + a jit factory for the recompile fixture pair.
+
+The static-arg summaries harvested here (phase 1) drive the call-site
+checks in ops/recompile_bad.py — the `@jit(static_...)` def and the bad
+call sites live in different modules on purpose: that is the exact
+cross-function shape of the PR 11 watchdog-floor incident."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnums=(1,))
+def pack_lanes(x, lanes):
+    return x.reshape((lanes, -1))
+
+
+@partial(jax.jit, static_argnames=("pad",))
+def pad_block(x, pad=4):
+    return jnp.pad(x, pad)
+
+
+# BAD: unhashable default on a static param — jit hashes static args
+@partial(jax.jit, static_argnames=("dims",))
+def tile(x, dims=[8, 128]):
+    return jnp.tile(x, dims)
+
+
+def make_hasher(width):
+    """jit factory: each call builds a fresh program flavor."""
+    return jax.jit(lambda m: m % width)

@@ -1,0 +1,22 @@
+"""Cross-function secret leaks: caught by the v2 interprocedural engine,
+invisible to the v1 per-function pass (regression-tested both ways in
+tests/test_vet.py)."""
+
+from crypto.secret_flow_helpers import current_material, report_material
+
+
+def leak_via_source(log, vault):
+    # BAD (v2 only): current_material() returns vault.get_share() — the
+    # helper launders the secret through a return value (secret-in-log)
+    log.info("material=%s", current_material(vault))
+
+
+def leak_via_sink(log, vault):
+    # BAD (v2 only): report_material() logs its `material` parameter —
+    # the leak is one frame down, the bug is here (secret-interproc-log)
+    report_material(log, vault.get_share())
+
+
+def hashed_is_fine(log, vault):
+    # OK: sanitized before crossing the call boundary
+    report_material(log, hash_secret(current_material(vault)))

@@ -1,0 +1,16 @@
+"""Interprocedural secret-flow helpers — the cross-function half of the
+v1-miss/v2-catch pair (tests/test_vet.py).
+
+`current_material` launders `vault.get_share()` through a return value;
+nothing at its call sites looks secret-ish to a per-function pass.  The
+phase-1 summary marks it ``returns_secret``.  `report_material` logs its
+`material` parameter, so a secret bound there leaks one frame down
+(``logged_params`` summary)."""
+
+
+def current_material(vault):
+    return vault.get_share()
+
+
+def report_material(log, material):
+    log.info("dkg material state: %s", material)

@@ -1,0 +1,38 @@
+"""Seeded metric-label cardinality violations: a peer address, a round
+number, or a request URL as a label value is one Prometheus time series
+per distinct value."""
+
+from drand_tpu.metrics import registered_label
+
+STATE_NAMES = {0: "open", 1: "closed"}
+
+
+def record(m, peer_addr, beacon_id, round_no, state):
+    # BAD: a peer address is one time series per peer
+    # (metriclabel-unbounded)
+    m.labels(peer_addr).inc()
+    # BAD: a round number is unbounded by construction
+    m.labels(f"round-{round_no}").inc()
+    # OK: bounded identifier
+    m.labels(beacon_id).inc()
+    # OK: literal
+    m.labels("aggregate").inc()
+    # OK: the sanctioned sanitizer caps the registry
+    m.labels(registered_label(peer_addr, ns="peer-address")).inc()
+    # OK: lookup into a bounded table
+    m.labels(STATE_NAMES[state]).inc()
+
+
+def record_attr(m, req):
+    # BAD: attribute with an unbounded terminal
+    m.labels(req.url).observe(1.0)
+    # OK: bounded terminal through an attribute
+    m.labels(req.route).observe(1.0)
+
+
+def record_local(m, cfg, addr):
+    # OK: a local assigned from a bounded expression (one hop)
+    lane_value = cfg.lane
+    m.labels(lane_value).set(1)
+    # suppressed: justified one-off debug metric
+    m.labels(addr).set(1)  # tpu-vet: disable=metriclabel

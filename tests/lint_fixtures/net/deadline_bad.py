@@ -1,0 +1,55 @@
+"""Seeded deadline violations: unbounded blocking primitives and an
+unthreaded budget — the r06 hung-probe class."""
+
+import socket
+import subprocess
+from urllib.request import urlopen
+
+from net.deadline_helpers import rpc, rpc_defaulted
+
+
+def probe(cmd):
+    # BAD: no timeout — a hung probe holds this thread forever
+    # (deadline-unbounded-call)
+    return subprocess.run(cmd, capture_output=True)
+
+
+def fetch_status(url):
+    # BAD: explicit timeout=None counts as absent
+    return urlopen(url, timeout=None)
+
+
+def drain(proc):
+    # BAD: communicate() with no timeout
+    out, _ = proc.communicate()
+    return out
+
+
+def call_without_budget(url):
+    # BAD: rpc() passes `timeout` straight into urlopen with no
+    # fallback — omitting it runs unbounded (deadline-not-threaded)
+    return rpc(url)
+
+
+def connect(addr):
+    # OK: bounded
+    return socket.create_connection(addr, 5.0)
+
+
+def probe_bounded(cmd, budget):
+    # OK: bounded by the caller's budget
+    return subprocess.run(cmd, timeout=budget, capture_output=True)
+
+
+def call_with_budget(url, budget):
+    # OK: budget threaded through to the blocking call
+    return rpc(url, timeout=budget)
+
+
+def call_defaulted(url):
+    # OK: the callee self-bounds (`timeout or DEFAULT_TIMEOUT`)
+    return rpc_defaulted(url)
+
+
+def justified_wait(proc):
+    return proc.communicate()  # tpu-vet: disable=deadline

@@ -1,0 +1,19 @@
+"""Deadline-threading helpers for the cross-function fixture pair.
+
+`rpc` REQUIRES its timeout: the parameter defaults to None and flows
+bare into `urlopen` — callers that omit it run unbounded
+(``required_deadline`` summary).  `rpc_defaulted` self-bounds with the
+``timeout or DEFAULT`` idiom (net/client.py style) and never burdens
+callers."""
+
+from urllib.request import urlopen
+
+DEFAULT_TIMEOUT = 5.0
+
+
+def rpc(url, timeout=None):
+    return urlopen(url, timeout=timeout)
+
+
+def rpc_defaulted(url, timeout=None):
+    return urlopen(url, timeout=timeout or DEFAULT_TIMEOUT)

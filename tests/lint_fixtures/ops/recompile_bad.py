@@ -1,0 +1,42 @@
+"""Seeded recompile violations at call sites — the jitted defs live in
+crypto/recompile_kernels.py, so every dispatch-hygiene code here needs
+the cross-module phase-1 summaries."""
+
+from jax.sharding import Mesh
+
+from crypto.recompile_kernels import make_hasher, pack_lanes, tile
+
+
+def dispatch(x, counts, cfg):
+    # BAD: .item() into a static slot — every distinct value is a fresh
+    # program flavor (recompile-data-dependent-static)
+    y = pack_lanes(x, counts.item())
+    # BAD: int() of runtime data into the same static slot
+    y = pack_lanes(y, int(counts))
+    # OK: shape-derived flavor constants are the sanctioned selector
+    y = pack_lanes(y, int(x.shape[0]))
+    # OK: config-derived flavor constant
+    return pack_lanes(y, cfg.lanes)
+
+
+def bad_static_display(x):
+    # BAD: unhashable list display in a static slot
+    # (recompile-unhashable-static)
+    return tile(x, dims=[4, 4])
+
+
+def bad_factory(x, n):
+    # BAD: data-dependent scalar into a jit factory
+    # (recompile-data-dependent-flavor)
+    return make_hasher(n.item())(x)
+
+
+def fresh_mesh(devices):
+    # BAD: placement object minted outside crypto/device_pool.py
+    # (recompile-per-call-placement)
+    return Mesh(devices, ("lanes",))
+
+
+def justified_mesh(devices):
+    # one-off diagnostic mesh in an operator path, justified:
+    return Mesh(devices, ("lanes",))  # tpu-vet: disable=recompile

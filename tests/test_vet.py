@@ -26,6 +26,7 @@ from drand_tpu.analysis.checkers import (ALL_CHECKERS, by_names,
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = os.path.join(REPO, "drand_tpu")
+TOOLS = os.path.join(REPO, "tools")
 FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "lint_fixtures")
 
@@ -45,9 +46,10 @@ def _fixture_report(checker_name):
 
 
 def test_package_is_vet_clean():
-    """The whole package vets clean, fast, with every checker enabled."""
+    """Package + operator tools vet clean, fast, with all 13 checkers
+    (the new recompile/deadline/threadlife/metriclabel gates included)."""
     t0 = time.perf_counter()
-    report = run_vet([PACKAGE])
+    report = run_vet([PACKAGE, TOOLS])
     elapsed = time.perf_counter() - t0
     assert report.errors == []
     assert report.findings == [], (
@@ -62,7 +64,7 @@ def test_cli_runs_clean_without_importing_jax():
     acceptance criterion, checked in a fresh interpreter."""
     probe = (
         "import sys\n"
-        "sys.argv = ['vet', %r]\n"
+        "sys.argv = ['vet', %r, %r]\n"
         "sys.path.insert(0, %r)\n"
         "import runpy\n"
         "try:\n"
@@ -72,7 +74,7 @@ def test_cli_runs_clean_without_importing_jax():
         "leaked = [m for m in sys.modules\n"
         "          if m == 'jax' or m.startswith('jax.')]\n"
         "assert not leaked, f'vet imported JAX: {leaked}'\n"
-    ) % (PACKAGE, REPO, os.path.join(REPO, "tools", "vet.py"))
+    ) % (PACKAGE, TOOLS, REPO, os.path.join(REPO, "tools", "vet.py"))
     proc = subprocess.run([sys.executable, "-c", probe],
                           capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
@@ -124,7 +126,8 @@ def test_secret_checker_catches_fixture():
     assert ("secrets_bad.py", "secret-in-log") in codes
     assert ("secrets_bad.py", "secret-in-exception") in codes
     assert ("secrets_bad.py", "secret-in-repr") in codes
-    msgs = [f.message for f in report.findings]
+    msgs = [f.message for f in report.findings
+            if f.path == "secrets_bad.py"]
     # direct kwarg + one-hop taint are both caught
     assert sum("secret-bearing" in m and "log call" in m
                for m in msgs) == 2
@@ -356,6 +359,140 @@ def test_wait_checker_exempts_test_code(tmp_path):
     assert report.findings == []
 
 
+def test_recompile_checker_catches_fixture():
+    report = _fixture_report("recompile")
+    codes = _codes(report)
+    assert ("ops/recompile_bad.py",
+            "recompile-data-dependent-static") in codes
+    assert ("ops/recompile_bad.py", "recompile-unhashable-static") in codes
+    assert ("ops/recompile_bad.py",
+            "recompile-data-dependent-flavor") in codes
+    assert ("ops/recompile_bad.py", "recompile-per-call-placement") in codes
+    # the unhashable DEFAULT is reported at the def, the static-args
+    # summary crosses the crypto/ -> ops/ module boundary for the rest
+    assert ("crypto/recompile_kernels.py",
+            "recompile-unhashable-static") in codes
+    # the placement home is exempt outside loops — but not inside one
+    assert ("crypto/device_pool.py", "recompile-per-call-placement") in codes
+    msgs = [f.message for f in report.findings]
+    assert any(".item()" in m and "static arg `lanes`" in m for m in msgs)
+    assert any("int(counts)" in m for m in msgs)
+    # shape-derived and config-derived flavor constants stay silent: the
+    # two seeded call-site BADs are the only `lanes` findings
+    assert sum("static arg `lanes`" in m for m in msgs) == 2
+    # the justified one-off mesh is a suppression, not a finding
+    assert len([f for f in report.suppressed
+                if f.path == "ops/recompile_bad.py"]) == 1
+
+
+def test_deadline_checker_catches_fixture():
+    report = _fixture_report("deadline")
+    codes = _codes(report, "net/deadline_bad.py")
+    assert ("net/deadline_bad.py", "deadline-unbounded-call") in codes
+    assert ("net/deadline_bad.py", "deadline-not-threaded") in codes
+    msgs = [f.message for f in report.findings]
+    assert any("subprocess.run" in m for m in msgs)
+    assert any("urlopen" in m for m in msgs)
+    assert any(".communicate()" in m for m in msgs)
+    assert any("omits `timeout`" in m for m in msgs)
+    # bounded calls, threaded budgets, and the `or`-fallback helper stay
+    # silent: exactly the four seeded BADs fire, and the helpers module
+    # (timeout flows with expressions present) is clean
+    lines = {f.line for f in report.findings
+             if f.path == "net/deadline_bad.py"}
+    assert len(lines) == 4, sorted(lines)
+    assert not any(f.path == "net/deadline_helpers.py"
+                   for f in report.findings)
+    assert len([f for f in report.suppressed
+                if f.path == "net/deadline_bad.py"]) == 1
+
+
+def test_threadlife_checker_catches_fixture():
+    report = _fixture_report("threadlife")
+    path = "core/threadlife_bad.py"
+    by_code = {}
+    for f in report.findings:
+        if f.path == path:
+            by_code.setdefault(f.code, set()).add(f.line)
+    assert len(by_code["threadlife-unnamed"]) == 1
+    # unregistered literal prefix + fully dynamic name
+    assert len(by_code["threadlife-unregistered-name"]) == 2
+    # LeakyOwner._pump (never joined), LeakyOwner._probe (join exists but
+    # stop() never reaches it), NoStopOwner (no stop root at all)
+    assert len(by_code["threadlife-no-join"]) == 3
+    msgs = [f.message for f in report.findings if f.path == path]
+    assert any("NoStopOwner" in m for m in msgs)
+    # the tuple-swap + bounded-join idiom is recognized, not flagged
+    assert not any("CleanOwner" in m for m in msgs)
+    # unbound .start(), local started-and-dropped, and the returns_thread
+    # local from make_pump()
+    assert len(by_code["threadlife-orphan"]) == 3
+    assert len([f for f in report.suppressed if f.path == path]) == 1
+
+
+def test_metriclabel_checker_catches_fixture():
+    report = _fixture_report("metriclabel")
+    path = "metrics_bad.py"
+    hits = [f for f in report.findings if f.path == path]
+    assert hits and all(f.code == "metriclabel-unbounded" for f in hits)
+    # peer_addr, the round f-string, req.url — each exactly once
+    assert len(hits) == len({f.line for f in hits}) == 3, \
+        sorted(f.line for f in hits)
+    msgs = [f.message for f in hits]
+    assert any("peer_addr" in m for m in msgs)
+    assert any("req.url" in m for m in msgs)
+    # bounded identifiers, literals, registered_label(), the bounded-table
+    # lookup, and the one-hop bounded local all stay silent
+    assert not any("beacon_id" in m or "STATE_NAMES" in m
+                   or "route" in m or "lane_value" in m for m in msgs)
+    assert len([f for f in report.suppressed if f.path == path]) == 1
+
+
+# -- the interprocedural regression: v1 misses, v2 catches --------------------
+
+
+def _fixture_module(rel):
+    from drand_tpu.analysis.symbols import ModuleInfo
+    full = os.path.join(FIXTURES, rel.replace("/", os.sep))
+    with open(full, "r", encoding="utf-8") as f:
+        return ModuleInfo(full, rel, f.read())
+
+
+def test_cross_function_pair_v1_misses_v2_catches():
+    """THE tentpole regression, asserted both ways: the cross-function
+    fixture leaks are invisible to a v1 per-function pass (checker.check
+    with no project) and caught by the v2 two-phase run."""
+    from drand_tpu.analysis.checkers.clock import ClockChecker
+    from drand_tpu.analysis.checkers.secrets import SecretChecker
+    secret_bad = _fixture_module("crypto/secret_flow_bad.py")
+    clock_bad = _fixture_module("core/clock_flow_bad.py")
+    # v1: no project — per-function analysis sees opaque helper calls
+    assert list(SecretChecker().check(secret_bad)) == []
+    assert list(ClockChecker().check(clock_bad)) == []
+    # v2: phase-1 summaries expose returns_secret / logged_params /
+    # returns_wallclock across the module boundary
+    report = run_vet([FIXTURES], checkers=by_names(["secret", "clock"]))
+    codes = _codes(report)
+    assert ("crypto/secret_flow_bad.py", "secret-in-log") in codes
+    assert ("crypto/secret_flow_bad.py", "secret-interproc-log") in codes
+    assert ("core/clock_flow_bad.py", "clock-interproc-call") in codes
+
+
+def test_threadlife_returns_thread_orphan_needs_project():
+    """The start_made_pump leak rides on the returns_thread summary:
+    v1 sees `t = make_pump(fn)` as an opaque call and stays silent."""
+    from drand_tpu.analysis.checkers.threadlife import ThreadLifeChecker
+    mod = _fixture_module("core/threadlife_bad.py")
+    v1 = {f.line for f in ThreadLifeChecker().check(mod)
+          if f.code == "threadlife-orphan"}
+    report = _fixture_report("threadlife")
+    v2 = {f.line for f in report.findings + report.suppressed
+          if f.path == "core/threadlife_bad.py"
+          and f.code == "threadlife-orphan"}
+    extra = v2 - v1
+    assert len(extra) == 1, (sorted(v1), sorted(v2))
+
+
 def test_all_fixture_violations_found_by_full_run():
     """One full-corpus run: every checker contributes findings (no
     checker silently stopped matching its fixture)."""
@@ -494,6 +631,57 @@ def test_cli_exit_codes_and_json(tmp_path, capsys):
     assert vet.main([FIXTURES, "--baseline", "/no/such/baseline"]) == 2
 
 
+def test_cli_sarif_output(capsys):
+    vet = _run_cli()
+    assert vet.main([FIXTURES, "--format", "sarif",
+                     "--checkers", "deadline"]) == 1
+    out = capsys.readouterr().out
+    doc = json.loads(out[out.index("{"):])
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "tpu-vet"
+    assert any(r["id"] == "tpu-vet/deadline-unbounded-call"
+               for r in run["tool"]["driver"]["rules"])
+    assert run["results"]
+    for res in run["results"]:
+        assert res["level"] == "error"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith(".py")
+        assert loc["region"]["startLine"] >= 1
+
+
+def test_cli_changed_scopes_to_git_dirty_files(tmp_path, capsys):
+    """--changed reports only git-touched files, with the committed rest
+    of the tree parsed as phase-1 context (not reported)."""
+    def git(*a):
+        subprocess.run(["git", *a], cwd=tmp_path, check=True,
+                       capture_output=True)
+    git("init", "-q")
+    git("config", "user.email", "vet@test")
+    git("config", "user.name", "vet")
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "committed.py").write_text("import time\nBAD = time.time()\n")
+    git("add", ".")
+    git("commit", "-qm", "seed")
+    (pkg / "fresh.py").write_text("import time\nALSO_BAD = time.time()\n")
+
+    vet = _run_cli()
+    rc = vet.main([str(pkg), "--changed", "--checkers", "clock",
+                   "--format", "json"])
+    out = capsys.readouterr().out
+    payload = json.loads(out[out.index("{"):])
+    assert rc == 1
+    # only the untracked file is reported; committed.py (equally in
+    # violation) is context, not a finding
+    assert {f["path"] for f in payload["findings"]} == {"fresh.py"}
+
+    # a fully-committed tree reports nothing and exits 0
+    git("add", ".")
+    git("commit", "-qm", "fix")
+    assert vet.main([str(pkg), "--changed", "--checkers", "clock"]) == 0
+    capsys.readouterr()
+
+
 def test_cli_write_baseline_then_clean(tmp_path, capsys):
     vet = _run_cli()
     bl = str(tmp_path / "bl.json")
@@ -504,7 +692,9 @@ def test_cli_write_baseline_then_clean(tmp_path, capsys):
 
 def test_checker_registry_names_are_suppression_tokens():
     assert checker_names() == ["clock", "lock", "secret", "trace", "store",
-                               "verifier", "wait", "bounds", "atomic"]
-    assert len(ALL_CHECKERS) == 9
+                               "verifier", "wait", "bounds", "atomic",
+                               "recompile", "deadline", "threadlife",
+                               "metriclabel"]
+    assert len(ALL_CHECKERS) == 13
     with pytest.raises(KeyError):
         by_names(["not-a-checker"])

@@ -20,7 +20,17 @@ win; a container with no chip and no tuning file is unchanged).
 The full sweep is sized for a chip round (pad 32768 x G2 is hours of
 compile on a cold CPU cache); the driver runs it once per chip round,
 after bench.py has pre-warmed the compilation cache.
+
+Fail-fast hygiene (the runtime complement of the vet `deadline`
+checker): the full sweep opens with a bounded backend-bind probe in a
+throwaway subprocess (drand_tpu/accel.py) — a wedged tunnel costs
+DRAND_TPU_AUTOTUNE_PROBE_TIMEOUT seconds and a JSON error line, not the
+round — and every measured (kind, group, pad, depth) cell commits the
+best-so-far winners to TUNING.json before the next cell starts, so a
+later hang never discards finished measurements.
 """
+# tpu-vet: disable-file=verifier  (the sweep MEASURES raw
+# BatchBeaconVerifier configs to pick what the service will use)
 
 import argparse
 import json
@@ -88,13 +98,17 @@ def _measure(sch, pub, beacons, pad, depth, group_size=1):
 
 
 def sweep(kinds, pads, depths, n, progress=lambda m: None,
-          group_sizes=(1,)):
+          group_sizes=(1,), commit=lambda winners, rows: None):
     """-> (winners {tuning key: entry}, rows [sweep table]).
 
     Winners are keyed per GROUP SIZE (ISSUE 11): the bare kind for a
     1-device group (the legacy spelling crypto/tuning.py falls back to)
     and `<kind>@<n>` for an n-device group, so a 1-device and a 4-device
-    group never share a TUNING.json winner."""
+    group never share a TUNING.json winner.
+
+    `commit(winners, rows)` fires after EVERY measured cell with the
+    best-so-far winner tables — a later cell that hangs past the driver
+    budget loses only itself, never the measurements already taken."""
     rows = []
     winners = {}
     for kind in kinds:
@@ -106,6 +120,7 @@ def sweep(kinds, pads, depths, n, progress=lambda m: None,
                 progress(f"{kind}@{gs}: fewer than {gs} devices, skipped")
                 continue
             best = None
+            entry_key = kind if gs == 1 else f"{kind}@{gs}"
             for pad in pads:
                 for depth in depths:
                     progress(f"{kind}@{gs} pad={pad} depth={depth}")
@@ -119,10 +134,10 @@ def sweep(kinds, pads, depths, n, progress=lambda m: None,
                              f"{rps:.1f} r/s")
                     if best is None or rps > best["rounds_per_s"]:
                         best = row
-            entry_key = kind if gs == 1 else f"{kind}@{gs}"
-            winners[entry_key] = {"pad": best["pad"],
-                                  "depth": best["depth"],
-                                  "rounds_per_s": best["rounds_per_s"]}
+                        winners[entry_key] = {
+                            "pad": best["pad"], "depth": best["depth"],
+                            "rounds_per_s": best["rounds_per_s"]}
+                    commit(winners, rows)
     return winners, rows
 
 
@@ -188,6 +203,20 @@ def main(argv=None):
     if args.selftest:
         return _selftest(args)
 
+    # Fail-fast preflight (bench.py does the same): bind the backend in a
+    # killable subprocess BEFORE this process touches jax — against a
+    # wedged axon tunnel, an in-process `jax.devices()` blocks forever in
+    # native code and eats the whole round budget (r06: 42 hung probes).
+    from drand_tpu.accel import probe_backend
+    probe_timeout = int(os.environ.get("DRAND_TPU_AUTOTUNE_PROBE_TIMEOUT",
+                                       "120"))
+    info, detail = probe_backend(timeout=probe_timeout)
+    if info is None:
+        print(json.dumps({"ok": False, "probe_error": detail,
+                          "probe_timeout": probe_timeout}), flush=True)
+        return 1
+    print(f"# probe: {detail}", file=sys.stderr, flush=True)
+
     import jax
     platform = jax.default_backend()
     pads = [int(x) for x in args.pads.split(",") if x.strip()]
@@ -207,12 +236,32 @@ def main(argv=None):
     out = args.out or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "TUNING.json")
+    from drand_tpu.crypto import tuning
+
+    def commit(winners_so_far, rows_so_far):
+        # every cell lands on disk before the next one starts: the winner
+        # table goes straight into TUNING.json (atomic temp + rename) and
+        # the raw sweep rows into a sidecar for postmortems of a killed run
+        if winners_so_far:
+            tuning.write_tuning(out, platform, winners_so_far)
+        tmp = f"{out}.sweep.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"platform": platform, "complete": False,
+                       "rows": rows_so_far}, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, out + ".sweep.json")
+
     winners, rows = sweep(kinds, pads, depths, args.n,
                           progress=lambda m: print(f"# {m}", file=sys.stderr,
                                                    flush=True),
-                          group_sizes=group_sizes)
-    from drand_tpu.crypto import tuning
+                          group_sizes=group_sizes, commit=commit)
     tuning.write_tuning(out, platform, winners)
+    tmp = f"{out}.sweep.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"platform": platform, "complete": True, "rows": rows}, f,
+                  indent=1)
+        f.write("\n")
+    os.replace(tmp, out + ".sweep.json")
     print(json.dumps({"ok": True, "platform": platform, "out": out,
                       "winners": winners, "sweep": rows}), flush=True)
     return 0

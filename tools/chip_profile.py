@@ -4,6 +4,8 @@ Each stage is jitted separately (axon adds ~0.1s dispatch per call — noted
 in the numbers), so this is for RELATIVE stage weights, not absolutes.
 Usage: python tools/chip_profile.py [N]
 """
+# tpu-vet: disable-file=verifier  (profiling tool measures the raw
+# verifier stages; routing through the service would hide them)
 import sys, time
 import jax
 jax.config.update("jax_compilation_cache_dir", "/tmp/drand_tpu_jax_cache")

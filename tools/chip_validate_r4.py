@@ -8,6 +8,11 @@ code before any bench/prewarm run trusts them.
 
     python tools/chip_validate_r4.py
 """
+# tpu-vet: disable-file=clock  (offline operator tool: wall-clock timing
+# of a one-shot validation run; no beacon schedule logic to fake-clock)
+# tpu-vet: disable-file=verifier  (validation must drive the raw kernels
+# and the real device inventory directly, bypassing the verify service
+# on purpose)
 
 import os
 import sys

@@ -17,6 +17,8 @@ isogeny from first principles:
 
 Writes drand_tpu/crypto/host/_iso_g1.py.  Run once: python tools/derive_isogeny.py
 """
+# tpu-vet: disable-file=clock  (offline derivation script: time.time()
+# is progress reporting for an hours-long symbolic computation)
 
 import sys, os, random, time
 

@@ -211,7 +211,7 @@ def run_load(fire, target: str, mode: str, clients: int, rate: float,
                 continue
             next_at += gap
             th = threading.Thread(target=fire, args=(report, lock),
-                                  daemon=True)
+                                  daemon=True, name="loadgen-fire")
             th.start()
             threads.append(th)
             if len(threads) > 4096:     # reap finished arrivals

@@ -8,6 +8,8 @@ RPC dispatch overhead.  Run on the real chip:
 
     python tools/profile_stages.py [N ...]
 """
+# tpu-vet: disable-file=verifier  (profiling tool measures the raw
+# verifier stages; routing through the service would hide them)
 
 import os
 import sys
