@@ -1159,7 +1159,7 @@ def main():
                 proc.kill()
                 break
             time.sleep(1.0)
-        proc.wait()
+        proc.wait(timeout=30)
         th.join(timeout=10)
         # drop finished configs; on timeout also drop the one that hung
         remaining = [i for i in remaining if i not in done_here]

@@ -36,7 +36,16 @@ from ..core import Finding
 from ..symbols import ModuleInfo
 
 SCOPES = ("net/", "beacon/")
-TOOL_FILES = {"bench.py", "autotune.py", "loadgen.py", "chaos_smoke.py"}
+TOOL_FILES = {"bench.py", "autotune.py", "loadgen.py", "chaos_smoke.py",
+              # the fleet harness lives under tests/ but is NOT exempt:
+              # pytest's watchdog can't unwedge a supervisor stuck in a
+              # subprocess wait — a hung fleet run must die in minutes
+              "fleet.py"}
+
+# method-shaped socket blockers: with no `settimeout` discipline in the
+# enclosing class these wait forever (the chaos proxy's accept loop and
+# pump recv are the canonical sites)
+SOCKET_BLOCKERS = ("accept", "recv")
 
 
 def _is_test_code(rel: str) -> bool:
@@ -46,11 +55,23 @@ def _is_test_code(rel: str) -> bool:
 
 
 def _in_scope(rel: str) -> bool:
+    if os.path.basename(rel) in TOOL_FILES:
+        return True         # before the test exemption: tests/fleet.py
     if _is_test_code(rel):
         return False
-    if any(rel.startswith(s) or f"/{s}" in f"/{rel}" for s in SCOPES):
-        return True
-    return os.path.basename(rel) in TOOL_FILES
+    return any(rel.startswith(s) or f"/{s}" in f"/{rel}" for s in SCOPES)
+
+
+def _has_settimeout(tree: ast.AST) -> bool:
+    """True when the subtree ever arms a non-None socket timeout — the
+    discipline that turns accept()/recv() into bounded poll slices."""
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "settimeout" and n.args \
+                and not (isinstance(n.args[0], ast.Constant)
+                         and n.args[0].value is None):
+            return True
+    return False
 
 
 class DeadlineChecker:
@@ -63,6 +84,7 @@ class DeadlineChecker:
               project: Optional[object] = None) -> Iterator[Finding]:
         if not _in_scope(module.rel):
             return
+        yield from self._socket_loops(module)
         from ..project import blocking_call
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call):
@@ -94,3 +116,25 @@ class DeadlineChecker:
                                  "a budget from this caller"),
                         path=module.rel, line=node.lineno,
                         col=node.col_offset)
+
+    def _socket_loops(self, module: ModuleInfo) -> Iterator[Finding]:
+        """accept()/recv() with no settimeout discipline in the tightest
+        enclosing class (or the module, for free functions): the socket
+        blocks forever, so a wedged link hangs supervisor teardown."""
+        def walk(node: ast.AST, owner: ast.AST) -> Iterator[Finding]:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.Call) \
+                        and isinstance(child.func, ast.Attribute) \
+                        and child.func.attr in SOCKET_BLOCKERS \
+                        and not _has_settimeout(owner):
+                    yield Finding(
+                        checker=self.name, code="deadline-unbounded-call",
+                        message=(f".{child.func.attr}() with no settimeout "
+                                 "discipline in scope; a silent peer holds "
+                                 "this thread forever — arm a poll-slice "
+                                 "timeout on the socket"),
+                        path=module.rel, line=child.lineno,
+                        col=child.col_offset)
+                nxt = child if isinstance(child, ast.ClassDef) else owner
+                yield from walk(child, nxt)
+        yield from walk(module.tree, module.tree)

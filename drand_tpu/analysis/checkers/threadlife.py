@@ -60,6 +60,7 @@ REGISTERED_PREFIXES = (
     "stop-",            # async stop trampolines
     "loadgen-", "bench-",          # operator tools
     "probe-",           # preflight probes
+    "chaos-",           # chaos proxy accept loop + stream pumps
 )
 
 

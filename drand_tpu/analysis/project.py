@@ -90,6 +90,10 @@ BLOCKING_CALLS = {
 # these names are unambiguous in practice — Popen.communicate)
 BLOCKING_METHODS = {
     "communicate": ("timeout", 0),
+    # Popen.wait / Event.wait / Condition.wait — all take the bound as
+    # the first positional or `timeout=`; all block forever without it
+    # (the fleet harness's subprocess reaps hang CI exactly like r06)
+    "wait": ("timeout", 0),
 }
 
 

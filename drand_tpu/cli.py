@@ -60,6 +60,44 @@ def cmd_generate_keypair(args) -> int:
     return 0
 
 
+# service threads that must be gone after a clean stop (mirrors
+# tests/harness.SERVICE_THREAD_PREFIXES — the daemon-side copy backs the
+# leaked-thread exit code, so fleet runs catch leaks without importing
+# test code into the child process)
+_SERVICE_THREAD_PREFIXES = ("verify-scheduler", "verify-packer",
+                            "verify-watchdog", "verify-probe",
+                            "transition-", "handel-")
+
+
+def _write_ready_file(path: str, daemon, cfg) -> None:
+    """Atomically publish this daemon's pid + bound ports (the fleet
+    supervisor binds everything ephemeral and reads the roster back from
+    here — no port races)."""
+    import json
+    import tempfile
+    info = {
+        "pid": os.getpid(),
+        "private": daemon.gateway.listen_addr,
+        "control": daemon.control.port,
+        "metrics": daemon.metrics.port if daemon.metrics is not None
+        else None,
+        "public": daemon.http_server.port
+        if daemon.http_server is not None else None,
+        "folder": cfg.folder,
+    }
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               prefix=".ready-")
+    with os.fdopen(fd, "w") as f:
+        json.dump(info, f)
+    os.replace(tmp, path)
+
+
+def _leaked_service_threads() -> list:
+    import threading
+    return sorted(t.name for t in threading.enumerate()
+                  if t.name.startswith(_SERVICE_THREAD_PREFIXES))
+
+
 def cmd_start(args) -> int:
     cfg = Config(
         folder=args.folder,
@@ -78,14 +116,32 @@ def cmd_start(args) -> int:
     daemon.start()
     if cfg.public_listen:
         from .http_server import RestServer
-        daemon.http_server = RestServer(daemon, cfg.public_listen)
+        daemon.http_server = RestServer(daemon, cfg.public_listen,
+                                        admission=daemon.admission)
         daemon.http_server.start()
     daemon.load_beacons_from_disk()
+    if args.ready_file:
+        _write_ready_file(args.ready_file, daemon, cfg)
+    import threading
     stopping = []
+    stoppers = []
+    drain_ok = []
 
-    def _sig(_s, _f):
-        if not stopping:
-            stopping.append(1)
+    def _graceful():
+        drain_ok.append(daemon.graceful_stop(grace=args.grace))
+
+    def _sig(s, _f):
+        if stopping:
+            return
+        stopping.append(1)
+        if s == signal.SIGTERM:
+            # drain off the signal frame: the handler runs on the main
+            # thread mid-wait_exit, and graceful_stop blocks on condvars
+            t = threading.Thread(target=_graceful, daemon=True,
+                                 name="stop-graceful")
+            stoppers.append(t)
+            t.start()
+        else:
             daemon.stop()
     signal.signal(signal.SIGTERM, _sig)
     signal.signal(signal.SIGINT, _sig)
@@ -96,6 +152,22 @@ def cmd_start(args) -> int:
             pass
     except KeyboardInterrupt:
         daemon.stop()
+    for t in stoppers:
+        t.join(timeout=args.grace + 5)
+    # teardown verdict: 0 clean; 1 drain timed out; 3 leaked service
+    # threads — the fleet invariant checker reads these exit codes
+    settle = threading.Event()
+    leaked = _leaked_service_threads()
+    for _ in range(20):
+        if not leaked:
+            break
+        settle.wait(0.1)
+        leaked = _leaked_service_threads()
+    if leaked:
+        print(f"leaked service threads: {leaked}", file=sys.stderr)
+        return 3
+    if drain_ok and not drain_ok[0]:
+        return 1
     return 0
 
 
@@ -299,6 +371,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dkg-timeout", type=int, default=10)
     p.add_argument("--no-tpu", action="store_true",
                    help="host-only partial verification")
+    p.add_argument("--ready-file", default=_env("ready_file", ""),
+                   help="write pid + bound ports here once serving "
+                        "(fleet supervisors; DRAND_READY_FILE)")
+    p.add_argument("--grace", type=float,
+                   default=float(_env("grace", 10.0)),
+                   help="SIGTERM drain budget in seconds (DRAND_GRACE)")
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("stop", help="shut the daemon down")

@@ -7,6 +7,7 @@ localhost control listener, the optional public REST edge and metrics
 server, and the on-disk multibeacon layout.
 """
 
+import json
 import os
 import tempfile
 import threading
@@ -37,6 +38,10 @@ class DrandDaemon:
         self.chain_hashes: Dict[str, str] = {}      # hex hash -> beacon_id
         self._lock = threading.Lock()
         self._exit = threading.Event()
+        # graceful-shutdown flag (SIGTERM drain): /health flips ready to
+        # false the moment the drain starts, so fleet supervisors and
+        # orchestrators stop routing to a terminating node
+        self.draining = False
 
         self.resilience = cfg.make_resilience(scope="node")
         # multi-tenant registry (core/tenancy.py): who owns each chain,
@@ -70,6 +75,7 @@ class DrandDaemon:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
+        self._note_start()
         self.gateway.start_all()
         self.control.start()
         if self.metrics is not None:
@@ -77,6 +83,33 @@ class DrandDaemon:
         self.log.info("daemon started",
                       private=self.gateway.listen_addr,
                       control=self.control.port)
+
+    def _note_start(self) -> None:
+        """Restart observability (fleet harness): bump the persisted
+        start counter in <folder>/restarts.json and export it — plus
+        this process's start stamp — through /metrics, so a supervisor
+        asserts restart counts from a scrape instead of log archaeology.
+        The counter survives the process because it lives in the beacon
+        folder; the write is atomic (tmp + rename) so a crash mid-write
+        never leaves a torn file."""
+        from ..metrics import daemon_restarts_total, daemon_start_time_seconds
+        daemon_start_time_seconds.set(self.cfg.clock.now())
+        os.makedirs(self.cfg.folder, exist_ok=True)
+        path = os.path.join(self.cfg.folder, "restarts.json")
+        starts = 0
+        try:
+            with open(path) as f:
+                starts = int(json.load(f).get("starts", 0))
+        except (OSError, ValueError):
+            pass
+        starts += 1
+        fd, tmp = tempfile.mkstemp(dir=self.cfg.folder,
+                                   prefix=".restarts-")
+        with os.fdopen(fd, "w") as f:
+            json.dump({"starts": starts}, f)
+        os.replace(tmp, path)
+        if starts > 1:
+            daemon_restarts_total.inc(starts - 1)
 
     def stop(self) -> None:
         for bp in list(self.processes.values()):
@@ -92,6 +125,26 @@ class DrandDaemon:
         # not tear it down — the daemon's exit does)
         self.cfg.stop_verify_service()
         self._exit.set()
+
+    def graceful_stop(self, grace: float = 10.0) -> bool:
+        """SIGTERM drain path (cli.cmd_start): stop admitting sheddable
+        and normal work (critical partials in flight finish), flush the
+        verify service's BACKGROUND lane, then run the hard stop().
+        Bounded: each drain gets half of `grace` REAL seconds and the
+        hard stop runs either way.  Returns True when both drains
+        completed in time — the caller maps this to the exit code."""
+        self.draining = True
+        self.log.info("graceful stop: draining", grace=grace)
+        ok = True
+        try:
+            self.admission.begin_drain()
+            ok = self.admission.drained(grace / 2)
+            vs = self.cfg._verify_service
+            if vs is not None:
+                ok = vs.flush_background(grace / 2) and ok
+        finally:
+            self.stop()
+        return ok
 
     def wait_exit(self, timeout: Optional[float] = None) -> bool:
         return self._exit.wait(timeout)

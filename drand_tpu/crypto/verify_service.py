@@ -2032,6 +2032,33 @@ class VerifyService:
         with self._cond:
             return self._bg_paused
 
+    def flush_background(self, timeout: float) -> bool:
+        """Graceful-shutdown flush (SIGTERM drain path): lift any
+        admission-ladder pause, mark every queued BACKGROUND request
+        flush-ready (so coalescing windows don't hold the drain open),
+        and wait until the background queues are empty — bounded by
+        `timeout` REAL seconds (condvar waits are wall-clock; a fake
+        clock cannot hang this).  Returns True when the lane drained in
+        time; the caller proceeds to stop() either way."""
+        with self._cond:
+            if self._stopped:
+                return True
+            if self._bg_paused:
+                self._bg_paused = False
+            for st in self._streams.values():
+                for r in st.queues[LANE_BACKGROUND]:
+                    r.flush = True
+            self._cond.notify_all()
+        slices = max(1, int(timeout / 0.05))
+        for _ in range(slices):
+            with self._cond:
+                if self._stopped \
+                        or self._qdepth_locked(LANE_BACKGROUND) == 0:
+                    return True
+                self._cond.wait(0.05)
+        with self._cond:
+            return self._qdepth_locked(LANE_BACKGROUND) == 0
+
     def degraded_backends(self) -> List[str]:
         """Labels of backends currently failed over to the host path
         (degraded or mid-probe) — the /health degraded line."""

@@ -526,6 +526,19 @@ class RestServer:
             payload["verify_degraded"] = bool(degraded)
             if degraded:
                 payload["verify_degraded_backends"] = degraded
+        # readiness vs liveness split (fleet harness / orchestrators):
+        # `live` means "the process answers HTTP" — true by construction
+        # when this handler runs; `ready` means "route traffic to me":
+        # DKG complete (a group exists), chain head within one round of
+        # clock-expected (status == 200 encodes it), not draining toward
+        # a SIGTERM exit, and the verify plane not degraded to the host
+        # path.  getattr: shim daemons in tests carry no draining flag.
+        payload["live"] = True
+        payload["ready"] = bool(
+            status == 200
+            and bp is not None and getattr(bp, "group", None) is not None
+            and not getattr(self.daemon, "draining", False)
+            and not payload.get("verify_degraded", False))
         body = json.dumps(payload).encode()
         return status, body, {}
 

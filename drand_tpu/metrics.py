@@ -88,6 +88,18 @@ reshare_state_timestamp = Gauge(
     ["beacon_id"], registry=GROUP)
 drand_node_db = Gauge(
     "drand_node_db", "Storage engine in use", ["db"], registry=PRIVATE)
+# restart observability (fleet harness, ISSUE 18): the gauge is this
+# process's start stamp; the counter is seeded from the persisted
+# restarts.json in the beacon folder so fleet runs assert restart counts
+# from a metrics scrape instead of scraping logs
+daemon_start_time_seconds = Gauge(
+    "daemon_start_time_seconds", "Unix time this daemon process started",
+    registry=PRIVATE)
+daemon_restarts_total = Counter(
+    "daemon_restarts_total",
+    "Daemon starts beyond the first against this beacon folder "
+    "(persisted across processes in <folder>/restarts.json)",
+    registry=PRIVATE)
 error_sending_partial = Counter(
     "error_sending_partial", "Failed partial beacon sends",
     ["beacon_id", "address"], registry=GROUP)

@@ -6,7 +6,8 @@ service specs in services.py; the generic service framework in rpc.py.
 
 from .admission import (AdmissionController, AdmissionInterceptor, Shed,
                         Ticket)
-from .client import CertManager, Peer, ProtocolClient
+from .chaosproxy import ChaosLink, LinkFault, ProxyMesh
+from .client import CertManager, DialMap, Peer, ProtocolClient
 from .listener import (ControlClient, ControlListener, Listener,
                        PrivateGateway)
 from .resilience import (BackoffPolicy, BreakerOpen, BreakerRegistry,
@@ -20,4 +21,5 @@ __all__ = [
     "PUBLIC", "BackoffPolicy", "BreakerOpen", "BreakerRegistry",
     "CircuitBreaker", "Deadline", "DeadlineExceeded", "ResiliencePolicy",
     "AdmissionController", "AdmissionInterceptor", "Shed", "Ticket",
+    "ChaosLink", "LinkFault", "ProxyMesh", "DialMap",
 ]

@@ -92,6 +92,7 @@ REASON_TENANT_PAUSED = "tenant-paused"   # weight 0 / admin pause
 REASON_TENANT_RATE = "tenant-rate"       # per-tenant token bucket empty
 REASON_TENANT_LEVEL = "tenant-level"     # over-quota: shed one rung early
 REASON_TENANT_SHARE = "tenant-share"     # weighted fair share exceeded
+REASON_DRAINING = "draining"    # graceful shutdown: only critical admitted
 
 
 class Shed(Exception):
@@ -215,6 +216,10 @@ class AdmissionController:
         # buckets ([tokens, last-refill stamp], injected clock)
         self._tenant_inflight: Dict[str, int] = {}
         self._tenant_buckets: Dict[str, List[float]] = {}
+        # graceful-shutdown drain gate: once set, sheddable/normal shed
+        # immediately (REASON_DRAINING) while critical keeps flowing so
+        # in-flight partials can finish before the process exits
+        self._draining = False
 
     # -- admission ------------------------------------------------------------
 
@@ -249,6 +254,10 @@ class AdmissionController:
         try:
             with self._cond:
                 hook = self._reassess_locked(now0)
+                if self._draining and cls != CLASS_CRITICAL:
+                    self._note_shed_locked(cls, REASON_DRAINING, now0)
+                    raise Shed(cls, REASON_DRAINING, self.retry_after_s,
+                               tenant=view.name if view else None)
                 self._check_tenant_locked(cls, view, now0)
                 self._check_level_locked(cls, now0, view=view)
                 if cls == CLASS_NORMAL and stream and peer is not None \
@@ -453,6 +462,34 @@ class AdmissionController:
             # cv-slice bounded in real time; released tokens notify
             self._cond.wait(0.05)
 
+    # -- graceful drain (SIGTERM path) ----------------------------------------
+
+    def begin_drain(self) -> None:
+        """Flip the drain gate: from now on sheddable and normal admits
+        shed immediately with REASON_DRAINING; critical keeps being
+        admitted so in-flight protocol work (partials) can finish.
+        Idempotent."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    def is_draining(self) -> bool:
+        with self._cond:
+            return self._draining
+
+    def drained(self, timeout: float) -> bool:
+        """Block until no critical request is in flight, or `timeout`
+        REAL seconds elapse (condvar waits are wall-clock; a fake clock
+        cannot hang this).  Returns True when the critical lane is dry —
+        the caller (graceful_stop) may then tear the services down."""
+        slices = max(1, int(timeout / 0.05))
+        with self._cond:
+            for _ in range(slices):
+                if self._inflight[CLASS_CRITICAL] == 0:
+                    return True
+                self._cond.wait(0.05)
+            return self._inflight[CLASS_CRITICAL] == 0
+
     def _release(self, ticket: Ticket) -> None:
         from ..metrics import admission_inflight
         hook = None
@@ -639,6 +676,7 @@ class AdmissionController:
             return {
                 "level": lvl,
                 "level_name": LEVEL_NAMES[lvl],
+                "draining": self._draining,
                 "inflight": dict(self._inflight),
                 "admitted": dict(self._admitted),
                 "shed": {f"{c}/{r}": v

@@ -1,0 +1,281 @@
+"""Userspace TCP chaos proxy: per-link network faults with no root.
+
+The process-fleet harness (tests/fleet.py) routes every inter-node gRPC
+connection through one `ChaosLink` — a tiny TCP forwarder owned by the
+supervisor process — so link-level faults (partition, delay, throttle,
+mid-stream reset) are injected in userspace, which works inside CI
+containers where iptables/tc are unavailable.  The daemons themselves are
+untouched: they dial the proxy address instead of the real peer via the
+`DRAND_DIAL_MAP` indirection in net/client.py.
+
+Topology: one link per ORDERED pair (dialer, target).  A 2|3 partition
+is "drop every link crossing the cut, both directions, and reset the
+streams already up"; a heal clears the drop and gRPC's own reconnect does
+the rest.  Faults are plain attributes toggled by the supervisor thread;
+the pump threads read them per chunk, so a fault takes effect mid-stream
+without tearing the proxy down.
+
+Everything here is wall-clock by design (it shapes real wire traffic for
+real subprocesses; an injected fake clock cannot reach across process
+boundaries), and every blocking socket op runs under a short settimeout
+so a wedged link can never hang the harness teardown — the fleet run must
+die in minutes, not hang CI.
+"""
+
+import socket
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# pump granularity: small enough that a fault lands within one chunk's
+# forwarding latency, big enough not to syscall-thrash a sync stream
+_CHUNK = 16384
+_POLL = 0.25        # accept/recv timeout slice; stop latency ceiling
+
+
+@dataclass
+class LinkFault:
+    """The live fault state of one directed link; mutated in place by the
+    supervisor, read per-chunk by the pumps."""
+    drop: bool = False          # partition: refuse new conns, starve pumps
+    delay: float = 0.0          # added latency per forwarded chunk (s)
+    rate: float = 0.0           # throttle, bytes/s (0 = unlimited)
+
+
+@dataclass
+class LinkStats:
+    accepted: int = 0
+    refused: int = 0            # connections closed at accept (drop mode)
+    resets: int = 0             # streams hard-reset mid-flight
+    bytes_forward: int = 0      # dialer -> target
+    bytes_backward: int = 0     # target -> dialer
+
+
+class ChaosLink:
+    """One directed proxied link: listens on an ephemeral localhost port,
+    forwards byte streams to `upstream`, applying the current `fault`."""
+
+    def __init__(self, upstream: str, name: str = "link",
+                 host: str = "127.0.0.1"):
+        self.upstream = upstream
+        self.name = name
+        self.fault = LinkFault()
+        self.stats = LinkStats()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._conns: List[socket.socket] = []
+        self._pumps: List[threading.Thread] = []
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, 0))
+        self._listener.listen(32)
+        self._listener.settimeout(_POLL)
+        self.address = "%s:%d" % self._listener.getsockname()[:2]
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name=f"chaos-accept-{name}")
+        self._thread.start()
+
+    # -- fault control (supervisor thread) -----------------------------------
+
+    def set_fault(self, drop: Optional[bool] = None,
+                  delay: Optional[float] = None,
+                  rate: Optional[float] = None) -> None:
+        if drop is not None:
+            self.fault.drop = drop
+        if delay is not None:
+            self.fault.delay = delay
+        if rate is not None:
+            self.fault.rate = rate
+
+    def heal(self) -> None:
+        self.fault = LinkFault()
+
+    def reset_streams(self) -> None:
+        """Hard-reset every live stream on this link: SO_LINGER(1, 0) turns
+        close() into an RST, so the peer sees a mid-stream connection reset
+        rather than a clean FIN."""
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             b"\x01\x00\x00\x00\x00\x00\x00\x00")
+                c.close()
+                self.stats.resets += 1
+            except OSError:
+                pass
+
+    def drop_and_reset(self) -> None:
+        """Partition this link: refuse new connections AND kill the ones
+        already up (a drop alone would let an established gRPC stream keep
+        flowing through the cut)."""
+        self.set_fault(drop=True)
+        self.reset_streams()
+
+    # -- forwarding ----------------------------------------------------------
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return          # listener closed under us: stopping
+            if self.fault.drop:
+                # partition: complete the TCP handshake (the listener's
+                # backlog already did) but reset immediately — the dialer
+                # sees UNAVAILABLE and gRPC backs off and retries
+                self.stats.refused += 1
+                self._abort(conn)
+                continue
+            try:
+                up = socket.create_connection(
+                    _split(self.upstream), timeout=2.0)
+            except OSError:
+                self.stats.refused += 1
+                self._abort(conn)
+                continue
+            self.stats.accepted += 1
+            conn.settimeout(_POLL)
+            up.settimeout(_POLL)
+            with self._lock:
+                self._conns.extend((conn, up))
+                for src, dst, fwd in ((conn, up, True), (up, conn, False)):
+                    t = threading.Thread(
+                        target=self._pump, args=(src, dst, fwd), daemon=True,
+                        name=f"chaos-pump-{self.name}")
+                    self._pumps.append(t)
+                    t.start()
+                # reap finished pump threads so a long soak's list stays
+                # bounded (joined-or-alive, never abandoned)
+                self._pumps = [t for t in self._pumps if t.is_alive()]
+
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              forward: bool) -> None:
+        budget = 0.0            # throttle token debt, seconds
+        while not self._stop.is_set():
+            if self.fault.drop:
+                break           # mid-stream partition: starve + reset below
+            try:
+                chunk = src.recv(_CHUNK)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not chunk:
+                break
+            delay = self.fault.delay
+            if delay:
+                time.sleep(delay)
+            rate = self.fault.rate
+            if rate > 0:
+                budget += len(chunk) / rate
+                if budget > 0.01:
+                    time.sleep(min(budget, 2.0))
+                    budget = 0.0
+            try:
+                dst.sendall(chunk)
+            except OSError:
+                break
+            if forward:
+                self.stats.bytes_forward += len(chunk)
+            else:
+                self.stats.bytes_backward += len(chunk)
+        for s in (src, dst):
+            self._abort(s)
+
+    @staticmethod
+    def _abort(sock: socket.socket) -> None:
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                            b"\x01\x00\x00\x00\x00\x00\x00\x00")
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.reset_streams()
+        self._thread.join(timeout=2 * _POLL + 1.0)
+        with self._lock:
+            pumps, self._pumps = self._pumps, []
+        for t in pumps:
+            t.join(timeout=2 * _POLL + 1.0)
+
+
+def _split(address: str) -> Tuple[str, int]:
+    host, _, port = address.rpartition(":")
+    return host, int(port)
+
+
+class ProxyMesh:
+    """All directed links of a fleet: nodes are opaque string keys, every
+    (dialer, target) pair gets its own `ChaosLink`, and the per-dialer
+    dial map (real target address -> that dialer's proxy address) is what
+    `DRAND_DIAL_MAP` points each daemon at."""
+
+    def __init__(self):
+        self._links: Dict[Tuple[str, str], ChaosLink] = {}
+        self._addrs: Dict[str, str] = {}
+
+    def build(self, addrs: Dict[str, str]) -> None:
+        """Create links for every ordered pair of `addrs` (node -> real
+        listen address).  Idempotent per pair: rebuilding after a node
+        restart keeps existing links (their upstream address is stable
+        because restarts re-pin the private port)."""
+        self._addrs.update(addrs)
+        for src in self._addrs:
+            for dst, upstream in self._addrs.items():
+                if src == dst or (src, dst) in self._links:
+                    continue
+                self._links[(src, dst)] = ChaosLink(
+                    upstream, name=f"{src}-{dst}")
+
+    def link(self, src: str, dst: str) -> ChaosLink:
+        return self._links[(src, dst)]
+
+    def links(self) -> Iterable[Tuple[Tuple[str, str], ChaosLink]]:
+        return self._links.items()
+
+    def dial_map_for(self, src: str) -> Dict[str, str]:
+        return {self._addrs[dst]: link.address
+                for (s, dst), link in self._links.items() if s == src}
+
+    # -- fleet-level faults --------------------------------------------------
+
+    def partition(self, side_a: Iterable[str], side_b: Iterable[str]) -> None:
+        """Drop every link crossing the A|B cut, both directions, and
+        reset the streams already up."""
+        a, b = set(side_a), set(side_b)
+        for (src, dst), link in self._links.items():
+            if (src in a and dst in b) or (src in b and dst in a):
+                link.drop_and_reset()
+
+    def isolate(self, node: str) -> None:
+        others = [n for n in self._addrs if n != node]
+        self.partition([node], others)
+
+    def heal_all(self) -> None:
+        for link in self._links.values():
+            link.heal()
+
+    def set_link(self, src: str, dst: str, **fault) -> None:
+        self._links[(src, dst)].set_fault(**fault)
+
+    def stats(self) -> Dict[str, dict]:
+        return {f"{s}->{d}": vars(link.stats)
+                for (s, d), link in self._links.items()}
+
+    def stop(self) -> None:
+        for link in self._links.values():
+            link.stop()
+        self._links.clear()

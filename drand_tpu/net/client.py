@@ -14,6 +14,7 @@ the SyncManager) so one subsystem's failures steer every other subsystem's
 peer selection.
 """
 
+import json
 import os
 import threading
 from dataclasses import dataclass
@@ -28,6 +29,45 @@ from .resilience import (HALF_OPEN, BreakerOpen, Deadline, ResiliencePolicy,
                          peer_key)
 
 DEFAULT_TIMEOUT = float(os.environ.get("DRAND_DIAL_TIMEOUT", "60"))
+
+
+class DialMap:
+    """Dial-time address indirection (the fleet chaos harness's hook,
+    net/chaosproxy.py): `DRAND_DIAL_MAP` names a JSON file mapping real
+    peer addresses to per-link proxy addresses, and every outbound channel
+    is dialed at the rewritten target.  The identity layer is untouched —
+    peers still advertise (and sign) their real addresses; only the TCP
+    connection detours through the proxy.
+
+    The file is re-read on mtime change so a supervisor can write it
+    after the daemon is already up (the fleet wires dial maps between
+    ready-file collection and the DKG kickoff); a missing or unparsable
+    file means identity — a half-written map must never black-hole the
+    dialer, so rewrite errors fail open."""
+
+    def __init__(self, path: str = ""):
+        self.path = path or os.environ.get("DRAND_DIAL_MAP", "")
+        self._stamp = None
+        self._map: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def rewrite(self, address: str) -> str:
+        if not self.path:
+            return address
+        try:
+            stamp = os.stat(self.path).st_mtime_ns
+        except OSError:
+            return address
+        with self._lock:
+            if stamp != self._stamp:
+                try:
+                    with open(self.path) as f:
+                        loaded = json.load(f)
+                    self._map = {str(k): str(v) for k, v in loaded.items()}
+                    self._stamp = stamp
+                except (OSError, ValueError):
+                    return address
+            return self._map.get(address, address)
 
 
 @dataclass(frozen=True)
@@ -94,25 +134,31 @@ class ProtocolClient:
 
     def __init__(self, certs: Optional[CertManager] = None,
                  timeout: float = DEFAULT_TIMEOUT,
-                 resilience: Optional[ResiliencePolicy] = None):
+                 resilience: Optional[ResiliencePolicy] = None,
+                 dial_map: Optional[DialMap] = None):
         self.certs = certs or CertManager()
         self.timeout = timeout
         self.resilience = resilience
+        self.dial_map = dial_map or DialMap()
         self._conns: Dict[tuple, grpc.Channel] = {}
         self._lock = threading.Lock()
 
     # -- pool ----------------------------------------------------------------
 
     def channel(self, peer: Peer) -> grpc.Channel:
-        key = (peer.address, peer.tls)   # a TLS peer must never reuse a
+        # dial indirection: the chaos harness reroutes this peer through
+        # its per-link proxy; identity (breakers, peer keys, group
+        # addresses) stays keyed on the REAL address
+        target = self.dial_map.rewrite(peer.address)
+        key = (target, peer.tls)         # a TLS peer must never reuse a
         with self._lock:                 # cached plaintext channel
             ch = self._conns.get(key)
             if ch is None:
                 if peer.tls:
-                    ch = grpc.secure_channel(peer.address,
+                    ch = grpc.secure_channel(target,
                                              self.certs.credentials())
                 else:
-                    ch = grpc.insecure_channel(peer.address)
+                    ch = grpc.insecure_channel(target)
                 self._conns[key] = ch
             return ch
 

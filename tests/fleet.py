@@ -1,0 +1,666 @@
+"""Process-fleet supervisor: N real daemons, seeded faults, invariants.
+
+Everything in-process chaos cannot reach lives here: real `DrandDaemon`
+processes (subprocess, own folder + sqlite store each), a live-gRPC
+coordinated DKG, and a seeded fault schedule — SIGKILL/SIGSTOP/SIGTERM,
+rolling restarts, and link faults through the per-link userspace TCP
+proxy (drand_tpu/net/chaosproxy.py).  No root, no iptables: each daemon
+is pointed at its own proxy addresses via the `DRAND_DIAL_MAP` file
+indirection in net/client.py, and the proxies live in THIS process, so
+fault injection is a method call.
+
+The module is import-style shared between the pytest smoke soak
+(tests/test_fleet.py), the operator CLI (tools/fleet.py), and
+`tools/chaos_smoke.py --fleet`.
+
+Deadline discipline (enforced by tpu-vet's `deadline` checker, which
+scopes this file BY NAME despite tests/ being otherwise exempt): every
+subprocess wait, ready-file poll, and RPC loop carries a hard deadline —
+a wedged fleet run must die in minutes, not hang CI.
+
+Invariants checked during/after a soak (`FleetInvariants`):
+
+  * no fork     — byte-identical beacon signatures across every node at
+                  every verified round;
+  * liveness    — rounds advance within the budget while >= threshold
+                  nodes are connected;
+  * recovery    — a killed/partitioned node catches up after heal;
+  * teardown    — SIGTERM exits 0 (graceful drain, no leaked service
+                  threads; cli.cmd_start returns 3 on a leak).
+"""
+
+import hashlib
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:                           # tools/ entry points
+    sys.path.insert(0, _REPO)
+
+from drand_tpu.net import ControlClient, Peer, ProtocolClient, ProxyMesh
+from drand_tpu.net import convert
+from drand_tpu.protos import drand_pb2 as pb
+
+SECRET = b"fleet-secret"
+
+# how long a spawned daemon gets to publish its ready file; generous for
+# a loaded CI box (cold JAX import dominates)
+READY_TIMEOUT = 90.0
+REAP_TIMEOUT = 30.0
+
+
+class FleetError(AssertionError):
+    """An invariant or supervisor-level failure; carries enough context
+    to diagnose without re-running."""
+
+
+# -- one daemon process -------------------------------------------------------
+
+class FleetNode:
+    """One real daemon process plus its folder, ready info, and signal
+    surface.  Restarts re-pin the original private/control ports so the
+    roster (and the proxy mesh upstreams) stay valid across the restart."""
+
+    def __init__(self, name: str, folder: str, env: dict, period: int,
+                 dkg_timeout: int, grace: float, log=None):
+        self.name = name
+        self.folder = folder
+        self.env = env
+        self.period = period
+        self.dkg_timeout = dkg_timeout
+        self.grace = grace
+        self.proc = None
+        self.ready = {}             # pid/private/control/metrics/public
+        self.starts = 0
+        self._log = log or (lambda *_: None)
+        os.makedirs(folder, exist_ok=True)
+
+    @property
+    def ready_path(self) -> str:
+        return os.path.join(self.folder, "ready.json")
+
+    @property
+    def private(self) -> str:
+        return self.ready["private"]
+
+    @property
+    def control(self) -> int:
+        return self.ready["control"]
+
+    def spawn(self, private_listen: str = "127.0.0.1:0",
+              control: int = 0) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            raise FleetError(f"{self.name}: already running")
+        try:
+            os.unlink(self.ready_path)
+        except OSError:
+            pass
+        cmd = [sys.executable, "-m", "drand_tpu.cli", "start",
+               "--folder", self.folder,
+               "--private-listen", private_listen,
+               "--control", str(control),
+               "--metrics", "0",
+               "--db", "sqlite",
+               "--no-tpu",
+               "--dkg-timeout", str(self.dkg_timeout),
+               "--ready-file", self.ready_path,
+               "--grace", str(self.grace)]
+        logf = open(os.path.join(self.folder, f"log.{self.starts}.txt"),
+                    "ab")
+        self.proc = subprocess.Popen(cmd, env=self.env, stdout=logf,
+                                     stderr=subprocess.STDOUT, cwd=_REPO)
+        logf.close()                # the child holds its own fd now
+        self.starts += 1
+        self._log(f"{self.name}: spawned pid={self.proc.pid} "
+                  f"listen={private_listen} control={control}")
+
+    def wait_ready(self, timeout: float = READY_TIMEOUT) -> dict:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise FleetError(
+                    f"{self.name}: daemon died rc={self.proc.returncode} "
+                    f"before ready (see {self.folder}/log.*.txt)")
+            try:
+                with open(self.ready_path) as f:
+                    self.ready = json.load(f)
+                return self.ready
+            except (OSError, ValueError):
+                time.sleep(0.1)
+        raise FleetError(f"{self.name}: not ready within {timeout}s")
+
+    def restart(self, timeout: float = READY_TIMEOUT) -> dict:
+        """Respawn with the ORIGINAL private/control ports re-pinned, so
+        the group roster (peer addresses inside the signed group file)
+        and the proxy upstreams remain correct."""
+        if self.proc is not None and self.proc.poll() is None:
+            raise FleetError(f"{self.name}: still running; kill first")
+        self.spawn(private_listen=self.private, control=self.control)
+        return self.wait_ready(timeout)
+
+    # -- signals ------------------------------------------------------------
+
+    def kill(self) -> None:
+        self._signal(signal.SIGKILL)
+
+    def sigterm(self) -> None:
+        self._signal(signal.SIGTERM)
+
+    def sigstop(self) -> None:
+        self._signal(signal.SIGSTOP)
+
+    def sigcont(self) -> None:
+        self._signal(signal.SIGCONT)
+
+    def _signal(self, sig) -> None:
+        self._log(f"{self.name}: signal {sig!r}")
+        self.proc.send_signal(sig)
+
+    def reap(self, timeout: float = REAP_TIMEOUT) -> int:
+        """Wait (bounded) for exit; SIGKILL + reap on overrun so the
+        supervisor never leaks a child, and return the exit code."""
+        try:
+            return self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self._log(f"{self.name}: reap overran {timeout}s; SIGKILL")
+            self.proc.kill()
+            return self.proc.wait(timeout=10)
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+# -- the seeded fault schedule ------------------------------------------------
+
+class FaultPlan:
+    """Deterministic fault schedule: same (seed, n, rounds) => same
+    events, byte for byte — `digest()` is the identity a CI log prints
+    so a failure reproduces locally with one seed value.
+
+    Events are (at_round, kind, params) with kinds:
+
+      kill_restart      SIGKILL one member, restart it two rounds later
+      sigterm_restart   graceful stop + restart (rolling restart)
+      freeze            SIGSTOP, SIGCONT after `hold` rounds
+      partition_heal    drop links across a seeded A|B cut, heal after
+                        `hold` rounds (minority side always < threshold
+                        complement, so the majority keeps the chain live)
+      delay_link        add per-chunk latency on one directed link
+      reset_link        hard-RST the streams of one directed link
+    """
+
+    KINDS = ("kill_restart", "sigterm_restart", "freeze",
+             "partition_heal", "delay_link", "reset_link")
+
+    def __init__(self, seed: int, n: int, rounds: int,
+                 kinds=None):
+        self.seed, self.n, self.rounds = seed, n, rounds
+        rng = random.Random(seed)
+        kinds = tuple(kinds or self.KINDS)
+        names = [f"n{i}" for i in range(n)]
+        self.events = []
+        r = 2                       # let the chain establish first
+        while r < rounds - 1:
+            kind = kinds[rng.randrange(len(kinds))]
+            if kind == "kill_restart":
+                self.events.append((r, kind, {"node": rng.choice(names),
+                                              "restart_after": 2}))
+                r += 3
+            elif kind == "sigterm_restart":
+                self.events.append((r, kind, {"node": rng.choice(names)}))
+                r += 3
+            elif kind == "freeze":
+                self.events.append((r, kind, {"node": rng.choice(names),
+                                              "hold": 1}))
+                r += 2
+            elif kind == "partition_heal":
+                minority = rng.sample(names, max(1, (n - 1) // 2))
+                self.events.append((r, kind, {"minority": sorted(minority),
+                                              "hold": 2}))
+                r += 4
+            elif kind == "delay_link":
+                src, dst = rng.sample(names, 2)
+                self.events.append((r, kind, {"src": src, "dst": dst,
+                                              "delay": 0.2, "hold": 1}))
+                r += 2
+            else:                   # reset_link
+                src, dst = rng.sample(names, 2)
+                self.events.append((r, kind, {"src": src, "dst": dst}))
+                r += 1
+
+    def digest(self) -> str:
+        ident = repr((self.seed, self.n, self.rounds, self.events))
+        return hashlib.sha256(ident.encode()).hexdigest()[:16]
+
+
+# -- the fleet ----------------------------------------------------------------
+
+class Fleet:
+    """Supervisor for N daemon processes wired through a ProxyMesh.
+
+    Lifecycle: start() -> run_dkg() -> (faults + wait_round/soak) ->
+    stop_all().  Context-manager use guarantees teardown even on a
+    failed invariant: every child is reaped and every proxy stopped."""
+
+    def __init__(self, n: int, base_dir: str, period: int = 3,
+                 threshold=None, handel_min_group: int = 2,
+                 dkg_timeout: int = 5, grace: float = 5.0, seed: int = 0,
+                 log=print):
+        self.n = n
+        self.period = period
+        self.threshold = threshold or (n // 2 + 1)
+        self.grace = grace
+        self.seed = seed
+        self.log = log or (lambda *_: None)
+        self.mesh = ProxyMesh()
+        self.client = ProtocolClient()      # direct, unproxied
+        self.nodes = {}
+        for i in range(n):
+            name = f"n{i}"
+            folder = os.path.join(base_dir, name)
+            env = dict(os.environ)
+            env.update({
+                "JAX_PLATFORMS": "cpu",
+                "DRAND_HANDEL_MIN_GROUP": str(handel_min_group),
+                "DRAND_DIAL_MAP": os.path.join(folder, "dialmap.json"),
+            })
+            # the supervisor may itself run under a dial map (nested
+            # harnesses); never inherit it into the children
+            env.pop("DRAND_READY_FILE", None)
+            self.nodes[name] = FleetNode(
+                name, folder, env, period, dkg_timeout, grace,
+                log=self.log)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.teardown()
+        return False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, ready_timeout: float = READY_TIMEOUT) -> None:
+        """Spawn every daemon, collect the roster from the ready files,
+        build the full proxy mesh, and hand each daemon its dial map."""
+        for node in self.nodes.values():
+            node.spawn()
+        for node in self.nodes.values():
+            node.wait_ready(ready_timeout)
+        self.mesh.build({nm: nd.private for nm, nd in self.nodes.items()})
+        for name in self.nodes:
+            self._write_dial_map(name)
+        self.log(f"fleet up: {self.n} daemons, "
+                 f"{sum(1 for _ in self.mesh.links())} proxied links")
+
+    def _write_dial_map(self, name: str) -> None:
+        node = self.nodes[name]
+        path = node.env["DRAND_DIAL_MAP"]
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.mesh.dial_map_for(name), f)
+        os.replace(tmp, path)
+
+    def run_dkg(self, timeout: float = 120.0, beacon_id: str = "default"):
+        """Coordinated DKG over live gRPC: node n0 leads, everyone else
+        retry-joins until the leader's setup phase accepts (mirrors
+        tests/test_daemon_e2e, but across process boundaries)."""
+        names = sorted(self.nodes)
+        leader = self.nodes[names[0]]
+        results, errors = {}, []
+
+        def drive(name, req):
+            cc = ControlClient(self.nodes[name].control)
+            join_deadline = time.monotonic() + timeout
+            while True:
+                try:
+                    results[name] = cc.stub.init_dkg(req, timeout=timeout)
+                    return
+                except Exception as e:
+                    if name == names[0] \
+                            or time.monotonic() >= join_deadline:
+                        errors.append((name, e))
+                        return
+                    time.sleep(0.3)
+
+        lead_req = pb.InitDKGPacket(
+            info=pb.SetupInfo(leader=True, nodes=self.n,
+                              threshold=self.threshold,
+                              timeout_seconds=int(timeout), secret=SECRET),
+            beacon_period_seconds=self.period,
+            metadata=convert.metadata(beacon_id))
+        join_req = pb.InitDKGPacket(
+            info=pb.SetupInfo(leader=False, leader_address=leader.private,
+                              timeout_seconds=int(timeout), secret=SECRET),
+            metadata=convert.metadata(beacon_id))
+        threads = [threading.Thread(
+            target=drive, name=f"dkg-fleet-{nm}",
+            args=(nm, lead_req if nm == names[0] else join_req))
+            for nm in names]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout + 30)
+        if errors:
+            raise FleetError(f"DKG failed: {errors}")
+        groups = {nm: convert.proto_to_group(r)
+                  for nm, r in results.items()}
+        hashes = {g.hash() for g in groups.values()}
+        if len(hashes) != 1:
+            raise FleetError(f"group divergence across nodes: {hashes}")
+        keys = {g.public_key.key() for g in groups.values()}
+        if len(keys) != 1:
+            raise FleetError("collective key fork (QUAL divergence)")
+        self.log(f"DKG complete: group hash "
+                 f"{next(iter(hashes)).hex()[:16]}")
+        return next(iter(groups.values()))
+
+    # -- observation (all via DIRECT, unproxied connections) -----------------
+
+    def head(self, name: str):
+        """Latest beacon of one node, or None while it is unreachable
+        (the client's own resilience timeout bounds the call)."""
+        node = self.nodes[name]
+        try:
+            return self.client.public_rand(Peer(node.private), 0, "default")
+        except Exception:
+            return None
+
+    def beacon(self, name: str, round_: int):
+        node = self.nodes[name]
+        try:
+            return self.client.public_rand(
+                Peer(node.private), round_, "default")
+        except Exception:
+            return None
+
+    def wait_round(self, round_: int, timeout: float,
+                   nodes=None) -> None:
+        """Block until every named (default: every live) node serves
+        `round_`; the liveness invariant is this call not overrunning."""
+        names = list(nodes or [nm for nm, nd in self.nodes.items()
+                               if nd.alive()])
+        deadline = time.monotonic() + timeout
+        pending = set(names)
+        while pending and time.monotonic() < deadline:
+            for nm in sorted(pending):
+                r = self.head(nm)
+                if r is not None and r.round >= round_:
+                    pending.discard(nm)
+            if pending:
+                time.sleep(0.3)
+        if pending:
+            heads = {nm: getattr(self.head(nm), "round", None)
+                     for nm in names}
+            raise FleetError(
+                f"liveness: round {round_} not reached on {sorted(pending)} "
+                f"within {timeout}s (heads={heads})")
+
+    def liveness_budget(self, rounds: int = 1) -> float:
+        """How long `rounds` more rounds may take: the period per round
+        plus a catch-up/aggregation allowance — generous because CI boxes
+        run CPU pairings (~0.6 s each) under load."""
+        return rounds * self.period + 12 * self.period
+
+    # -- seeded fault execution ----------------------------------------------
+
+    def execute(self, plan: FaultPlan) -> None:
+        """Run the plan: advance round by round, injecting each event at
+        its round boundary, and verify liveness of the untouched majority
+        throughout.  Deferred un-faults (restarts, heals) fire at their
+        scheduled round."""
+        self.log(f"executing plan seed={plan.seed} "
+                 f"digest={plan.digest()} events={len(plan.events)}")
+        pending = []                # (at_round, fn, label)
+        max_round = plan.rounds
+        schedule = list(plan.events)
+        for r in range(1, max_round + 1):
+            for at, fn, label in [p for p in pending if p[0] <= r]:
+                self.log(f"round {r}: deferred {label}")
+                fn()
+            pending = [p for p in pending if p[0] > r]
+            while schedule and schedule[0][0] <= r:
+                _, kind, params = schedule.pop(0)
+                self.log(f"round {r}: inject {kind} {params}")
+                pending.extend(self._inject(r, kind, params))
+            healthy = self._healthy_names()
+            if len(healthy) >= self.threshold:
+                self.wait_round(r, self.liveness_budget(), nodes=healthy)
+        # flush any still-deferred heals/restarts, then let everyone
+        # converge on the final round
+        for _, fn, label in pending:
+            self.log(f"flush deferred {label}")
+            fn()
+        self.wait_round(max_round, self.liveness_budget(4),
+                        nodes=list(self.nodes))
+
+    def _healthy_names(self):
+        return [nm for nm, nd in self.nodes.items()
+                if nd.alive() and nm not in self._faulted]
+
+    _faulted = frozenset()          # names currently killed/frozen/cut
+
+    def _inject(self, r: int, kind: str, params: dict):
+        """Apply one event; returns deferred (at_round, fn, label)
+        un-fault actions."""
+        deferred = []
+        faulted = set(self._faulted)
+        if kind == "kill_restart":
+            nm = params["node"]
+            self.nodes[nm].kill()
+            self.nodes[nm].reap()
+            faulted.add(nm)
+
+            def restart(nm=nm):
+                self.nodes[nm].restart()
+                self._write_dial_map(nm)
+                self._faulted = frozenset(self._faulted - {nm})
+            deferred.append((r + params.get("restart_after", 2), restart,
+                             f"restart {nm}"))
+        elif kind == "sigterm_restart":
+            nm = params["node"]
+            self.nodes[nm].sigterm()
+            rc = self.nodes[nm].reap()
+            if rc != 0:
+                raise FleetError(
+                    f"{nm}: SIGTERM exit rc={rc} (want 0: graceful drain "
+                    "failed or service threads leaked)")
+            self.nodes[nm].restart()
+            self._write_dial_map(nm)
+        elif kind == "freeze":
+            nm = params["node"]
+            self.nodes[nm].sigstop()
+            faulted.add(nm)
+
+            def thaw(nm=nm):
+                self.nodes[nm].sigcont()
+                self._faulted = frozenset(self._faulted - {nm})
+            deferred.append((r + params.get("hold", 1), thaw,
+                             f"thaw {nm}"))
+        elif kind == "partition_heal":
+            minority = list(params["minority"])
+            majority = [nm for nm in self.nodes if nm not in minority]
+            self.mesh.partition(minority, majority)
+            faulted.update(minority)
+
+            def heal(minority=tuple(minority)):
+                self.mesh.heal_all()
+                self._faulted = frozenset(self._faulted - set(minority))
+            deferred.append((r + params.get("hold", 2), heal,
+                             f"heal {sorted(minority)}|{len(majority)}"))
+        elif kind == "delay_link":
+            src, dst = params["src"], params["dst"]
+            self.mesh.set_link(src, dst, delay=params.get("delay", 0.2))
+
+            def undelay(src=src, dst=dst):
+                self.mesh.set_link(src, dst, delay=0.0)
+            deferred.append((r + params.get("hold", 1), undelay,
+                             f"undelay {src}->{dst}"))
+        elif kind == "reset_link":
+            self.mesh.link(params["src"], params["dst"]).reset_streams()
+        else:
+            raise FleetError(f"unknown fault kind {kind!r}")
+        self._faulted = frozenset(faulted)
+        return deferred
+
+    # -- teardown ------------------------------------------------------------
+
+    def stop_all(self) -> dict:
+        """SIGTERM every live daemon, reap with a hard budget, return
+        {name: exit code}.  Codes: 0 clean, 1 drain overran, 3 leaked
+        service threads, negative = died by signal."""
+        codes = {}
+        for nm, nd in sorted(self.nodes.items()):
+            if nd.alive():
+                nd.sigterm()
+        for nm, nd in sorted(self.nodes.items()):
+            if nd.proc is not None:
+                codes[nm] = nd.reap(timeout=self.grace + REAP_TIMEOUT)
+        return codes
+
+    def teardown(self) -> None:
+        """Last-resort cleanup (context-manager exit): kill anything
+        still alive, reap bounded, stop every proxy."""
+        for nd in self.nodes.values():
+            if nd.alive():
+                nd.proc.kill()
+        for nd in self.nodes.values():
+            if nd.proc is not None:
+                try:
+                    nd.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+        self.mesh.stop()
+
+
+# -- invariants ---------------------------------------------------------------
+
+class FleetInvariants:
+    """Post-hoc checks over a soaked fleet; every method raises
+    FleetError with enough context to debug from a CI log."""
+
+    def __init__(self, fleet: Fleet):
+        self.fleet = fleet
+
+    def assert_no_fork(self, up_to: int, nodes=None) -> int:
+        """Byte-identical signatures across nodes at every round.  A
+        node missing a round (pruned/still syncing) is skipped, a
+        DIFFERENT byte string is a fork.  Returns rounds compared."""
+        names = list(nodes or self.fleet.nodes)
+        compared = 0
+        for r in range(1, up_to + 1):
+            sigs = {}
+            for nm in names:
+                b = self.fleet.beacon(nm, r)
+                if b is not None and b.round == r:
+                    sigs[nm] = bytes(b.signature)
+            if len(set(sigs.values())) > 1:
+                raise FleetError(
+                    f"CHAIN FORK at round {r}: "
+                    f"{ {nm: s.hex()[:16] for nm, s in sigs.items()} }")
+            if len(sigs) >= 2:
+                compared += 1
+        return compared
+
+    def assert_caught_up(self, name: str, timeout: float) -> None:
+        """Recovery: `name` serves a head within 1 round of the fleet
+        maximum before `timeout` real seconds pass."""
+        deadline = time.monotonic() + timeout
+        gap, mine, best = None, None, None
+        while time.monotonic() < deadline:
+            heads = {nm: self.fleet.head(nm) for nm in self.fleet.nodes}
+            rounds = {nm: h.round for nm, h in heads.items()
+                      if h is not None}
+            if name in rounds and rounds:
+                mine, best = rounds[name], max(rounds.values())
+                gap = best - mine
+                if gap <= 1:
+                    return
+            time.sleep(0.3)
+        raise FleetError(
+            f"recovery: {name} stuck {gap} rounds behind "
+            f"(head {mine} vs fleet max {best}) after {timeout}s")
+
+    def assert_restart_counts(self) -> None:
+        """Every node's persisted restarts.json agrees with the
+        supervisor's own spawn bookkeeping."""
+        for nm, nd in self.fleet.nodes.items():
+            path = os.path.join(nd.folder, "restarts.json")
+            try:
+                with open(path) as f:
+                    starts = int(json.load(f).get("starts", 0))
+            except (OSError, ValueError):
+                raise FleetError(f"{nm}: unreadable {path}")
+            if starts != nd.starts:
+                raise FleetError(
+                    f"{nm}: restarts.json says {starts} starts, "
+                    f"supervisor spawned {nd.starts}")
+
+    def assert_clean_exit(self, codes: dict) -> None:
+        bad = {nm: rc for nm, rc in codes.items() if rc != 0}
+        if bad:
+            raise FleetError(
+                f"unclean exits {bad} (1=drain overran, 3=leaked "
+                "service threads, negative=killed by signal)")
+
+
+# -- canned scenario ----------------------------------------------------------
+
+def smoke_soak(base_dir: str, n: int = 5, rounds: int = 5, seed: int = 7,
+               period: int = 3, log=print) -> dict:
+    """The acceptance scenario, shared by tests/test_fleet.py,
+    tools/fleet.py and chaos_smoke --fleet: live-gRPC DKG across `n`
+    processes, `rounds` Handel rounds, one SIGKILL + restart + catch-up,
+    one seeded minority partition + heal, then a SIGTERM-all teardown.
+    Returns a result dict for logs/CI artifacts."""
+    rng = random.Random(seed)
+    with Fleet(n, base_dir, period=period, seed=seed, log=log) as fleet:
+        fleet.start()
+        group = fleet.run_dkg()
+        inv = FleetInvariants(fleet)
+        fleet.wait_round(2, fleet.liveness_budget(2))
+
+        # crash one member mid-soak; the survivors must keep advancing
+        victim = f"n{rng.randrange(n)}"
+        log(f"SIGKILL {victim}")
+        fleet.nodes[victim].kill()
+        fleet.nodes[victim].reap()
+        others = [nm for nm in fleet.nodes if nm != victim]
+        fleet.wait_round(3, fleet.liveness_budget(2), nodes=others)
+        fleet.nodes[victim].restart()
+        fleet._write_dial_map(victim)
+        inv.assert_caught_up(victim, fleet.liveness_budget(6))
+
+        # seeded minority partition through the proxies, then heal; the
+        # majority side must never stall
+        minority = sorted(rng.sample(sorted(fleet.nodes), (n - 1) // 2))
+        majority = [nm for nm in fleet.nodes if nm not in minority]
+        log(f"partition {minority} | {majority}")
+        fleet.mesh.partition(minority, majority)
+        head0 = max((getattr(fleet.head(nm), "round", 0) or 0)
+                    for nm in majority)
+        fleet.wait_round(head0 + 1, fleet.liveness_budget(2),
+                         nodes=majority)
+        fleet.mesh.heal_all()
+        for nm in minority:
+            inv.assert_caught_up(nm, fleet.liveness_budget(6))
+
+        fleet.wait_round(rounds, fleet.liveness_budget(rounds))
+        compared = inv.assert_no_fork(rounds)
+        inv.assert_restart_counts()
+        codes = fleet.stop_all()
+        inv.assert_clean_exit(codes)
+        return {
+            "n": n, "rounds": rounds, "seed": seed,
+            "group_hash": group.hash().hex(),
+            "rounds_compared": compared,
+            "victim": victim, "minority": minority,
+            "exit_codes": codes,
+            "proxy_stats": fleet.mesh.stats(),
+        }

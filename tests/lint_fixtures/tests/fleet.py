@@ -1,0 +1,54 @@
+"""Seeded fleet-harness deadline violations: the file is named fleet.py,
+so the deadline checker's test-code exemption does NOT apply — a wedged
+fleet run must die in minutes, not hang CI."""
+
+import socket
+import subprocess
+
+
+def reap(proc):
+    # BAD: Popen.wait() with no timeout — a wedged daemon hangs the
+    # supervisor forever (deadline-unbounded-call)
+    return proc.wait()
+
+
+def spawn(cmd):
+    # BAD: no timeout on the subprocess run
+    return subprocess.run(cmd, capture_output=True)
+
+
+class BadProxy:
+    """Accept loop with no settimeout discipline anywhere in the class:
+    a silent peer parks the accept thread forever."""
+
+    def __init__(self, listener):
+        self.listener = listener
+
+    def serve(self):
+        while True:
+            conn, _ = self.listener.accept()     # BAD
+            data = conn.recv(4096)               # BAD
+            conn.sendall(data)
+
+
+class GoodProxy:
+    """The poll-slice discipline: settimeout in scope bounds every
+    accept/recv to one slice."""
+
+    def __init__(self, listener):
+        self.listener = listener
+        self.listener.settimeout(0.25)
+
+    def serve(self):
+        while True:
+            try:
+                conn, _ = self.listener.accept()     # OK: bounded
+            except socket.timeout:
+                continue
+            conn.settimeout(0.25)
+            conn.recv(4096)                          # OK: bounded
+
+
+def reap_bounded(proc, budget):
+    # OK: the supervisor's budget reaches the wait
+    return proc.wait(timeout=budget)

@@ -1,0 +1,305 @@
+"""Process-fleet chaos harness tests (ISSUE 18).
+
+Three tiers:
+
+  * fast unit tests of the harness machinery itself — the chaos proxy
+    (plain TCP, no daemons), the dial-map indirection, the seeded fault
+    plan's determinism, and the graceful-drain plumbing;
+  * the tier-1 smoke soak: 5 REAL daemon processes over live gRPC
+    through the proxy mesh — coordinated DKG, >=5 Handel rounds
+    (DRAND_HANDEL_MIN_GROUP=2 forces the overlay on), one SIGKILL +
+    restart + catch-up, a seeded 2|3 partition + heal, SIGTERM-all
+    teardown with per-node exit code 0 (drain completed, zero leaked
+    service threads) and byte-identical beacons across every node;
+  * the heavy soak (>=32 daemons, full seeded FaultPlan), marked
+    slow+fleet — run via `tools/fleet.py soak`, `chaos_smoke --fleet`
+    on bigger iron, or DRAND_TPU_RUN_HEAVY=1.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from fleet import FaultPlan, Fleet, FleetInvariants, smoke_soak
+from drand_tpu.net import ChaosLink, DialMap, ProxyMesh
+from drand_tpu.net.admission import (AdmissionController, CLASS_CRITICAL,
+                                     CLASS_NORMAL, CLASS_SHEDDABLE,
+                                     REASON_DRAINING, Shed)
+
+pytestmark = pytest.mark.fleet
+
+
+# -- harness machinery (no daemon subprocesses) -------------------------------
+
+class _Echo:
+    """Tiny threaded TCP echo server for proxy tests."""
+
+    def __init__(self):
+        self.srv = socket.socket()
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(8)
+        self.srv.settimeout(0.25)
+        self.address = "%s:%d" % self.srv.getsockname()
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._serve, daemon=True)
+        self._t.start()
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._pump, args=(conn,),
+                             daemon=True).start()
+
+    def _pump(self, conn):
+        conn.settimeout(0.25)
+        while not self._stop.is_set():
+            try:
+                data = conn.recv(4096)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if not data:
+                break
+            try:
+                conn.sendall(data)
+            except OSError:
+                return
+        conn.close()
+
+    def stop(self):
+        self._stop.set()
+        self.srv.close()
+        self._t.join(timeout=2)
+
+
+def _dial(address, timeout=5.0):
+    host, _, port = address.rpartition(":")
+    s = socket.create_connection((host, int(port)), timeout=timeout)
+    s.settimeout(timeout)
+    return s
+
+
+@pytest.fixture()
+def echo():
+    e = _Echo()
+    yield e
+    e.stop()
+
+
+def test_chaos_link_forwards_and_partitions(echo):
+    link = ChaosLink(echo.address, name="t")
+    try:
+        s = _dial(link.address)
+        s.sendall(b"hello")
+        assert s.recv(5) == b"hello"
+        assert link.stats.accepted == 1
+
+        # drop: established stream is reset, new connections refused
+        link.drop_and_reset()
+        with pytest.raises(OSError):
+            for _ in range(50):         # until the RST propagates
+                s.sendall(b"x" * 8192)
+                time.sleep(0.05)
+        with pytest.raises(OSError):
+            # the reset may land at connect time or on a later send
+            bad = _dial(link.address)
+            for _ in range(50):
+                bad.sendall(b"y" * 8192)
+                time.sleep(0.05)
+        assert link.stats.resets >= 1
+
+        # heal: traffic flows again on a fresh connection
+        link.heal()
+        s2 = _dial(link.address)
+        s2.sendall(b"again")
+        assert s2.recv(5) == b"again"
+        s2.close()
+    finally:
+        link.stop()
+    # teardown joins every pump: no chaos-* thread survives
+    assert not [t.name for t in threading.enumerate()
+                if t.name.startswith("chaos-")]
+
+
+def test_chaos_link_delay(echo):
+    link = ChaosLink(echo.address, name="d")
+    try:
+        s = _dial(link.address)
+        s.sendall(b"warm")
+        assert s.recv(4) == b"warm"
+        link.set_fault(delay=0.3)
+        t0 = time.monotonic()
+        s.sendall(b"slow")
+        assert s.recv(4) == b"slow"
+        # one chunk each way through the proxy: >= 2 delay applications
+        assert time.monotonic() - t0 >= 0.5
+        s.close()
+    finally:
+        link.stop()
+
+
+def test_proxy_mesh_partition_and_heal(echo):
+    mesh = ProxyMesh()
+    # three "nodes" all upstreaming to the same echo server: the mesh
+    # only cares about link topology, not what's behind it
+    mesh.build({"a": echo.address, "b": echo.address, "c": echo.address})
+    try:
+        assert len(dict(mesh.links())) == 6      # every ordered pair
+        dm = mesh.dial_map_for("a")
+        assert set(dm) == {echo.address}         # b and c share an addr
+
+        mesh.partition(["a"], ["b", "c"])
+        # crossing links drop; the b<->c links stay clean
+        assert mesh.link("a", "b").fault.drop
+        assert mesh.link("c", "a").fault.drop
+        assert not mesh.link("b", "c").fault.drop
+
+        s = _dial(mesh.link("b", "c").address)
+        s.sendall(b"ok")
+        assert s.recv(2) == b"ok"
+        s.close()
+        with pytest.raises(OSError):
+            bad = _dial(mesh.link("a", "b").address)
+            for _ in range(50):
+                bad.sendall(b"x" * 8192)
+                time.sleep(0.05)
+
+        mesh.heal_all()
+        s = _dial(mesh.link("a", "b").address)
+        s.sendall(b"healed")
+        assert s.recv(6) == b"healed"
+        s.close()
+    finally:
+        mesh.stop()
+
+
+def test_dial_map_rewrite(tmp_path, monkeypatch):
+    path = tmp_path / "dialmap.json"
+    monkeypatch.setenv("DRAND_DIAL_MAP", str(path))
+    dm = DialMap()
+    # fail-open before the supervisor writes the file
+    assert dm.rewrite("10.0.0.1:9000") == "10.0.0.1:9000"
+    path.write_text(json.dumps({"10.0.0.1:9000": "127.0.0.1:7777"}))
+    assert dm.rewrite("10.0.0.1:9000") == "127.0.0.1:7777"
+    assert dm.rewrite("10.0.0.2:9000") == "10.0.0.2:9000"
+    # mtime-based reload picks up a rewritten map
+    os.utime(path, (time.time() + 5, time.time() + 5))
+    path.write_text(json.dumps({"10.0.0.1:9000": "127.0.0.1:8888"}))
+    os.utime(path, (time.time() + 10, time.time() + 10))
+    assert dm.rewrite("10.0.0.1:9000") == "127.0.0.1:8888"
+
+
+def test_fault_plan_deterministic():
+    p1 = FaultPlan(seed=42, n=9, rounds=40)
+    p2 = FaultPlan(seed=42, n=9, rounds=40)
+    assert p1.events == p2.events
+    assert p1.digest() == p2.digest()
+    assert p1.events, "a 40-round plan must schedule events"
+    assert FaultPlan(seed=43, n=9, rounds=40).digest() != p1.digest()
+    # every event lands strictly inside the soak window
+    assert all(2 <= at < 40 for at, _, _ in p1.events)
+    kinds = {k for _, k, _ in p1.events}
+    assert kinds <= set(FaultPlan.KINDS)
+
+
+def test_admission_drain_gate():
+    ctrl = AdmissionController()
+    held = ctrl.admit(CLASS_CRITICAL)
+    ctrl.begin_drain()
+    assert ctrl.is_draining()
+    for cls in (CLASS_NORMAL, CLASS_SHEDDABLE):
+        with pytest.raises(Shed) as exc:
+            ctrl.admit(cls)
+        assert exc.value.reason == REASON_DRAINING
+    # critical keeps flowing; drained() waits for it to finish
+    second = ctrl.admit(CLASS_CRITICAL)
+    assert ctrl.drained(0.2) is False
+    held.release()
+    second.release()
+    assert ctrl.drained(2.0) is True
+    assert ctrl.snapshot()["draining"] is True
+
+
+def test_graceful_stop_in_process(tmp_path):
+    """The drain path end to end without subprocesses: an idle daemon's
+    graceful_stop drains admission, flushes the verify lane, stops, and
+    reports clean."""
+    from drand_tpu.core.config import Config
+    from drand_tpu.core.daemon import DrandDaemon
+    cfg = Config(folder=str(tmp_path / "n0"), control_port=0,
+                 private_listen="127.0.0.1:0", use_device_verifier=False,
+                 db_engine="memdb")
+    d = DrandDaemon(cfg)
+    d.start()
+    assert d.graceful_stop(grace=5.0) is True
+    assert d.draining is True
+    with pytest.raises(Shed):
+        d.admission.admit(CLASS_SHEDDABLE)
+
+
+def test_restart_counter_persists(tmp_path):
+    from drand_tpu.core.config import Config
+    from drand_tpu.core.daemon import DrandDaemon
+    folder = str(tmp_path / "n0")
+    for _ in range(3):
+        cfg = Config(folder=folder, control_port=0,
+                     private_listen="127.0.0.1:0",
+                     use_device_verifier=False, db_engine="memdb")
+        d = DrandDaemon(cfg)
+        d.start()
+        d.stop()
+    with open(os.path.join(folder, "restarts.json")) as f:
+        assert json.load(f)["starts"] == 3
+
+
+# -- the smoke soak: real processes, real sockets -----------------------------
+
+def test_fleet_smoke_soak(tmp_path):
+    """The ISSUE 18 acceptance scenario: 5 real daemon processes, live
+    gRPC DKG through per-link chaos proxies, >=5 rounds with Handel
+    forced on, SIGKILL n? + restart + catch-up, a seeded 2|3 partition
+    + heal with the majority never stalling, then SIGTERM teardown with
+    every exit code 0 and byte-identical beacons at every round."""
+    result = smoke_soak(str(tmp_path), n=5, rounds=5, seed=7, period=3,
+                        log=lambda *_: None)
+    assert result["rounds_compared"] >= 5
+    assert set(result["exit_codes"].values()) == {0}
+    # the proxies actually carried the committee's traffic
+    assert sum(s["bytes_forward"] for s in result["proxy_stats"].values()) > 0
+    # the partition reset established streams mid-flight
+    assert sum(s["resets"] for s in result["proxy_stats"].values()) > 0
+    # the SIGKILL victim restarted: its folder says 2 starts
+    victim_folder = os.path.join(str(tmp_path), result["victim"])
+    with open(os.path.join(victim_folder, "restarts.json")) as f:
+        assert json.load(f)["starts"] == 2
+
+
+# -- the heavy soak (>=32 daemons, full seeded plan) --------------------------
+
+@pytest.mark.slow
+def test_fleet_heavy_soak(tmp_path):
+    """>=32 real daemons under the full seeded FaultPlan — kills,
+    rolling restarts, freezes, partitions, link delay/reset.  Run on
+    real iron via DRAND_TPU_RUN_HEAVY=1, `tools/fleet.py soak`, or
+    `chaos_smoke --fleet --nodes 32`."""
+    n, rounds = 32, 12
+    plan = FaultPlan(seed=11, n=n, rounds=rounds)
+    with Fleet(n, str(tmp_path), period=4, seed=11,
+               log=lambda *_: None) as fleet:
+        fleet.start()
+        fleet.run_dkg(timeout=300.0)
+        fleet.execute(plan)
+        inv = FleetInvariants(fleet)
+        assert inv.assert_no_fork(rounds) >= rounds - 2
+        inv.assert_restart_counts()
+        inv.assert_clean_exit(fleet.stop_all())

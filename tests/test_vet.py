@@ -407,6 +407,30 @@ def test_deadline_checker_catches_fixture():
                 if f.path == "net/deadline_bad.py"]) == 1
 
 
+def test_deadline_checker_covers_fleet_harness():
+    """ISSUE 18: the fleet harness (tests/fleet.py, tools/fleet.py) is in
+    deadline scope DESPITE living under tests/ — a wedged subprocess wait
+    or accept loop must die in minutes, not hang CI.  Ordinary test
+    support files keep the exemption."""
+    report = _fixture_report("deadline")
+    codes = _codes(report, "tests/fleet.py")
+    assert ("tests/fleet.py", "deadline-unbounded-call") in codes
+    msgs = [f.message for f in report.findings
+            if f.path == "tests/fleet.py"]
+    # the three seeded shapes: bare Popen.wait(), unbounded subprocess
+    # run, and the settimeout-less accept/recv loop (accept + recv)
+    assert any(".wait()" in m for m in msgs)
+    assert any("subprocess.run" in m for m in msgs)
+    assert any(".accept()" in m for m in msgs)
+    assert any(".recv()" in m for m in msgs)
+    lines = {f.line for f in report.findings if f.path == "tests/fleet.py"}
+    assert len(lines) == 4, sorted(lines)
+    # GoodProxy (settimeout discipline) and reap_bounded stay silent;
+    # the non-fleet harness file keeps the test-code exemption entirely
+    assert not any(f.path == "tests/other_harness.py"
+                   for f in report.findings)
+
+
 def test_threadlife_checker_catches_fixture():
     report = _fixture_report("threadlife")
     path = "core/threadlife_bad.py"

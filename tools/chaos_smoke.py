@@ -268,12 +268,53 @@ def handel_main(args) -> int:
     return 0 if r.ok else 1
 
 
+def fleet_main(args) -> int:
+    """--fleet mode: the process-fleet soak (tests/fleet.py) — N REAL
+    daemon processes over live gRPC through the per-link chaos proxy:
+    coordinated DKG, Handel rounds, one SIGKILL + restart + catch-up,
+    a seeded minority partition + heal, SIGTERM-all teardown.  Exit 0
+    iff every invariant held (no fork, liveness, recovery, clean
+    exits)."""
+    import json
+    import tempfile
+
+    from fleet import FleetError, smoke_soak
+
+    base = tempfile.mkdtemp(prefix="drand-fleet-")
+    try:
+        result = smoke_soak(base, n=max(args.nodes, 5),
+                            rounds=max(args.rounds, 5), seed=args.seed,
+                            period=args.period)
+    except FleetError as e:
+        print(f"FLEET INVARIANT FAILED: {e}", file=sys.stderr)
+        print(f"node folders kept for diagnosis: {base}", file=sys.stderr)
+        return 1
+    print(f"seed            : {result['seed']}")
+    print(f"nodes           : {result['n']}")
+    print(f"rounds          : {result['rounds']} "
+          f"({result['rounds_compared']} fork-compared)")
+    print(f"group hash      : {result['group_hash'][:32]}")
+    print(f"SIGKILL victim  : {result['victim']} (rejoined + caught up)")
+    print(f"partitioned     : {result['minority']} (healed + caught up)")
+    print(f"exit codes      : {result['exit_codes']}")
+    forwarded = sum(s["bytes_forward"] + s["bytes_backward"]
+                    for s in result["proxy_stats"].values())
+    resets = sum(s["resets"] for s in result["proxy_stats"].values())
+    print(f"proxied traffic : {forwarded} bytes, {resets} stream resets")
+    print("verdict         : OK")
+    import shutil
+    shutil.rmtree(base, ignore_errors=True)
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--nodes", type=int, default=5)
     ap.add_argument("--byzantine", type=int, default=2)
     ap.add_argument("--rounds", type=int, default=24)
+    ap.add_argument("--period", type=int, default=3,
+                    help="beacon period in seconds (--fleet mode)")
     ap.add_argument("--storage", action="store_true",
                     help="run the at-rest storage-fault scenario "
                          "(integrity scan + quarantine + peer repair) "
@@ -305,8 +346,15 @@ def main() -> int:
                          "(aggressor tenant flood + device-quota "
                          "saturation vs a victim tenant's live rounds) "
                          "instead of the network chaos scenario")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the process-fleet soak: N real daemon "
+                         "processes over live gRPC through the per-link "
+                         "chaos proxy (DKG, Handel rounds, SIGKILL + "
+                         "restart, partition + heal, graceful teardown)")
     args = ap.parse_args()
 
+    if args.fleet:
+        return fleet_main(args)
     if args.storage:
         return storage_main(args)
     if args.device:

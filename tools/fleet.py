@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Operator CLI for the process-fleet chaos harness (tests/fleet.py):
+
+    python tools/fleet.py smoke  [--nodes 5] [--rounds 5] [--seed 7]
+    python tools/fleet.py soak   [--nodes 32] [--rounds 20] [--seed 7]
+    python tools/fleet.py plan   [--nodes 9] [--rounds 30] [--seed 7]
+
+`smoke` runs the canned acceptance scenario (DKG + Handel rounds +
+SIGKILL/restart + partition/heal + graceful teardown) at tier-1 size.
+`soak` spawns a bigger fleet and executes the full seeded FaultPlan —
+kills, rolling restarts, freezes, partitions, link delay/reset — then
+checks every invariant.  `plan` just prints the deterministic fault
+schedule for a seed (same seed => same schedule, byte for byte).
+
+Every run is bounded: subprocess reaps, ready-file polls, and round
+waits all carry deadlines (enforced statically by tpu-vet's `deadline`
+checker, which scopes this file by name) — a wedged fleet dies in
+minutes, not hangs a terminal.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+
+def cmd_plan(args) -> int:
+    from fleet import FaultPlan
+    plan = FaultPlan(args.seed, args.nodes, args.rounds)
+    print(f"seed={plan.seed} n={plan.n} rounds={plan.rounds} "
+          f"digest={plan.digest()}")
+    for at, kind, params in plan.events:
+        print(f"  round {at:>3}: {kind:<16} {json.dumps(params)}")
+    return 0
+
+
+def cmd_smoke(args) -> int:
+    from fleet import FleetError, smoke_soak
+    base = args.dir or tempfile.mkdtemp(prefix="drand-fleet-")
+    try:
+        result = smoke_soak(base, n=args.nodes, rounds=args.rounds,
+                            seed=args.seed, period=args.period)
+    except FleetError as e:
+        print(f"FLEET INVARIANT FAILED: {e}", file=sys.stderr)
+        print(f"folders kept for diagnosis: {base}", file=sys.stderr)
+        return 1
+    print(json.dumps({k: v for k, v in result.items()
+                      if k != "proxy_stats"}, indent=2))
+    if not args.keep:
+        shutil.rmtree(base, ignore_errors=True)
+    return 0
+
+
+def cmd_soak(args) -> int:
+    from fleet import FaultPlan, Fleet, FleetError, FleetInvariants
+    base = args.dir or tempfile.mkdtemp(prefix="drand-fleet-")
+    plan = FaultPlan(args.seed, args.nodes, args.rounds)
+    print(f"fault plan digest {plan.digest()} "
+          f"({len(plan.events)} events)")
+    try:
+        with Fleet(args.nodes, base, period=args.period,
+                   seed=args.seed) as fleet:
+            fleet.start()
+            fleet.run_dkg()
+            fleet.execute(plan)
+            inv = FleetInvariants(fleet)
+            compared = inv.assert_no_fork(plan.rounds)
+            inv.assert_restart_counts()
+            codes = fleet.stop_all()
+            inv.assert_clean_exit(codes)
+    except FleetError as e:
+        print(f"FLEET INVARIANT FAILED: {e}", file=sys.stderr)
+        print(f"folders kept for diagnosis: {base}", file=sys.stderr)
+        return 1
+    print(f"soak OK: {args.nodes} nodes, {plan.rounds} rounds, "
+          f"{compared} fork-compared, exits {codes}")
+    if not args.keep:
+        shutil.rmtree(base, ignore_errors=True)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name, fn in (("plan", cmd_plan), ("smoke", cmd_smoke),
+                     ("soak", cmd_soak)):
+        p = sub.add_parser(name)
+        p.add_argument("--nodes", type=int,
+                       default=5 if name != "soak" else 32)
+        p.add_argument("--rounds", type=int,
+                       default=5 if name != "soak" else 20)
+        p.add_argument("--seed", type=int, default=7)
+        p.add_argument("--period", type=int, default=3)
+        p.add_argument("--dir", help="fleet base dir (default: tmpdir)")
+        p.add_argument("--keep", action="store_true",
+                       help="keep node folders after a green run")
+        p.set_defaults(fn=fn)
+    args = ap.parse_args()
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
