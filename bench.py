@@ -620,9 +620,19 @@ def bench_multitenant_serving(stats):
     verify service attributes measured device time per tenant.  Recorded:
     per-tenant rounds/s, quota rejections (one tenant is deliberately
     rate-capped), the chain→group placement map, and per-tenant device
-    seconds.  Value = total verified rounds/s across tenants."""
+    seconds.  Value = total verified rounds/s across tenants.
+
+    Since PR 19 the lanes also exercise the identity plane: every
+    tenant but one presents a real macaroon-style bearer token
+    (core/authz.py) that is verified before each span's admission —
+    the same check the REST/gRPC edges run — and the remaining lane
+    stays anonymous, so `multitenant_authenticated` records both read
+    paths side by side."""
+    import shutil
+    import tempfile
     import threading
 
+    from drand_tpu.core.authz import TokenAuthority
     from drand_tpu.core.tenancy import TenantConfig, TenantRegistry
     from drand_tpu.crypto import schemes
     from drand_tpu.crypto.verify_service import VerifyService
@@ -647,6 +657,9 @@ def bench_multitenant_serving(stats):
                                                      burst=4)),
     ][:max(2, TENANT_MAX)]
     periods = {"anchor": 3, "burst": 30, "heavy-g2": 30, "capped": 30}
+    authority_dir = tempfile.mkdtemp(prefix="drand-bench-authz-")
+    authority = TokenAuthority(authority_dir)
+    lane_tokens = {}
     chains = {}
     for name, scheme_id, kw in tenants:
         chain_id = f"{name}-chain"
@@ -657,7 +670,12 @@ def bench_multitenant_serving(stats):
             f"mt-{name}")
         registry.register_chain(chain_id, pk=pub)
         chains[name] = (svc.handle(sch, pub), store)
-    _progress(f"multitenant fixtures ready: {len(chains)} tenants")
+        # every lane but "capped" reads with a real bearer token; the
+        # capped lane stays anonymous so both paths are measured
+        if name != "capped":
+            lane_tokens[name], _ = authority.mint(name, chains=(chain_id,))
+    _progress(f"multitenant fixtures ready: {len(chains)} tenants "
+              f"({len(lane_tokens)} token-bearing)")
 
     def replay(name, count_sheds=False):
         handle, store = chains[name]
@@ -666,7 +684,14 @@ def bench_multitenant_serving(stats):
         step = max(1, N_TENANT // 4)
         served = sheds = 0
         futs = []
+        token = lane_tokens.get(name)
         for lo in range(0, N_TENANT, step):
+            # authenticated lanes verify their token before every
+            # span's admission — the exact order the edges use
+            # (token check BEFORE quota spend)
+            if token is not None:
+                v = authority.verify(token, chain=f"{name}-chain")
+                assert v.ok and v.tenant == name, v
             # every span is admitted AS the tenant (the serving-path
             # read admission the REST/gRPC edges perform)
             ticket, s = ctrl.try_admit(CLASS_SHEDDABLE, tenant=name)
@@ -730,6 +755,8 @@ def bench_multitenant_serving(stats):
                                         for n, _, _ in tenants}
         stats["multitenant_per_tenant_rps"] = per_tenant
         stats["multitenant_quota_rejections"] = rejections
+        stats["multitenant_authenticated"] = {
+            n: n in lane_tokens for n, _, _ in tenants}
         stats["multitenant_placement"] = {
             st["tenant_map"].get(label, "?"): gid
             for label, gid in st["group_map"].items()}
@@ -747,6 +774,7 @@ def bench_multitenant_serving(stats):
         return total_rounds / dt
     finally:
         svc.stop()
+        shutil.rmtree(authority_dir, ignore_errors=True)
 
 
 def bench_committee_scale(stats):

@@ -8,6 +8,15 @@ class; in this codebase the dangerous values are the DKG share
 one `log.debug("dkg state", share=self.share)` and the share sits in
 every log aggregator the operator ships to.
 
+The identity plane (net/identity.py + core/authz.py) adds two more
+bearer-grade classes: the tenant-token ROOT KEY (`_root_key`,
+`token_key` — whoever holds it mints arbitrary tenant tokens) and TLS
+PRIVATE KEYS (`key_pem`, `node_key`, `tls_key`, `ca_key` — whoever
+holds one impersonates the node, or with the CA key the whole roster).
+Token *ids* and certificate PEMs (`cert_pem`, `ca_pem` public halves)
+are deliberately NOT matched: ids are public handles and certs are what
+the wire already shows every peer.
+
 Taint-lite, intra-function:
 
   * sources — names/attributes whose terminal identifier is secret-ish
@@ -45,8 +54,12 @@ from ..core import Finding
 from ..symbols import ModuleInfo, dotted
 
 SECRET_IDS = re.compile(
-    r"^(secret|secrets|sk|pri_key|private|private_key|secret_key|"
-    r"longterm|share|_share|new_share|old_share|dist_share)$")
+    r"^_?(secret|secrets|sk|pri_key|private|private_key|secret_key|"
+    r"longterm|share|new_share|old_share|dist_share|"
+    # identity plane (PR 19): the token-authority root key mints
+    # arbitrary tenant tokens, a node's TLS private key impersonates it
+    # to the whole committee — both are bearer-grade material.
+    r"root_key|token_key|key_pem|tls_key|node_key|ca_key)$")
 
 SAFE_IDS = {"secret_proof", "share_index", "sharemap", "shares_total"}
 

@@ -651,7 +651,7 @@ class HandelCoordinator:
         sess.add_own(partial)
         sess._send_pass()
 
-    def receive(self, pkt, peer: Optional[str] = None) -> None:
+    def receive(self, pkt, peer: Optional[str] = None, auth=None) -> None:
         """One wire candidate (daemon ingress).  Raises ValueError on
         protocol violations (mapped to INVALID_ARGUMENT upstream).
 
@@ -663,17 +663,38 @@ class HandelCoordinator:
         victim's index on forged candidates and farm the victim's
         session-local score demotion (the one per-peer state content
         offences feed).  Host-granular by design — the client dials from
-        an ephemeral port, and finer binding belongs to mTLS."""
+        an ephemeral port.
+
+        `auth` (net/identity.py PeerIdentity) is the mTLS-authenticated
+        sender: when present it REPLACES the IP heuristic — the roster
+        host of the claimed index must appear in the sender cert's SAN
+        set, which holds for DNS-named rosters too (the PR 15
+        `sender_binding_enforceable` carve-out, now enforced; ISSUE 19).
+        Either way a mismatch is rejected at ingress, metered, and never
+        reaches the session — the honest owner of the claimed index is
+        not demoted by someone else's forgery."""
         from ..metrics import handel_candidates
         round_, prev_sig, level, sender, agg = from_packet(pkt)
         if not (0 <= sender < self.n):
             raise ValueError(f"handel sender index {sender} out of range")
-        if peer is not None and self.score_key is not None:
+        if auth is not None and self.score_key is not None:
+            claimed = self.score_key(sender)
+            if not auth.matches(peer_host(claimed)):
+                from ..metrics import identity_rejections
+                handel_candidates.labels(self.beacon_id,
+                                         "impersonation").inc()
+                identity_rejections.labels("handel", "impersonation").inc()
+                raise ValueError(
+                    f"handel sender index {sender} is registered at "
+                    f"{claimed}, but the packet was authenticated as "
+                    f"{auth.label}")
+        elif peer is not None and self.score_key is not None:
             claimed = self.score_key(sender)
             # enforce only for IP-literal rosters: the transport peer is
             # always numeric, so a DNS-named roster entry can never
             # match and enforcing would reject every honest packet
-            # (sender_binding_enforceable; DNS rosters bind with mTLS)
+            # (sender_binding_enforceable; DNS rosters bind with mTLS
+            # via `auth` above)
             if sender_binding_enforceable(claimed) \
                     and peer_host(claimed) != peer_host(peer):
                 handel_candidates.labels(self.beacon_id,

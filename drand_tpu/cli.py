@@ -36,10 +36,17 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="beacon id (multi-beacon daemons)")
     p.add_argument("--json", action="store_true", help="JSON log output")
     p.add_argument("--verbose", action="store_true")
+    p.add_argument("--identity-dir", default=_env("identity_dir", ""),
+                   help="cert dir (node.key/node.crt/ca.crt) for mutual "
+                        "TLS — on `start` it arms the identity plane; on "
+                        "control commands it authenticates the client "
+                        "(DRAND_IDENTITY_DIR)")
 
 
 def _control(args) -> ControlClient:
-    return ControlClient(args.control)
+    return ControlClient(args.control,
+                         identity_dir=getattr(args, "identity_dir", "")
+                         or None)
 
 
 def _md(args):
@@ -99,6 +106,7 @@ def _leaked_service_threads() -> list:
 
 
 def cmd_start(args) -> int:
+    identity_dir = getattr(args, "identity_dir", "") or None
     cfg = Config(
         folder=args.folder,
         private_listen=args.private_listen,
@@ -107,8 +115,9 @@ def cmd_start(args) -> int:
         metrics_port=args.metrics,
         db_engine=args.db,
         pg_dsn=getattr(args, "pg_dsn", ""),
-        insecure=not (args.tls_cert and args.tls_key),
+        insecure=not (identity_dir or (args.tls_cert and args.tls_key)),
         tls_cert=args.tls_cert, tls_key=args.tls_key,
+        identity_dir=identity_dir,
         dkg_timeout=args.dkg_timeout,
         use_device_verifier=not args.no_tpu)
     from .core.daemon import DrandDaemon
@@ -275,6 +284,58 @@ def cmd_sync(args) -> int:
     return 0
 
 
+def cmd_token(args) -> int:
+    """Tenant token mint/revoke/list over the Control plane (ISSUE 19).
+    The minted token string is printed exactly once — the daemon's ledger
+    keeps only its id and caveat metadata."""
+    cc = _control(args)
+    if args.token_cmd == "mint":
+        resp = cc.stub.token_mint(pb.TokenMintRequest(
+            tenant=args.tenant, chains=args.chains,
+            ttl_seconds=args.ttl, read_only=args.read_only,
+            metadata=_md(args)))
+        print(f"token-id: {resp.token_id}")
+        if resp.expires:
+            print(f"expires: {resp.expires:.0f}")
+        print(resp.token)
+        return 0
+    if args.token_cmd == "revoke":
+        cc.stub.token_revoke(pb.TokenRequest(token_id=args.token_id,
+                                             metadata=_md(args)))
+        print(f"revoked {args.token_id}")
+        return 0
+    for t in cc.stub.token_list(pb.TokenRequest(metadata=_md(args))).tokens:
+        state = "revoked" if t.revoked else "active"
+        caveats = []
+        if t.chains:
+            caveats.append("chains=" + ",".join(t.chains))
+        if t.expires:
+            caveats.append(f"expires={t.expires:.0f}")
+        if t.read_only:
+            caveats.append("read-only")
+        print(f"{t.token_id}  {t.tenant}  {state}"
+              + ("  " + " ".join(caveats) if caveats else ""))
+    return 0
+
+
+def cmd_identity(args) -> int:
+    """Provision the mTLS identity plane: a CA plus one cert dir per
+    roster entry (net/identity.py).  `name=host:port` pairs come from the
+    group roster; each node then starts with --identity-dir."""
+    from .net.identity import provision_fleet
+    roster = {}
+    for entry in args.nodes:
+        name, _, addr = entry.partition("=")
+        if not addr:
+            raise SystemExit(f"expected name=host[:port], got {entry!r}")
+        host = addr.rsplit(":", 1)[0] if ":" in addr else addr
+        roster[name] = [host]
+    dirs = provision_fleet(args.out, roster, days=args.days)
+    for name, d in sorted(dirs.items()):
+        print(f"{name}: {d}")
+    return 0
+
+
 def cmd_util(args) -> int:
     cc_lazy = lambda: _control(args)
     if args.util == "check":
@@ -422,6 +483,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--chain-hash")
     p.add_argument("--tls", action="store_true")
     p.set_defaults(fn=cmd_sync)
+
+    p = sub.add_parser("token", help="tenant bearer tokens (mint/revoke/list)")
+    _add_common(p)
+    p.add_argument("token_cmd", choices=["mint", "revoke", "list"])
+    p.add_argument("--tenant", default="", help="tenant the token names")
+    p.add_argument("--chains", nargs="*", default=[],
+                   help="beacon-id allowlist caveat (empty = any chain)")
+    p.add_argument("--ttl", type=float, default=0.0,
+                   help="seconds until expiry (0 = no expiry caveat)")
+    p.add_argument("--read-only", action="store_true")
+    p.add_argument("--token-id", default="", help="id to revoke")
+    p.set_defaults(fn=cmd_token)
+
+    p = sub.add_parser("identity",
+                       help="provision mTLS certs for a roster")
+    _add_common(p)
+    p.add_argument("nodes", nargs="+", metavar="name=host[:port]",
+                   help="roster entries to issue certs for")
+    p.add_argument("--out", default="identity",
+                   help="output root (CA + one cert dir per node)")
+    p.add_argument("--days", type=int, default=365,
+                   help="certificate validity in days")
+    p.set_defaults(fn=cmd_identity)
 
     p = sub.add_parser("util", help="maintenance helpers")
     _add_common(p)

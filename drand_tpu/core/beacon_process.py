@@ -359,7 +359,8 @@ class BeaconProcess:
         """The /health `handel` block (None when the overlay is off)."""
         return self.handel.summary() if self.handel is not None else None
 
-    def process_handel(self, req, peer: Optional[str] = None) -> None:
+    def process_handel(self, req, peer: Optional[str] = None,
+                       auth=None) -> None:
         """RPC ingress for drand.Protocol/HandelAggregate.  The future-
         round window check mirrors process_partial: without it a flood
         of far-future rounds would churn the coordinator's session cap
@@ -367,7 +368,10 @@ class BeaconProcess:
         transport-level gRPC sender: the coordinator rejects packets
         whose claimed sender_index is registered at a different host
         (ROADMAP 3d — score demotion must not be griefable by
-        impersonation)."""
+        impersonation).  `auth` (net/identity.py PeerIdentity, mTLS
+        only) is the cert-backed identity: when present the binding is
+        enforced on the cert's SAN set instead of the IP heuristic, so
+        DNS-named rosters get enforcement too (ISSUE 19)."""
         if self.handel is None:
             raise ValueError("handel overlay not active")
         if self.handler is not None:
@@ -376,7 +380,7 @@ class BeaconProcess:
                 raise ValueError(
                     f"handel aggregate for future round {req.round} "
                     f"(next {next_round})")
-        self.handel.receive(req, peer=peer)
+        self.handel.receive(req, peer=peer, auth=auth)
 
     def start_beacon(self, catchup: bool) -> None:
         """Create store + handler + sync plane and start the round loop

@@ -39,6 +39,20 @@ class Config:
     tls_key: Optional[str] = None
     trusted_certs: tuple = ()
     insecure: bool = True                # no TLS (test networks)
+    # identity plane (net/identity.py, ISSUE 19): a cert dir holding
+    # node.key/node.crt/ca.crt switches the node-to-node AND control
+    # planes to mutual TLS with hot-reloadable per-node certs; None (the
+    # default, env DRAND_IDENTITY_DIR in the CLI) keeps every plane
+    # exactly as before.  reload_interval rate-limits the cert-dir sweep;
+    # expiry_grace is the metered warning window an expired cert keeps
+    # serving through (0 = module defaults).
+    identity_dir: Optional[str] = None
+    identity_reload_interval: float = 0.0
+    identity_expiry_grace: float = 0.0
+    _identity: Optional[object] = field(default=None, init=False,
+                                        repr=False, compare=False)
+    _authority: Optional[object] = field(default=None, init=False,
+                                         repr=False, compare=False)
     dkg_timeout: int = DEFAULT_DKG_TIMEOUT
     dkg_kickoff_grace: float = 1.0       # leader wait before phase 1
     reshare_offset: int = DEFAULT_RESHARING_OFFSET
@@ -208,6 +222,32 @@ class Config:
         if svc is not None:
             svc.rebalance_tenants()
 
+    def identity(self):
+        """The daemon-owned identity plane (net/identity.py) when
+        `identity_dir` is set, else None.  Created on first use, bound to
+        the daemon clock so hot-reload sweeps and the expiry-grace window
+        are deterministic under a FakeClock."""
+        if self.identity_dir and self._identity is None:
+            from ..net.identity import IdentityPlane
+            kw = {}
+            if self.identity_reload_interval:
+                kw["reload_interval"] = self.identity_reload_interval
+            if self.identity_expiry_grace:
+                kw["expiry_grace"] = self.identity_expiry_grace
+            self._identity = IdentityPlane(self.identity_dir,
+                                           clock=self.clock, **kw)
+        return self._identity
+
+    def authority(self):
+        """The daemon-owned token authority (core/authz.py), created on
+        first use beside the tenant registry.  A daemon that never mints
+        stays fileless and the admission path skips token work."""
+        if self._authority is None:
+            from .authz import TokenAuthority
+            self._authority = TokenAuthority(
+                os.path.join(self.folder, "multibeacon"), clock=self.clock)
+        return self._authority
+
     def handel_config(self):
         """The overlay knob bundle (beacon/handel.py HandelConfig); zeros
         defer to the module's env-overridable defaults."""
@@ -236,7 +276,8 @@ class Config:
                 dwell=self.admission_dwell,
                 pace_rate=self.admission_pace_rate,
                 background_hook=self._pause_background,
-                tenancy=self.tenancy())
+                tenancy=self.tenancy(),
+                authority=self.authority())
         return self._admission
 
     def _pause_background(self, paused: bool) -> None:

@@ -55,6 +55,13 @@ class DrandDaemon:
         # public reads shed first (ROADMAP 5a overload protection); the
         # controller reads the tenant registry for per-tenant sub-budgets
         self.admission = cfg.admission()
+        # tenant token authority (core/authz.py): minted/revoked over the
+        # Control plane below, consulted by admission + the REST edge
+        self.authority = cfg.authority()
+        # identity plane (net/identity.py): when a cert dir is configured
+        # the private AND control planes require mutual TLS, peers are
+        # authenticated by cert SAN, and certs hot-reload on this clock
+        self.identity = cfg.identity()
         self.gateway = PrivateGateway(
             cfg.private_listen,
             protocol_impl=ProtocolService(self),
@@ -62,9 +69,11 @@ class DrandDaemon:
             tls_cert=None if cfg.insecure else cfg.tls_cert,
             tls_key=None if cfg.insecure else cfg.tls_key,
             resilience=self.resilience,
-            admission=self.admission)
+            admission=self.admission,
+            identity=self.identity)
         self.control = ControlListener(ControlService(self),
-                                       port=cfg.control_port)
+                                       port=cfg.control_port,
+                                       identity=self.identity)
         self.metrics: Optional[MetricsServer] = None
         if cfg.metrics_port is not None:
             self.metrics = MetricsServer(cfg.metrics_port,
@@ -298,10 +307,15 @@ class ProtocolService:
 
     def handel_aggregate(self, req, context):
         bp = _route(self.daemon, context, req.metadata)
+        from ..net.identity import peer_identity
         try:
             # the transport-level peer authenticates the claimed
-            # sender_index (beacon/handel.py sender-binding check)
-            bp.process_handel(req, peer=context.peer())
+            # sender_index (beacon/handel.py sender-binding check);
+            # under mTLS the cert's SAN set is the stronger binding —
+            # DNS-named rosters get enforcement the IP heuristic
+            # could not give them (ISSUE 19)
+            bp.process_handel(req, peer=context.peer(),
+                              auth=peer_identity(context))
         except ValueError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         return pb.Empty()
@@ -667,6 +681,43 @@ class ControlService:
 
     def tenant_list(self, req, context):
         return self._tenant_list_response()
+
+    # -- tenant tokens (core/authz.py, ISSUE 19) -----------------------------
+
+    def _token_list_response(self) -> pb.TokenListResponse:
+        out = pb.TokenListResponse(metadata=convert.metadata())
+        for rec in self.daemon.authority.tokens():
+            out.tokens.append(pb.TokenInfo(
+                token_id=rec.token_id, tenant=rec.tenant,
+                expires=rec.expires, read_only=rec.read_only,
+                revoked=rec.revoked, chains=list(rec.chains)))
+        return out
+
+    def token_mint(self, req, context):
+        """Mint a bearer token; the token string appears in this response
+        and nowhere else (the ledger keeps only its metadata)."""
+        from ..metrics import authz_tokens
+        try:
+            token, rec = self.daemon.authority.mint(
+                req.tenant, chains=tuple(req.chains),
+                ttl=req.ttl_seconds, read_only=req.read_only)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        authz_tokens.labels("minted").inc()
+        return pb.TokenMintResponse(token=token, token_id=rec.token_id,
+                                    expires=rec.expires,
+                                    metadata=convert.metadata())
+
+    def token_revoke(self, req, context):
+        from ..metrics import authz_tokens
+        if not self.daemon.authority.revoke(req.token_id):
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"unknown token {req.token_id!r}")
+        authz_tokens.labels("revoked").inc()
+        return self._token_list_response()
+
+    def token_list(self, req, context):
+        return self._token_list_response()
 
     def remote_status(self, req, context):
         bp = self._bp(context, req.metadata)

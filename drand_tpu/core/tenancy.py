@@ -32,11 +32,16 @@ plane (TenantSet/TenantRemove/TenantList) without a daemon restart —
 change listeners fan the update out to the admission controller and the
 verify service's placement rebalancer.
 
-Trust model: tenancy is OPERATOR configuration, not client
-authentication.  A tenant is resolved from the chain a request names
-(beacon_id / chain hash), which is public information — quotas protect
-tenants from EACH OTHER's load on a shared daemon, they are not an
-authorization boundary.  Critical-class traffic (the daemon's own group
+Trust model: tenancy is OPERATOR configuration.  A tenant is resolved
+from the chain a request names (beacon_id / chain hash), which is
+public information — quotas protect tenants from EACH OTHER's load on
+a shared daemon.  Since PR 19 the identity plane upgrades this to a
+real authorization boundary when the operator opts in: macaroon-style
+bearer tokens (core/authz.py) bind a request to a tenant + chain
+allowlist BEFORE any quota is spent, and mutual TLS (net/identity.py)
+binds node-to-node traffic to roster entries.  Without tokens/mTLS the
+pre-PR-19 behavior is unchanged (load isolation only, anonymous reads
+byte-identical).  Critical-class traffic (the daemon's own group
 partials/DKG) is never shed on a tenant's behalf: a tenant's quota can
 slow its readers, never its chain's liveness.
 """

@@ -288,7 +288,8 @@ class RestServer:
                 try:
                     code, body, headers = outer._route(
                         self.path,
-                        if_none_match=self.headers.get("If-None-Match"))
+                        if_none_match=self.headers.get("If-None-Match"),
+                        authorization=self.headers.get("Authorization"))
                 except Exception as e:
                     code, body, headers = 500, str(e).encode(), {}
                 self.send_response(code)
@@ -328,7 +329,8 @@ class RestServer:
             bh.ensure_callback()
             return bh
 
-    def _route(self, path: str, if_none_match: Optional[str] = None):
+    def _route(self, path: str, if_none_match: Optional[str] = None,
+               authorization: Optional[str] = None):
         parts = [p for p in path.split("/") if p]
         if parts == ["health"]:
             return self._health()
@@ -355,13 +357,35 @@ class RestServer:
             return 200, info.to_json(), {}
         if len(parts) == 2 and parts[0] == "public":
             api_call_counter.labels("public").inc()
+            # authenticated tenant attribution (core/authz.py): a bearer
+            # token names the tenant directly and is verified BEFORE the
+            # quota gate spends anything — a bad token is a 401 carrying
+            # the rejection reason, never a quota hit against the tenant
+            # it claims.  No token (or no authority) keeps the anonymous
+            # chain-name path byte-identical.
+            tenant = None
+            authority = getattr(self.daemon, "authority", None)
+            if authority is not None and authority.active() \
+                    and authorization is not None:
+                from .core.authz import bearer_token
+                verdict = authority.verify(bearer_token(authorization),
+                                           chain=bp.beacon_id)
+                if not verdict.ok:
+                    from .metrics import identity_rejections
+                    identity_rejections.labels("rest", verdict.reason).inc()
+                    body = json.dumps(
+                        {"error": "token rejected",
+                         "reason": verdict.reason},
+                        separators=(",", ":")).encode()
+                    return 401, body, {}
+                tenant = verdict.tenant
             # multi-tenant quota gate (core/tenancy.py): the pre-parse
             # shed can't see the chain-hash path segment, so the
             # per-tenant rules (pause / rate bucket / over-quota early
             # rung) run here, once the chain — hence the tenant — is
             # known but before any store or device work.  Rejections are
             # well-formed 429s carrying the tenant label, never silent.
-            shed = self._tenant_gate(bp)
+            shed = self._tenant_gate(bp, tenant=tenant)
             if shed is not None:
                 import math
                 body = json.dumps(
@@ -385,16 +409,19 @@ class RestServer:
             return 200, _beacon_json(beacon), headers
         return 404, b'{"error":"no such route"}', {}
 
-    def _tenant_gate(self, bp):
+    def _tenant_gate(self, bp, tenant: Optional[str] = None):
         """Per-tenant read gate: resolve the chain's tenant and consult
         the admission controller's tenant rules.  None (no registry, no
-        controller, or an admitted read) means serve."""
+        controller, or an admitted read) means serve.  `tenant` (from a
+        verified bearer token) overrides the chain-name resolution —
+        authenticated attribution beats the honor system."""
         tenancy = getattr(self.daemon, "tenancy", None)
         if tenancy is None or self.admission is None \
                 or not hasattr(self.admission, "check_tenant_read"):
             return None
         try:
-            tenant = tenancy.tenant_for_chain(bp.beacon_id)
+            if tenant is None:
+                tenant = tenancy.tenant_for_chain(bp.beacon_id)
             # attribute the pre-parse ticket to the tenant FIRST, so the
             # share check below (and concurrent admissions) count this
             # request's token against the tenant's weighted share
@@ -491,6 +518,26 @@ class RestServer:
                 tsnap = tenancy.snapshot()
                 if tsnap.get("tenants") or tsnap.get("load_error"):
                     payload["tenants"] = tsnap
+            except Exception:
+                pass
+        # identity plane (net/identity.py, ISSUE 19): cert state
+        # (fresh/grace/expired) + reload counters, and whether tenant
+        # tokens are live — a mis-rotated cert must be visible here
+        # during its grace window, before it ever bricks the mesh.
+        # Only present when an identity dir is configured.
+        identity = getattr(self.daemon, "identity", None)
+        if identity is not None:
+            try:
+                payload["identity"] = identity.status()
+            except Exception:
+                pass
+        authority = getattr(self.daemon, "authority", None)
+        if authority is not None and authority.active():
+            try:
+                payload["authz"] = {
+                    "tokens": len(authority.tokens()),
+                    "revoked": sum(1 for r in authority.tokens()
+                                   if r.revoked)}
             except Exception:
                 pass
         if svc is not None:

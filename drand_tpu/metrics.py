@@ -330,6 +330,30 @@ tenant_quota_level = Gauge(
     "window; >= 1 is over quota)",
     ["tenant"], registry=PRIVATE)
 
+# Identity plane (net/identity.py + core/authz.py, ISSUE 19): mTLS cert
+# lifecycle on the node-to-node planes and tenant-token verdicts on the
+# admission edge.  Every rejected theft attempt lands here with a bounded
+# reason label; `identity_rejections` is the series the StolenIdentity
+# chaos scenario asserts on.
+identity_cert_state = Gauge(
+    "identity_cert_state",
+    "Local mTLS cert expiry state (0 fresh, 1 grace, 2 expired; grace "
+    "and expired both keep serving — rotation is overdue, not fatal)",
+    registry=PRIVATE)
+identity_cert_reloads = Counter(
+    "identity_cert_reloads_total",
+    "Cert-dir hot reloads by result (ok | error)",
+    ["result"], registry=PRIVATE)
+identity_rejections = Counter(
+    "identity_rejections_total",
+    "Authentication rejections by surface (grpc | rest | handel) and "
+    "reason (token REASON_* values, or impersonation)",
+    ["surface", "reason"], registry=PRIVATE)
+authz_tokens = Counter(
+    "authz_tokens_total",
+    "Tenant-token lifecycle events (minted | revoked)",
+    ["event"], registry=PRIVATE)
+
 
 def scrape(which: str = "group") -> bytes:
     reg = {"private": PRIVATE, "http": HTTP, "group": GROUP,

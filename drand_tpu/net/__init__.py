@@ -8,6 +8,7 @@ from .admission import (AdmissionController, AdmissionInterceptor, Shed,
                         Ticket)
 from .chaosproxy import ChaosLink, LinkFault, ProxyMesh
 from .client import CertManager, DialMap, Peer, ProtocolClient
+from .identity import IdentityPlane, PeerIdentity, issue_cert, provision_fleet
 from .listener import (ControlClient, ControlListener, Listener,
                        PrivateGateway)
 from .resilience import (BackoffPolicy, BreakerOpen, BreakerRegistry,
@@ -22,4 +23,5 @@ __all__ = [
     "CircuitBreaker", "Deadline", "DeadlineExceeded", "ResiliencePolicy",
     "AdmissionController", "AdmissionInterceptor", "Shed", "Ticket",
     "ChaosLink", "LinkFault", "ProxyMesh", "DialMap",
+    "IdentityPlane", "PeerIdentity", "issue_cert", "provision_fleet",
 ]

@@ -173,7 +173,7 @@ class AdmissionController:
                  pace_rate: float = 0.0, pace_burst: int = 0,
                  retry_after: float = 0.0,
                  background_hook: Optional[Callable[[bool], None]] = None,
-                 tenancy=None):
+                 tenancy=None, authority=None):
         if clock is None:
             # deferred import: net must not hard-depend on beacon at
             # module scope (same softening as net/resilience.py)
@@ -197,6 +197,10 @@ class AdmissionController:
         # weights / note_decision / resolve_metadata) — None keeps every
         # pre-tenancy call site byte-identical in behavior
         self.tenancy = tenancy
+        # core/authz.py TokenAuthority (duck-typed: active / verify) —
+        # None (or an authority that never minted) keeps the anonymous
+        # chain-name attribution path untouched (ISSUE 19)
+        self.authority = authority
         self._cond = threading.Condition()
         self._inflight: Dict[str, int] = {c: 0 for c in CLASSES}
         self._peer_streams: Dict[str, int] = {}
@@ -745,6 +749,22 @@ def _shed_abort(context, shed: Shed):
     context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(shed))
 
 
+def _identity_abort(context, verdict):
+    """Reject a bad bearer token BEFORE admission — no quota is spent,
+    nothing is attributed to the tenant the token claims, and the
+    rejection carries an identity-labelled trailer + metric so theft is
+    observable (the StolenIdentityScenario asserts on both)."""
+    import grpc
+    from ..metrics import identity_rejections
+    identity_rejections.labels("grpc", verdict.reason).inc()
+    trailers = [("identity-reason", verdict.reason)]
+    if verdict.token_id:
+        trailers.append(("token-id", verdict.token_id))
+    context.set_trailing_metadata(tuple(trailers))
+    context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                  f"token rejected: {verdict.reason}")
+
+
 class AdmissionInterceptor:
     """grpc.ServerInterceptor applying the controller to every RPC of a
     listener.  Unary handlers admit/release around the behavior; stream
@@ -784,13 +804,42 @@ class AdmissionInterceptor:
             except Exception:
                 return None
 
+        def tenant_for(request, context) -> Optional[str]:
+            """Authenticated tenant attribution (core/authz.py): a
+            presented bearer token names the tenant directly — verified
+            BEFORE any quota spend, with the chain caveat checked against
+            the chain the request addresses.  A bad token aborts
+            UNAUTHENTICATED here (never reaching `admit`, so nothing is
+            attributed to the claimed tenant); no token at all keeps the
+            anonymous chain-name path byte-identical."""
+            authority = ctrl.authority
+            if authority is not None and authority.active():
+                from ..core.authz import REASON_READ_ONLY, TokenVerdict, \
+                    grpc_bearer
+                token = grpc_bearer(context.invocation_metadata())
+                if token is not None:
+                    meta = getattr(request, "metadata", None)
+                    chain = getattr(meta, "beaconID", "") or None
+                    verdict = authority.verify(token, chain=chain)
+                    if verdict.ok and verdict.read_only \
+                            and cls == CLASS_CRITICAL:
+                        # a read-only token must not reach the write-ish
+                        # node-to-node plane
+                        verdict = TokenVerdict(
+                            False, verdict.tenant, REASON_READ_ONLY,
+                            token_id=verdict.token_id)
+                    if not verdict.ok:
+                        _identity_abort(context, verdict)
+                    return verdict.tenant
+            return tenant_of(request)
+
         if handler.unary_unary is not None:
             inner = handler.unary_unary
 
             def unary(request, context):
                 try:
                     ticket = ctrl.admit(cls, peer=peer_identity(
-                        context.peer()), tenant=tenant_of(request))
+                        context.peer()), tenant=tenant_for(request, context))
                 except Shed as s:
                     _shed_abort(context, s)
                 with ticket:
@@ -807,7 +856,7 @@ class AdmissionInterceptor:
                 try:
                     ticket = ctrl.admit(cls, peer=peer_identity(
                         context.peer()), stream=True,
-                        tenant=tenant_of(request))
+                        tenant=tenant_for(request, context))
                 except Shed as s:
                     _shed_abort(context, s)
 

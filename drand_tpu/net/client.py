@@ -135,11 +135,12 @@ class ProtocolClient:
     def __init__(self, certs: Optional[CertManager] = None,
                  timeout: float = DEFAULT_TIMEOUT,
                  resilience: Optional[ResiliencePolicy] = None,
-                 dial_map: Optional[DialMap] = None):
+                 dial_map: Optional[DialMap] = None, identity=None):
         self.certs = certs or CertManager()
         self.timeout = timeout
         self.resilience = resilience
         self.dial_map = dial_map or DialMap()
+        self.identity = identity      # net/identity.py IdentityPlane or None
         self._conns: Dict[tuple, grpc.Channel] = {}
         self._lock = threading.Lock()
 
@@ -150,6 +151,28 @@ class ProtocolClient:
         # its per-link proxy; identity (breakers, peer keys, group
         # addresses) stays keyed on the REAL address
         target = self.dial_map.rewrite(peer.address)
+        if self.identity is not None:
+            # the mesh speaks mTLS on EVERY dial regardless of the peer's
+            # advertised tls flag (group files predating the identity
+            # plane carry tls=False); the channel cache is keyed on the
+            # cert epoch so a hot rotation re-dials with fresh creds
+            # instead of reusing a channel pinned to the old client cert
+            self.identity.maybe_reload()
+            epoch = self.identity.epoch
+            key = (target, True, epoch)
+            with self._lock:
+                ch = self._conns.get(key)
+                if ch is None:
+                    ch = grpc.secure_channel(
+                        target, self.identity.channel_credentials(),
+                        options=(("grpc.ssl_target_name_override",
+                                  "localhost"),))
+                    self._conns[key] = ch
+                    # drop channels pinned to superseded cert epochs
+                    for k in [k for k in self._conns
+                              if len(k) == 3 and k[2] != epoch]:
+                        self._conns.pop(k).close()
+                return ch
         key = (target, peer.tls)         # a TLS peer must never reuse a
         with self._lock:                 # cached plaintext channel
             ch = self._conns.get(key)
@@ -294,12 +317,15 @@ class ProtocolClient:
     # -- Public service ------------------------------------------------------
 
     def public_rand(self, peer: Peer, round_: int = 0,
-                    beacon_id: str = "") -> pb.PublicRandResponse:
+                    beacon_id: str = "",
+                    token: Optional[str] = None) -> pb.PublicRandResponse:
+        md = (("authorization", f"Bearer {token}"),) if token else None
         req = pb.PublicRandRequest(round=round_,
                                    metadata=convert.metadata(beacon_id))
         return self._unary(
             peer, "public_rand",
-            lambda t: self._public(peer).public_rand(req, timeout=t))
+            lambda t: self._public(peer).public_rand(req, timeout=t,
+                                                     metadata=md))
 
     def public_rand_stream(self, peer: Peer, round_: int = 0,
                            beacon_id: str = "") -> Iterator[pb.PublicRandResponse]:

@@ -30,7 +30,7 @@ class Listener:
 
     def __init__(self, address: str, handlers, tls_cert: Optional[str] = None,
                  tls_key: Optional[str] = None, max_workers: int = 16,
-                 admission=None):
+                 admission=None, identity=None):
         self.address = address
         interceptors = ()
         max_rpcs = None
@@ -53,7 +53,13 @@ class Listener:
             maximum_concurrent_rpcs=max_rpcs)
         self.server.add_generic_rpc_handlers(
             tuple(spec.handler(impl) for spec, impl in handlers))
-        if tls_cert and tls_key:
+        if identity is not None:
+            # mTLS (net/identity.py, ISSUE 19): hot-reloadable server
+            # credentials that REQUIRE a client certificate — the peer's
+            # SAN set becomes its authenticated identity downstream.
+            self.port = self.server.add_secure_port(
+                address, identity.server_credentials())
+        elif tls_cert and tls_key:
             with open(tls_key, "rb") as f:
                 key = f.read()
             with open(tls_cert, "rb") as f:
@@ -82,12 +88,14 @@ class PrivateGateway:
     def __init__(self, address: str, protocol_impl, public_impl,
                  certs: Optional[CertManager] = None,
                  tls_cert: Optional[str] = None, tls_key: Optional[str] = None,
-                 resilience=None, admission=None):
+                 resilience=None, admission=None, identity=None):
         self.listener = Listener(
             address,
             [(services.PROTOCOL, protocol_impl), (services.PUBLIC, public_impl)],
-            tls_cert=tls_cert, tls_key=tls_key, admission=admission)
-        self.client = ProtocolClient(certs=certs, resilience=resilience)
+            tls_cert=tls_cert, tls_key=tls_key, admission=admission,
+            identity=identity)
+        self.client = ProtocolClient(certs=certs, resilience=resilience,
+                                     identity=identity)
         host = address.rsplit(":", 1)[0]
         self.listen_addr = f"{host}:{self.listener.port}"
 
@@ -102,9 +110,11 @@ class PrivateGateway:
 class ControlListener:
     """Localhost control-plane server (net/control.go:23-66)."""
 
-    def __init__(self, control_impl, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(self, control_impl, port: int = 0, host: str = "127.0.0.1",
+                 identity=None):
         self.listener = Listener(f"{host}:{port}",
-                                 [(services.CONTROL, control_impl)])
+                                 [(services.CONTROL, control_impl)],
+                                 identity=identity)
         self.port = self.listener.port
 
     def start(self) -> None:
@@ -115,11 +125,29 @@ class ControlListener:
 
 
 class ControlClient:
-    """CLI-side control-plane client (net/control.go:68-96)."""
+    """CLI-side control-plane client (net/control.go:68-96).
+
+    When the daemon runs with an identity plane the control listener also
+    requires mTLS; point the client at the same cert dir (explicitly via
+    `identity_dir`, or the DRAND_IDENTITY_DIR env the CLI already exports
+    for the daemon) so operator subcommands keep working."""
 
     def __init__(self, port: int, host: str = "127.0.0.1",
-                 timeout: float = 10.0):
-        self.channel = grpc.insecure_channel(f"{host}:{port}")
+                 timeout: float = 10.0, identity_dir: Optional[str] = None):
+        target = f"{host}:{port}"
+        if identity_dir is None:
+            import os
+            identity_dir = os.environ.get("DRAND_IDENTITY_DIR") or None
+        if identity_dir:
+            from .identity import IdentityPlane
+            plane = IdentityPlane(identity_dir)
+            self.channel = grpc.secure_channel(
+                target, plane.channel_credentials(),
+                # per-node certs carry localhost SANs, but name the target
+                # explicitly so dialing via 127.0.0.1 always verifies
+                options=(("grpc.ssl_target_name_override", "localhost"),))
+        else:
+            self.channel = grpc.insecure_channel(target)
         self.timeout = timeout
         self.stub = services.CONTROL.stub(self.channel,
                                           default_timeout=timeout)

@@ -64,4 +64,10 @@ CONTROL = ServiceSpec("drand.Control", [
     Method("TenantSet", pb.TenantConfigPacket, pb.TenantListResponse),
     Method("TenantRemove", pb.TenantRequest, pb.TenantListResponse),
     Method("TenantList", pb.TenantRequest, pb.TenantListResponse),
+    # Tenant tokens (core/authz.py, ISSUE 19): macaroon mint/revoke.
+    # Control plane only — the root key never leaves the daemon, and the
+    # minted token string is returned exactly once.
+    Method("TokenMint", pb.TokenMintRequest, pb.TokenMintResponse),
+    Method("TokenRevoke", pb.TokenRequest, pb.TokenListResponse),
+    Method("TokenList", pb.TokenRequest, pb.TokenListResponse),
 ])

@@ -2383,3 +2383,434 @@ class NoisyNeighborScenario:
             silent_drops=loud["silent"],
             placement=placement,
             device_seconds=device)
+
+
+# ---------------------------------------------------------------------------
+# stolen-identity scenario (ISSUE 19): the authenticated identity plane
+# under active identity theft — real daemons, real mTLS gRPC on localhost
+# ---------------------------------------------------------------------------
+
+@dataclass
+class StolenIdentityResult:
+    """Verdict of one stolen-identity run against a live mTLS fleet."""
+    plaintext_rejected: bool          # no-cert client cannot even connect
+    victim_index: int
+    forged_packets: int               # forged sender_index packets sent
+    impersonation_rejected: int       # ... of which INVALID_ARGUMENT'd
+    impersonation_metered: bool       # identity_rejections{handel} moved
+    liveness_after_forgery: bool      # chain advanced past the flood
+    good_token_served: bool
+    token_reasons: Dict[str, str] = field(default_factory=dict)
+    token_trailers: Dict[str, str] = field(default_factory=dict)
+    victim_quota_untouched: bool = False
+    rekey_over_rotation: bool = False  # second DKG with certs rotating
+    rotation_epochs: List[int] = field(default_factory=list)
+    liveness_after_rotation: bool = False
+    control_plaintext_ok: bool = False  # no-identity fleet serves plain
+    control_header_ignored: bool = False   # token header: same bytes
+    digest: str = ""
+
+    @property
+    def ok(self) -> bool:
+        reasons_ok = (self.token_reasons.get("revoked") == "revoked"
+                      and self.token_reasons.get("expired") == "expired"
+                      and self.token_reasons.get("tampered")
+                      == "bad-signature")
+        trailers_ok = all(self.token_trailers.get(k) == v for k, v in
+                          self.token_reasons.items())
+        return (self.plaintext_rejected
+                and self.impersonation_rejected == self.forged_packets > 0
+                and self.impersonation_metered
+                and self.liveness_after_forgery
+                and self.good_token_served
+                and reasons_ok and trailers_ok
+                and self.victim_quota_untouched
+                and self.rekey_over_rotation
+                and all(e >= 1 for e in self.rotation_epochs)
+                and self.liveness_after_rotation
+                and self.control_plaintext_ok
+                and self.control_header_ignored)
+
+
+class StolenIdentityScenario:
+    """Identity theft against a live 3-node mTLS committee
+    (net/identity.py + core/authz.py; ISSUE 19).
+
+    The fleet runs real `DrandDaemon`s over localhost gRPC with per-node
+    certs from one private CA.  The attacker holds a VALID CA-signed
+    cert — transport authentication alone would admit it — whose SAN set
+    (`attacker.example` only) covers no roster entry.  Legs:
+
+      * **Forged sender_index over mTLS**: the attacker sends Handel
+        candidates claiming a victim's group index.  Every packet must
+        be rejected at ingress (INVALID_ARGUMENT naming the
+        authenticated identity), metered under
+        `identity_rejections{surface="handel"}`, and the chain must
+        keep producing — the victim is never demoted by the forgery.
+      * **Stolen/replayed tokens**: a revoked token replayed, an expired
+        token, and a tampered token are each refused UNAUTHENTICATED
+        with an `identity-reason` trailer BEFORE any quota spend — no
+        metric series ever attributes the attempts to the victim
+        tenant.  The genuine token keeps being served.
+      * **Cert rotation mid-rekey**: every node's cert is rotated while
+        a second-chain DKG is in flight; the exchange completes, every
+        plane hot-reloads (epoch bump) without a restart, and rounds
+        keep flowing.
+      * **No-identity control run**: a fleet without `identity_dir`
+        serves plaintext exactly as before — a bearer header on an
+        untenanted daemon changes nothing, byte for byte.
+
+    Real daemons produce rounds on wall clocks, so `digest` covers the
+    seed-stable verdict surface (reasons, counts, flags), not beacon
+    bytes."""
+
+    def __init__(self, seed: int, root: str, period: int = 4):
+        self.seed = seed
+        self.root = root
+        self.period = period
+        dice = random.Random(stable_seed(seed, "stolen-identity"))
+        self.victim_node = dice.randrange(1, 3)   # never the DKG leader
+
+    # -- helpers -------------------------------------------------------------
+
+    def _mk_daemon(self, folder, identity_dir=None):
+        from drand_tpu.core.config import Config
+        from drand_tpu.core.daemon import DrandDaemon
+        cfg = Config(folder=folder, control_port=0,
+                     private_listen="127.0.0.1:0", dkg_timeout=2,
+                     dkg_kickoff_grace=0.8, use_device_verifier=False,
+                     db_engine="memdb", handel_min_group=2,
+                     identity_dir=identity_dir,
+                     identity_reload_interval=0.5)
+        d = DrandDaemon(cfg)
+        d.start()
+        return d
+
+    def _run_dkg(self, daemons, sup_dir, beacon_id="default"):
+        import time
+
+        from drand_tpu.net import ControlClient, convert
+        from drand_tpu.protos import drand_pb2 as pb
+        leader_addr = daemons[0].gateway.listen_addr
+        results = [None] * len(daemons)
+        errors = []
+
+        def drive(i):
+            cc = ControlClient(daemons[i].control.port,
+                               identity_dir=sup_dir)
+            req = pb.InitDKGPacket(
+                info=pb.SetupInfo(
+                    leader=(i == 0),
+                    leader_address="" if i == 0 else leader_addr,
+                    nodes=len(daemons), threshold=2,
+                    timeout_seconds=30, secret=b"stolen-id"),
+                beacon_period_seconds=self.period,
+                metadata=convert.metadata(beacon_id))
+            deadline = time.monotonic() + 30
+            while True:
+                try:
+                    results[i] = cc.stub.init_dkg(req, timeout=120)
+                    return
+                except Exception as e:
+                    if i == 0 or time.monotonic() >= deadline:
+                        errors.append((i, e))
+                        return
+                    time.sleep(0.2)
+
+        ts = [threading.Thread(target=drive, args=(i,),
+                               name=f"stolen-dkg-{i}")
+              for i in range(len(daemons))]
+        for t in ts:
+            t.start()
+        return ts, results, errors
+
+    def _wait_round(self, pc, addr, round_, timeout=90, beacon_id="default"):
+        import time
+
+        from drand_tpu.net import Peer
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                r = pc.public_rand(Peer(addr), 0, beacon_id)
+                if r.round >= round_:
+                    return r
+            except Exception:
+                pass
+            time.sleep(0.4)
+        raise AssertionError(f"round {round_} not reached on {addr}")
+
+    @staticmethod
+    def _victim_tenant_lines():
+        from drand_tpu.metrics import scrape
+        return sorted(
+            l for l in scrape("private").decode().splitlines()
+            if 'tenant="victim"' in l and not l.startswith("#"))
+
+    # -- the run -------------------------------------------------------------
+
+    def run(self) -> StolenIdentityResult:
+        import time
+
+        import grpc
+
+        from drand_tpu.beacon import handel as H
+        from drand_tpu.metrics import identity_rejections
+        from drand_tpu.net import convert, services
+        from drand_tpu.net.identity import (IdentityPlane, issue_cert,
+                                            provision_fleet)
+        from drand_tpu.net import ControlClient, Peer, ProtocolClient
+        from drand_tpu.protos import drand_pb2 as pb
+
+        id_root = os.path.join(self.root, "identity")
+        certs = provision_fleet(
+            id_root, {f"n{i}": ["127.0.0.1"] for i in range(3)}
+            | {"supervisor": ["127.0.0.1"]}, days=365)
+        ca_dir = os.path.join(id_root, "ca")
+        # the attacker's cert IS CA-signed — transport auth admits it —
+        # but its SAN set covers no roster host
+        attacker_dir = issue_cert(os.path.join(id_root, "attacker"),
+                                  "attacker", ["attacker.example"], ca_dir)
+        sup_dir = certs["supervisor"]
+
+        daemons = [self._mk_daemon(os.path.join(self.root, f"n{i}"),
+                                   identity_dir=certs[f"n{i}"])
+                   for i in range(3)]
+        control_daemons = []
+        result = StolenIdentityResult(
+            plaintext_rejected=False, victim_index=-1, forged_packets=0,
+            impersonation_rejected=0, impersonation_metered=False,
+            liveness_after_forgery=False, good_token_served=False)
+        try:
+            addr0 = daemons[0].gateway.listen_addr
+
+            # -- leg 0: a certless client cannot reach the plane at all
+            with grpc.insecure_channel(addr0) as chan:
+                try:
+                    services.PUBLIC.stub(chan).public_rand(
+                        pb.PublicRandRequest(
+                            metadata=convert.metadata("default")),
+                        timeout=5)
+                except grpc.RpcError:
+                    result.plaintext_rejected = True
+
+            ts, dkg_results, errors = self._run_dkg(daemons, sup_dir)
+            for t in ts:
+                t.join(timeout=150)
+            assert not errors, errors
+            group = convert.proto_to_group(dkg_results[0])
+
+            pc = ProtocolClient(identity=IdentityPlane(sup_dir))
+            head = self._wait_round(pc, addr0, 1).round
+
+            # -- leg A: forged sender_index through an authenticated
+            # channel.  The claimed index belongs to a DIFFERENT node.
+            victim_addr = daemons[self.victim_node].gateway.listen_addr
+            victim_idx = next(n.index for n in group.nodes
+                              if n.identity.addr == victim_addr)
+            result.victim_index = victim_idx
+            metered0 = identity_rejections.labels(
+                "handel", "impersonation")._value.get()
+            atk_chan = grpc.secure_channel(
+                addr0, IdentityPlane(attacker_dir).channel_credentials(),
+                options=(("grpc.ssl_target_name_override", "localhost"),))
+            atk = services.PROTOCOL.stub(atk_chan)
+            forged = H.to_packet(
+                head, b"", 1, victim_idx,
+                H.Aggregate({victim_idx: victim_idx.to_bytes(2, "big")
+                             + b"\x5a" * 48}), len(group), "default")
+            for _ in range(4):
+                result.forged_packets += 1
+                try:
+                    atk.handel_aggregate(forged, timeout=10)
+                except grpc.RpcError as e:
+                    if (e.code() == grpc.StatusCode.INVALID_ARGUMENT
+                            and "authenticated as attacker"
+                            in (e.details() or "")):
+                        result.impersonation_rejected += 1
+            atk_chan.close()
+            metered1 = identity_rejections.labels(
+                "handel", "impersonation")._value.get()
+            result.impersonation_metered = \
+                metered1 - metered0 >= result.forged_packets
+            # the victim was never demoted: every node keeps producing
+            for d in daemons:
+                self._wait_round(pc, d.gateway.listen_addr, head + 2,
+                                 timeout=20 * self.period)
+            result.liveness_after_forgery = True
+
+            # -- leg B: stolen tokens.  All rejections land BEFORE any
+            # quota spend attributable to the victim tenant.
+            cc0 = ControlClient(daemons[0].control.port,
+                                identity_dir=sup_dir)
+            quota_before = self._victim_tenant_lines()
+
+            def present(token, round_=0):
+                """public_rand with a bearer token; returns
+                (response|None, reason-trailer|None)."""
+                chan = grpc.secure_channel(
+                    addr0, IdentityPlane(sup_dir).channel_credentials(),
+                    options=(("grpc.ssl_target_name_override",
+                              "localhost"),))
+                try:
+                    resp = services.PUBLIC.stub(chan).public_rand(
+                        pb.PublicRandRequest(
+                            round=round_,
+                            metadata=convert.metadata("default")),
+                        metadata=(("authorization", f"Bearer {token}"),),
+                        timeout=10)
+                    return resp, None
+                except grpc.RpcError as e:
+                    assert e.code() == grpc.StatusCode.UNAUTHENTICATED, e
+                    reason = dict(e.trailing_metadata() or ()).get(
+                        "identity-reason")
+                    return None, reason
+                finally:
+                    chan.close()
+
+            minted = cc0.stub.token_mint(pb.TokenMintRequest(
+                tenant="victim", chains=["default"], ttl_seconds=3600,
+                metadata=convert.metadata("default")), timeout=10)
+            resp, _ = present(minted.token)
+            result.good_token_served = resp is not None and resp.round >= 1
+
+            # replay after revocation
+            cc0.stub.token_revoke(pb.TokenRequest(
+                token_id=minted.token_id,
+                metadata=convert.metadata("default")), timeout=10)
+            _, reason = present(minted.token)
+            result.token_reasons["revoked"] = reason
+            result.token_trailers["revoked"] = reason
+
+            # expired (shrink the authority's skew window in-process so
+            # the leg doesn't wait out the 30 s default)
+            authority = daemons[0].authority
+            old_skew = authority.skew
+            authority.skew = 0.2
+            try:
+                short = cc0.stub.token_mint(pb.TokenMintRequest(
+                    tenant="victim", chains=["default"],
+                    ttl_seconds=0.2,
+                    metadata=convert.metadata("default")), timeout=10)
+                time.sleep(0.8)
+                _, reason = present(short.token)
+                result.token_reasons["expired"] = reason
+                result.token_trailers["expired"] = reason
+            finally:
+                authority.skew = old_skew
+
+            # tampered signature
+            parts = minted.token.split(".")
+            parts[-1] = ("0" if parts[-1][0] != "0" else "1") \
+                + parts[-1][1:]
+            _, reason = present(".".join(parts))
+            result.token_reasons["tampered"] = reason
+            result.token_trailers["tampered"] = reason
+
+            result.victim_quota_untouched = \
+                self._victim_tenant_lines() == quota_before
+
+            # -- leg C: rotate every node's cert while a second-chain
+            # DKG (a full protocol-plane key exchange) is in flight
+            ts, rot_results, rot_errors = self._run_dkg(
+                daemons, sup_dir, beacon_id="rot")
+            time.sleep(0.6)
+            for i in range(3):
+                issue_cert(certs[f"n{i}"], f"n{i}",
+                           ["127.0.0.1", "localhost"], ca_dir)
+            for t in ts:
+                t.join(timeout=150)
+            result.rekey_over_rotation = (not rot_errors
+                                          and all(r is not None
+                                                  for r in rot_results))
+            # every plane converges on the rotated trio without restart
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                result.rotation_epochs = [d.identity.epoch
+                                          for d in daemons]
+                if all(e >= 1 for e in result.rotation_epochs):
+                    break
+                for d in daemons:
+                    d.identity.maybe_reload()
+                time.sleep(0.3)
+            head2 = self._wait_round(pc, addr0, 1, timeout=60,
+                                     beacon_id="rot").round
+            self._wait_round(pc, addr0, head2 + 1,
+                             timeout=20 * self.period, beacon_id="rot")
+            result.liveness_after_rotation = True
+
+            # -- control run: no identity_dir => plaintext plane, and a
+            # bearer header on an untenanted daemon changes NOTHING
+            control_daemons = [
+                self._mk_daemon(os.path.join(self.root, f"c{i}"))
+                for i in range(2)]
+            ts, c_results, c_errors = self._run_dkg2(control_daemons)
+            for t in ts:
+                t.join(timeout=150)
+            assert not c_errors, c_errors
+            c_addr = control_daemons[0].gateway.listen_addr
+            plain_pc = ProtocolClient()
+            self._wait_round(plain_pc, c_addr, 1)
+            with grpc.insecure_channel(c_addr) as chan:
+                stub = services.PUBLIC.stub(chan)
+                req = pb.PublicRandRequest(
+                    round=1, metadata=convert.metadata("default"))
+                bare = stub.public_rand(req, timeout=10)
+                tokened = stub.public_rand(
+                    req, metadata=(("authorization", "Bearer dt1.junk"),),
+                    timeout=10)
+            result.control_plaintext_ok = bare.round == 1
+            result.control_header_ignored = (
+                bare.SerializeToString() == tokened.SerializeToString()
+                and control_daemons[0].identity is None
+                and not control_daemons[0].authority.active())
+
+            ident = repr((self.seed, self.victim_node,
+                          result.forged_packets,
+                          result.impersonation_rejected,
+                          sorted(result.token_reasons.items()),
+                          result.victim_quota_untouched,
+                          result.rekey_over_rotation))
+            result.digest = hashlib.sha256(
+                ident.encode()).hexdigest()[:16]
+            return result
+        finally:
+            for d in daemons + control_daemons:
+                d.stop()
+
+    def _run_dkg2(self, daemons):
+        """2-node variant for the control fleet (threshold 2 of 2)."""
+        import time
+
+        from drand_tpu.net import ControlClient, convert
+        from drand_tpu.protos import drand_pb2 as pb
+        leader_addr = daemons[0].gateway.listen_addr
+        results = [None] * len(daemons)
+        errors = []
+
+        def drive(i):
+            cc = ControlClient(daemons[i].control.port)
+            req = pb.InitDKGPacket(
+                info=pb.SetupInfo(
+                    leader=(i == 0),
+                    leader_address="" if i == 0 else leader_addr,
+                    nodes=len(daemons), threshold=2,
+                    timeout_seconds=30, secret=b"stolen-id"),
+                beacon_period_seconds=self.period,
+                metadata=convert.metadata("default"))
+            deadline = time.monotonic() + 30
+            while True:
+                try:
+                    results[i] = cc.stub.init_dkg(req, timeout=120)
+                    return
+                except Exception as e:
+                    if i == 0 or time.monotonic() >= deadline:
+                        errors.append((i, e))
+                        return
+                    time.sleep(0.2)
+
+        ts = [threading.Thread(target=drive, args=(i,),
+                               name=f"stolen-control-dkg-{i}")
+              for i in range(len(daemons))]
+        for t in ts:
+            t.start()
+        return ts, results, errors

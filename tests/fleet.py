@@ -68,13 +68,15 @@ class FleetNode:
     roster (and the proxy mesh upstreams) stay valid across the restart."""
 
     def __init__(self, name: str, folder: str, env: dict, period: int,
-                 dkg_timeout: int, grace: float, log=None):
+                 dkg_timeout: int, grace: float, identity_dir=None,
+                 log=None):
         self.name = name
         self.folder = folder
         self.env = env
         self.period = period
         self.dkg_timeout = dkg_timeout
         self.grace = grace
+        self.identity_dir = identity_dir
         self.proc = None
         self.ready = {}             # pid/private/control/metrics/public
         self.starts = 0
@@ -111,6 +113,8 @@ class FleetNode:
                "--dkg-timeout", str(self.dkg_timeout),
                "--ready-file", self.ready_path,
                "--grace", str(self.grace)]
+        if self.identity_dir:
+            cmd += ["--identity-dir", self.identity_dir]
         logf = open(os.path.join(self.folder, f"log.{self.starts}.txt"),
                     "ab")
         self.proc = subprocess.Popen(cmd, env=self.env, stdout=logf,
@@ -251,15 +255,31 @@ class Fleet:
     def __init__(self, n: int, base_dir: str, period: int = 3,
                  threshold=None, handel_min_group: int = 2,
                  dkg_timeout: int = 5, grace: float = 5.0, seed: int = 0,
-                 log=print):
+                 mtls: bool = False, log=print):
         self.n = n
         self.period = period
         self.threshold = threshold or (n // 2 + 1)
         self.grace = grace
         self.seed = seed
+        self.mtls = mtls
         self.log = log or (lambda *_: None)
         self.mesh = ProxyMesh()
-        self.client = ProtocolClient()      # direct, unproxied
+        # mTLS fleet (ISSUE 19): one private CA under base_dir/identity,
+        # a cert dir per node (SANs 127.0.0.1 + localhost, so roster and
+        # proxy dials both verify) plus a supervisor cert — the server
+        # side REQUIRES client auth, so the observation clients below
+        # must present one too
+        self.identity_dirs = {}
+        self.supervisor_identity = None
+        if mtls:
+            from drand_tpu.net import provision_fleet
+            self.identity_dirs = provision_fleet(
+                os.path.join(base_dir, "identity"),
+                {f"n{i}": ["127.0.0.1"] for i in range(n)}
+                | {"supervisor": ["127.0.0.1"]})
+            self.supervisor_identity = self.identity_dirs["supervisor"]
+        self.client = ProtocolClient(
+            identity=self._supervisor_plane())    # direct, unproxied
         self.nodes = {}
         for i in range(n):
             name = f"n{i}"
@@ -275,7 +295,18 @@ class Fleet:
             env.pop("DRAND_READY_FILE", None)
             self.nodes[name] = FleetNode(
                 name, folder, env, period, dkg_timeout, grace,
+                identity_dir=self.identity_dirs.get(name),
                 log=self.log)
+
+    def _supervisor_plane(self):
+        if self.supervisor_identity is None:
+            return None
+        from drand_tpu.net import IdentityPlane
+        return IdentityPlane(self.supervisor_identity)
+
+    def _control(self, name: str) -> ControlClient:
+        return ControlClient(self.nodes[name].control,
+                             identity_dir=self.supervisor_identity)
 
     def __enter__(self):
         return self
@@ -316,7 +347,7 @@ class Fleet:
         results, errors = {}, []
 
         def drive(name, req):
-            cc = ControlClient(self.nodes[name].control)
+            cc = self._control(name)
             join_deadline = time.monotonic() + timeout
             while True:
                 try:
@@ -613,14 +644,17 @@ class FleetInvariants:
 # -- canned scenario ----------------------------------------------------------
 
 def smoke_soak(base_dir: str, n: int = 5, rounds: int = 5, seed: int = 7,
-               period: int = 3, log=print) -> dict:
+               period: int = 3, mtls: bool = False, log=print) -> dict:
     """The acceptance scenario, shared by tests/test_fleet.py,
     tools/fleet.py and chaos_smoke --fleet: live-gRPC DKG across `n`
     processes, `rounds` Handel rounds, one SIGKILL + restart + catch-up,
     one seeded minority partition + heal, then a SIGTERM-all teardown.
+    With `mtls` every plane (DKG, Handel, observation, restarts through
+    the proxies) runs over per-node certs with required client auth.
     Returns a result dict for logs/CI artifacts."""
     rng = random.Random(seed)
-    with Fleet(n, base_dir, period=period, seed=seed, log=log) as fleet:
+    with Fleet(n, base_dir, period=period, seed=seed, mtls=mtls,
+               log=log) as fleet:
         fleet.start()
         group = fleet.run_dkg()
         inv = FleetInvariants(fleet)
@@ -657,7 +691,7 @@ def smoke_soak(base_dir: str, n: int = 5, rounds: int = 5, seed: int = 7,
         codes = fleet.stop_all()
         inv.assert_clean_exit(codes)
         return {
-            "n": n, "rounds": rounds, "seed": seed,
+            "n": n, "rounds": rounds, "seed": seed, "mtls": mtls,
             "group_hash": group.hash().hex(),
             "rounds_compared": compared,
             "victim": victim, "minority": minority,

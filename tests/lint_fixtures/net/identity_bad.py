@@ -1,0 +1,60 @@
+"""Seeded identity-plane secret leaks (tests/test_vet.py fixture).
+
+Token root keys (core/authz.py) and TLS private keys (net/identity.py)
+are bearer-grade material: a leaked root key mints arbitrary tenant
+tokens, a leaked node key impersonates the node to the whole committee.
+The `secret` checker must treat them exactly like DKG shares — no log,
+no exception message, no __repr__, no print.
+"""
+
+
+def hash_secret(value):
+    return b"sanitized"
+
+
+class TokenAuthorityish:
+    def __init__(self, root_key, log):
+        self._root_key = root_key
+        self.log = log
+
+    def leak_root_key(self):
+        self.log.info("authority up", root_key=self._root_key)  # VIOLATION
+
+    def leak_exception(self):
+        raise RuntimeError(
+            f"ledger torn, key was {self._root_key}")           # VIOLATION
+
+    def __repr__(self):
+        return f"TokenAuthority(key={self._root_key})"          # VIOLATION
+
+    def safe_token_id(self, token_id):
+        # token ids are public handles, not key material: fine
+        self.log.info("minted", token_id=token_id)
+
+    def safe_proof(self):
+        proof = hash_secret(self._root_key)                     # sanitizer
+        self.log.info("rotated", proof=proof)
+
+
+class CertGenerationish:
+    def __init__(self, key_pem, cert_pem, log):
+        self.key_pem = key_pem
+        self.cert_pem = cert_pem
+        self.log = log
+
+    def leak_tls_key(self):
+        print("loaded node key", self.key_pem)                  # VIOLATION
+
+    def leak_one_hop(self):
+        pem = self.key_pem
+        self.log.debug("reload", material=pem)                  # VIOLATION
+
+    def safe_public_half(self):
+        # the CERTIFICATE is what the wire already shows every peer,
+        # and len() of the key is a sanitized size: both fine
+        self.log.info("reload ok", cert=self.cert_pem,
+                      key_bytes=len(self.key_pem))
+
+    def suppressed(self):
+        # tpu-vet: disable=secret
+        self.log.debug("dump", key_pem=self.key_pem)

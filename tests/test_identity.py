@@ -1,0 +1,407 @@
+"""Identity plane (net/identity.py + core/authz.py; ISSUE 19).
+
+Tier-1 coverage: the tenant-token caveat matrix (expiry + skew, chain
+allowlist, tampered HMAC chain, revocation through the cache, unknown
+caveats fail closed, torn-ledger fail-closed), cert provisioning +
+hot-reload + the expiry-grace state machine on a FakeClock, the
+SAN <-> roster Handel binding for DNS-named rosters (the PR 15
+`sender_binding_enforceable` carve-out, now enforced), and the
+anonymous-read byte-identity guarantee (an untenanted daemon never
+grows identity state).  The live mTLS fleet legs run in
+tests/chaos.py's StolenIdentityScenario and tools/fleet.py --mtls."""
+
+import os
+import shutil
+
+import pytest
+
+from drand_tpu.beacon import FakeClock
+from drand_tpu.beacon import handel as H
+from drand_tpu.core.authz import (REASON_BAD_SIGNATURE, REASON_EXPIRED,
+                                  REASON_MALFORMED, REASON_REVOKED,
+                                  REASON_UNKNOWN, REASON_WRONG_CHAIN,
+                                  TokenAuthority, _b64u, _chain_sig,
+                                  bearer_token, grpc_bearer)
+from drand_tpu.crypto.schemes import scheme_from_name
+from drand_tpu.net import identity as ident
+
+
+def mk_authority(tmp_path, clock=None, **kw):
+    return TokenAuthority(str(tmp_path / "multibeacon"),
+                          clock=clock or FakeClock(1000.0), **kw)
+
+
+def _partial(idx, body=b"-good"):
+    return idx.to_bytes(2, "big") + body
+
+
+class StubVerifier:
+    def verify(self, msg, partials):
+        return [p.endswith(b"-good") for p in partials]
+
+
+# ---------------------------------------------------------------------------
+# token caveat matrix
+# ---------------------------------------------------------------------------
+
+
+def test_token_mint_verify_roundtrip(tmp_path):
+    clock = FakeClock(1000.0)
+    auth = mk_authority(tmp_path, clock)
+    token, rec = auth.mint("acme", chains=("default", "c2"),
+                           ttl=600.0, read_only=True)
+    v = auth.verify(token)
+    assert v.ok and v.tenant == "acme" and v.read_only
+    assert v.chains == ("default", "c2")
+    assert v.expires == 1600.0
+    assert v.token_id == rec.token_id
+    # chain allowlist: listed chains pass, others are wrong-chain
+    assert auth.verify(token, chain="default").ok
+    assert auth.verify(token, chain="c2").ok
+    bad = auth.verify(token, chain="other")
+    assert not bad.ok and bad.reason == REASON_WRONG_CHAIN
+    # an unrestricted token (empty chains caveat) serves any chain
+    tok2, _ = auth.mint("acme")
+    assert auth.verify(tok2, chain="anything").ok
+
+
+def test_token_expiry_honors_skew_boundary(tmp_path):
+    clock = FakeClock(1000.0)
+    auth = mk_authority(tmp_path, clock, skew=30.0)
+    token, _ = auth.mint("acme", ttl=100.0)       # expires at 1100
+    clock.set_time(1100.0 + 30.0)                 # exactly expiry + skew
+    assert auth.verify(token).ok, "inside the skew window must pass"
+    clock.advance(1.0)
+    v = auth.verify(token)
+    assert not v.ok and v.reason == REASON_EXPIRED
+    # no-expiry tokens never age out
+    forever, _ = auth.mint("acme")
+    clock.advance(10 ** 9)
+    assert auth.verify(forever).ok
+
+
+def test_token_tampering_breaks_the_hmac_chain(tmp_path):
+    auth = mk_authority(tmp_path)
+    token, _ = auth.mint("acme", read_only=True)
+    parts = token.split(".")
+    # rewrite the ro=1 caveat to ro=0 without re-signing
+    ro_idx = next(i for i, p in enumerate(parts[2:-1], start=2)
+                  if p == _b64u(b"ro=1"))
+    parts[ro_idx] = _b64u(b"ro=0")
+    v = auth.verify(".".join(parts))
+    assert not v.ok and v.reason == REASON_BAD_SIGNATURE
+    # reordering caveats breaks it too (order is part of the chain)
+    parts = token.split(".")
+    parts[2], parts[3] = parts[3], parts[2]
+    assert auth.verify(".".join(parts)).reason == REASON_BAD_SIGNATURE
+    # and a flipped signature byte
+    parts = token.split(".")
+    parts[-1] = ("0" if parts[-1][0] != "0" else "1") + parts[-1][1:]
+    assert auth.verify(".".join(parts)).reason == REASON_BAD_SIGNATURE
+
+
+def test_token_malformed_inputs_rejected(tmp_path):
+    auth = mk_authority(tmp_path)
+    auth.mint("acme")          # ensure a root key exists
+    for junk in ("", "garbage", "dt1.only-two", "dt2.x.y.z",
+                 "dt1." + "x" * 5000, None, 42):
+        v = auth.verify(junk)
+        assert not v.ok and v.reason == REASON_MALFORMED
+
+
+def test_token_unknown_caveat_fails_closed(tmp_path):
+    """A correctly-SIGNED token carrying a caveat this build does not
+    understand is rejected: honoring it as a no-op would widen the
+    token's authority."""
+    auth = mk_authority(tmp_path)
+    auth.mint("acme")
+    key = auth._root_key
+    caveats = ("t=acme", "c=", "e=0", "ro=0", "x=later-feature")
+    sig = _chain_sig(key, "cafe0123", caveats)
+    token = ".".join(("dt1", "cafe0123")
+                     + tuple(_b64u(c.encode()) for c in caveats)
+                     + (sig.hex(),))
+    v = auth.verify(token)
+    assert not v.ok and v.reason == REASON_MALFORMED
+
+
+def test_token_revocation_pierces_the_cache(tmp_path):
+    auth = mk_authority(tmp_path)
+    token, rec = auth.mint("acme")
+    assert auth.verify(token).ok          # primes the structural cache
+    assert auth.revoke(rec.token_id)
+    v = auth.verify(token)
+    assert not v.ok and v.reason == REASON_REVOKED
+    assert not auth.revoke("no-such-id")
+    # revocation survives a restart (ledger persisted atomically)
+    auth2 = TokenAuthority(auth.folder, clock=FakeClock(1000.0))
+    assert auth2.verify(token).reason == REASON_REVOKED
+
+
+def test_token_torn_ledger_fails_closed(tmp_path):
+    """Key survives but the ledger is torn/lost: tokens still verify
+    structurally, but without a record they are UNKNOWN — a crash must
+    never resurrect a revoked token."""
+    auth = mk_authority(tmp_path)
+    token, _ = auth.mint("acme")
+    os.unlink(os.path.join(auth.folder, "tokens.json"))
+    auth2 = TokenAuthority(auth.folder, clock=FakeClock(1000.0))
+    v = auth2.verify(token)
+    assert not v.ok and v.reason == REASON_UNKNOWN
+
+
+def test_token_foreign_key_rejected(tmp_path):
+    """A token minted under another daemon's root key fails the
+    signature check here."""
+    theirs = TokenAuthority(str(tmp_path / "theirs"), clock=FakeClock(0))
+    ours = TokenAuthority(str(tmp_path / "ours"), clock=FakeClock(0))
+    token, _ = theirs.mint("acme")
+    ours.mint("other")          # give ours a (different) root key
+    assert ours.verify(token).reason == REASON_BAD_SIGNATURE
+
+
+def test_token_persistence_across_restart(tmp_path):
+    auth = mk_authority(tmp_path)
+    token, rec = auth.mint("acme", chains=("default",), ttl=500.0)
+    auth2 = TokenAuthority(auth.folder, clock=FakeClock(1000.0))
+    assert auth2.active()
+    v = auth2.verify(token, chain="default")
+    assert v.ok and v.tenant == "acme"
+    assert [r.token_id for r in auth2.tokens()] == \
+        [r.token_id for r in auth.tokens()]
+    key_mode = os.stat(os.path.join(auth.folder, "tokens.key")).st_mode
+    assert key_mode & 0o077 == 0, "root key must not be group/world readable"
+
+
+def test_bearer_extraction_helpers():
+    assert bearer_token(None) is None
+    assert bearer_token("") is None
+    assert bearer_token("Bearer abc.def") == "abc.def"
+    assert bearer_token("bearer abc") == "abc"
+    assert bearer_token("abc") == "abc"
+    assert grpc_bearer(None) is None
+    assert grpc_bearer([("x-other", "1")]) is None
+    assert grpc_bearer([("authorization", "Bearer tok")]) == "tok"
+
+
+# ---------------------------------------------------------------------------
+# anonymous-read byte-identity: no tokens ever minted => the authz plane
+# is inert — no files, no active() flag, no state growth on probes
+# ---------------------------------------------------------------------------
+
+
+def test_untenanted_authority_stays_inert(tmp_path):
+    folder = tmp_path / "multibeacon"
+    auth = TokenAuthority(str(folder), clock=FakeClock(0))
+    assert not auth.active()
+    # probing with garbage (or even well-formed foreign tokens) creates
+    # no files and flips no state
+    assert not auth.verify("dt1.aa.dD0x.deadbeef").ok
+    assert not auth.verify("garbage").ok
+    assert not auth.active()
+    assert not folder.exists(), "verification must never create files"
+    assert auth.tokens() == []
+
+
+def test_config_without_identity_dir_builds_no_plane(tmp_path):
+    from drand_tpu.core.config import Config
+    cfg = Config(folder=str(tmp_path))
+    assert cfg.identity() is None
+    assert not cfg.authority().active()
+
+
+# ---------------------------------------------------------------------------
+# cert provisioning + IdentityPlane state machine (openssl CLI)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("identity"))
+    dirs = ident.provision_fleet(
+        root, {"node-a": ["node-a.example"], "node-b": ["10.0.0.2"]},
+        days=3)
+    return root, dirs
+
+
+def test_provision_fleet_sans_carry_roster_and_loopback(fleet):
+    root, dirs = fleet
+    facts = ident.cert_facts(os.path.join(dirs["node-a"], "node.crt"))
+    assert "node-a.example" in facts["names"]
+    assert "127.0.0.1" in facts["names"] and "localhost" in facts["names"]
+    assert facts["common_name"] == "node-a"
+    assert facts["not_after"] is not None
+    # issue_cert (unlike provision_fleet) adds NO loopback SANs — the
+    # chaos scenario's attacker cert depends on this
+    lone = ident.issue_cert(os.path.join(root, "lone"), "lone",
+                            ["attacker.example"],
+                            os.path.join(root, "ca"), days=3)
+    lf = ident.cert_facts(os.path.join(lone, "node.crt"))
+    assert lf["names"] == ("attacker.example",)
+    # private keys land 0600
+    mode = os.stat(os.path.join(dirs["node-a"], "node.key")).st_mode
+    assert mode & 0o077 == 0
+
+
+def test_identity_plane_expiry_grace_state_machine(fleet, tmp_path):
+    root, dirs = fleet
+    cert_dir = str(tmp_path / "certs")
+    shutil.copytree(dirs["node-a"], cert_dir)
+    not_after = ident.cert_facts(
+        os.path.join(cert_dir, "node.crt"))["not_after"]
+    clock = FakeClock(not_after - 1000.0)
+    plane = ident.IdentityPlane(cert_dir, clock=clock,
+                                reload_interval=5.0, expiry_grace=3600.0)
+    assert plane.state() == ident.STATE_FRESH
+    clock.set_time(not_after + 1.0)
+    assert plane.state() == ident.STATE_GRACE
+    clock.set_time(not_after + 3600.0 + 1.0)
+    assert plane.state() == ident.STATE_EXPIRED
+    # degraded NEVER means bricked: both credential surfaces still serve
+    assert plane.server_credentials() is not None
+    assert plane.channel_credentials() is not None
+    st = plane.status()
+    assert st["state"] == ident.STATE_EXPIRED and st["epoch"] == 0
+
+
+def test_identity_plane_hot_reload_bumps_epoch(fleet, tmp_path):
+    root, dirs = fleet
+    cert_dir = str(tmp_path / "certs")
+    shutil.copytree(dirs["node-a"], cert_dir)
+    clock = FakeClock(1000.0)
+    plane = ident.IdentityPlane(cert_dir, clock=clock, reload_interval=5.0)
+    assert plane.epoch == 0
+    creds0 = plane.channel_credentials()
+    plane.maybe_reload()        # arm the rate-limit window
+    # rotate: reissue into the same dir (new key + crt, new SAN set)
+    ident.issue_cert(cert_dir, "node-a", ["node-a.example", "rotated.example"],
+                     os.path.join(root, "ca"), days=3)
+    # inside the rate-limit window nothing happens...
+    assert not plane.maybe_reload()
+    assert plane.epoch == 0
+    # ...past it (or forced) the new generation swaps in atomically
+    clock.advance(6.0)
+    assert plane.maybe_reload()
+    assert plane.epoch == 1
+    assert "rotated.example" in plane.names()
+    assert plane.channel_credentials() is not creds0, \
+        "rotation must invalidate the cached channel credentials"
+    assert plane.status()["reloads"] == 1
+
+
+def test_identity_plane_torn_rotation_keeps_last_good(fleet, tmp_path):
+    root, dirs = fleet
+    cert_dir = str(tmp_path / "certs")
+    shutil.copytree(dirs["node-b"], cert_dir)
+    plane = ident.IdentityPlane(cert_dir, clock=FakeClock(1000.0))
+    os.unlink(os.path.join(cert_dir, "node.crt"))
+    assert not plane.maybe_reload(force=True)
+    assert plane.epoch == 0 and plane.channel_credentials() is not None
+
+
+def test_identity_plane_requires_complete_dir(tmp_path):
+    with pytest.raises(ident.IdentityError, match="incomplete"):
+        ident.IdentityPlane(str(tmp_path / "empty"))
+
+
+def test_peer_identity_matching_and_extraction():
+    pid = ident.PeerIdentity(names=("Node-A.Example", "10.0.0.2"),
+                             common_name="node-a")
+    assert pid.matches("node-a.example")          # case-insensitive
+    assert pid.matches("10.0.0.2")
+    assert pid.matches("node-a")                  # CN fallback
+    assert not pid.matches("node-b.example")
+    assert not pid.matches("")
+    assert pid.label == "node-a"
+
+    class Ctx:
+        def __init__(self, auth):
+            self._auth = auth
+
+        def auth_context(self):
+            return self._auth
+
+    good = Ctx({"transport_security_type": (b"ssl",),
+                "x509_subject_alternative_name": (b"node-a.example",),
+                "x509_common_name": (b"node-a",)})
+    got = ident.peer_identity(good)
+    assert got is not None and got.matches("node-a.example")
+    assert ident.peer_identity(Ctx({})) is None           # plaintext
+    assert ident.peer_identity(Ctx(None)) is None
+
+
+# ---------------------------------------------------------------------------
+# Handel binding: DNS-named rosters are now enforceable via the mTLS
+# identity (the sender_binding_enforceable carve-out closes)
+# ---------------------------------------------------------------------------
+
+
+def _dns_coordinator():
+    scheme = scheme_from_name("pedersen-bls-chained")
+    addrs = {i: f"node-{i}.example.com:443" for i in range(8)}
+    c = H.HandelCoordinator(
+        group_n=8, me=0, threshold=5, scheme=scheme,
+        verifier=StubVerifier(), transport=lambda i, p: None,
+        on_complete=lambda r, p, parts: None, clock=FakeClock(0),
+        cfg=H.HandelConfig(min_group=2, window=8, bad_limit=3),
+        score_key=lambda i: addrs[i], beacon_id="mtls-bind")
+    c.submit_own(1, None, _partial(0))
+    return c
+
+
+def _pkt(sender):
+    block = H.own_block(8, sender, 2)
+    return H.to_packet(1, None, 2, sender,
+                       H.Aggregate({i: _partial(i) for i in block}), 8,
+                       "mtls-bind")
+
+
+def test_handel_dns_roster_enforced_with_mtls_identity():
+    """With an authenticated PeerIdentity the DNS roster binds: the SAN
+    of the sender cert must cover the claimed index's roster host."""
+    from drand_tpu.metrics import identity_rejections
+    c = _dns_coordinator()
+    honest = ident.PeerIdentity(names=("node-3.example.com",),
+                                common_name="node-3")
+    c.receive(_pkt(3), peer="ipv4:10.9.9.9:41234", auth=honest)
+    sess = c._sessions[(1, b"")]
+    assert sess._pending, "SAN-matching candidate must enter the session"
+
+    before = identity_rejections.labels("handel",
+                                        "impersonation")._value.get()
+    attacker = ident.PeerIdentity(names=("attacker.example",),
+                                  common_name="attacker")
+    with pytest.raises(ValueError, match="authenticated as attacker"):
+        c.receive(_pkt(5), peer="ipv4:10.9.9.9:41234", auth=attacker)
+    after = identity_rejections.labels("handel",
+                                       "impersonation")._value.get()
+    assert after == before + 1
+    # the forgery never reached the session: the claimed index's
+    # demotion counter is untouched (no griefing of honest peers)
+    assert sess._bad.get(5, 0) == 0
+
+
+def test_handel_auth_replaces_ip_heuristic():
+    """When `auth` is present it REPLACES the transport-IP heuristic —
+    a numeric peer mismatch is irrelevant if the cert SAN matches, and
+    vice versa a matching IP cannot rescue a SAN mismatch."""
+    c = _dns_coordinator()
+    # DNS roster + no auth: heuristic skips (PR 15 behavior preserved)
+    c.receive(_pkt(3), peer="ipv4:10.2.3.4:41234")
+    assert c._sessions[(1, b"")]._pending
+
+
+# ---------------------------------------------------------------------------
+# the full stolen-identity scenario (live mTLS daemons; chaos_smoke
+# --identity runs the same legs in CI)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_stolen_identity_scenario(tmp_path):
+    from chaos import StolenIdentityScenario
+    r = StolenIdentityScenario(seed=42, root=str(tmp_path)).run()
+    assert r.ok, r
+    assert r.impersonation_rejected == r.forged_packets
+    assert r.token_reasons == {"revoked": "revoked", "expired": "expired",
+                               "tampered": "bad-signature"}

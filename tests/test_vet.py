@@ -137,6 +137,27 @@ def test_secret_checker_catches_fixture():
                 if f.path == "secrets_bad.py"]) == 1
 
 
+def test_secret_checker_covers_identity_plane_material():
+    """Token root keys and TLS private keys (the PR 19 identity plane)
+    are secret material: log kwargs, print, exception messages and
+    __repr__ all flag; token ids, public cert PEMs and len()/
+    hash_secret()-sanitized values stay silent."""
+    report = _fixture_report("secret")
+    path = "net/identity_bad.py"
+    codes = _codes(report, path)
+    assert (path, "secret-in-log") in codes
+    assert (path, "secret-in-exception") in codes
+    assert (path, "secret-in-repr") in codes
+    msgs = [f.message for f in report.findings if f.path == path]
+    assert any("_root_key" in m for m in msgs)
+    assert any("key_pem" in m for m in msgs)
+    # the five seeded leaks, nothing else: the public halves
+    # (token_id, cert_pem) and the sanitizers never flag
+    assert len(msgs) == 5, msgs
+    assert not any("token_id" in m or "cert_pem" in m for m in msgs)
+    assert len([f for f in report.suppressed if f.path == path]) == 1
+
+
 def test_trace_checker_catches_fixture():
     report = _fixture_report("trace")
     codes = _codes(report, "ops/trace_bad.py")

@@ -268,6 +268,52 @@ def handel_main(args) -> int:
     return 0 if r.ok else 1
 
 
+def identity_main(args) -> int:
+    """--identity mode: the stolen-identity scenario — a live 3-node
+    mTLS committee under active identity theft.  A CA-signed attacker
+    cert with no roster SAN forges a victim's Handel sender_index
+    (rejected at ingress, metered, chain stays live), revoked/expired/
+    tampered tokens are refused with identity-reason trailers before any
+    quota spend lands on the victim tenant, every node's cert rotates
+    mid-rekey without a restart, and a no-identity control fleet serves
+    plaintext byte-identically with a bearer header present."""
+    import tempfile
+
+    from chaos import StolenIdentityScenario
+
+    with tempfile.TemporaryDirectory(prefix="drand-identity-") as root:
+        r = StolenIdentityScenario(seed=args.seed, root=root).run()
+        print(f"seed            : {args.seed}")
+        print(f"plaintext       : rejected={r.plaintext_rejected}")
+        print(f"forged packets  : {r.forged_packets} sent, "
+              f"{r.impersonation_rejected} rejected "
+              f"(victim index {r.victim_index}, "
+              f"metered={r.impersonation_metered})")
+        print(f"chain liveness  : after forgery="
+              f"{r.liveness_after_forgery} "
+              f"after rotation={r.liveness_after_rotation}")
+        print(f"good token      : served={r.good_token_served}")
+        print(f"stolen tokens   : " + ", ".join(
+            f"{leg}->{reason}" for leg, reason in
+            sorted(r.token_reasons.items())))
+        print(f"victim quota    : untouched={r.victim_quota_untouched}")
+        print(f"cert rotation   : epochs={r.rotation_epochs} "
+              f"rekey-completed={r.rekey_over_rotation}")
+        print(f"control fleet   : plaintext={r.control_plaintext_ok} "
+              f"header-ignored={r.control_header_ignored}")
+        print(f"digest          : {r.digest}")
+
+    from drand_tpu.metrics import scrape
+    lines = [l for l in scrape("private").decode().splitlines()
+             if l.startswith(("identity_rejections",
+                              "identity_cert_reloads",
+                              "authz_tokens"))]
+    print("identity series :")
+    for line in lines:
+        print(f"  {line}")
+    return 0 if r.ok else 1
+
+
 def fleet_main(args) -> int:
     """--fleet mode: the process-fleet soak (tests/fleet.py) — N REAL
     daemon processes over live gRPC through the per-link chaos proxy:
@@ -284,13 +330,13 @@ def fleet_main(args) -> int:
     try:
         result = smoke_soak(base, n=max(args.nodes, 5),
                             rounds=max(args.rounds, 5), seed=args.seed,
-                            period=args.period)
+                            period=args.period, mtls=args.mtls)
     except FleetError as e:
         print(f"FLEET INVARIANT FAILED: {e}", file=sys.stderr)
         print(f"node folders kept for diagnosis: {base}", file=sys.stderr)
         return 1
     print(f"seed            : {result['seed']}")
-    print(f"nodes           : {result['n']}")
+    print(f"nodes           : {result['n']} (mtls={result['mtls']})")
     print(f"rounds          : {result['rounds']} "
           f"({result['rounds_compared']} fork-compared)")
     print(f"group hash      : {result['group_hash'][:32]}")
@@ -346,6 +392,15 @@ def main() -> int:
                          "(aggressor tenant flood + device-quota "
                          "saturation vs a victim tenant's live rounds) "
                          "instead of the network chaos scenario")
+    ap.add_argument("--mtls", action="store_true",
+                    help="with --fleet: run the whole fleet over mutual "
+                         "TLS (per-node certs from a private CA)")
+    ap.add_argument("--identity", action="store_true",
+                    help="run the stolen-identity scenario: a live "
+                         "3-node mTLS committee vs a CA-signed attacker "
+                         "cert (forged sender_index, stolen/replayed "
+                         "tokens, cert rotation mid-rekey, no-identity "
+                         "control run)")
     ap.add_argument("--fleet", action="store_true",
                     help="run the process-fleet soak: N real daemon "
                          "processes over live gRPC through the per-link "
@@ -355,6 +410,8 @@ def main() -> int:
 
     if args.fleet:
         return fleet_main(args)
+    if args.identity:
+        return identity_main(args)
     if args.storage:
         return storage_main(args)
     if args.device:

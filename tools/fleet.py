@@ -47,7 +47,8 @@ def cmd_smoke(args) -> int:
     base = args.dir or tempfile.mkdtemp(prefix="drand-fleet-")
     try:
         result = smoke_soak(base, n=args.nodes, rounds=args.rounds,
-                            seed=args.seed, period=args.period)
+                            seed=args.seed, period=args.period,
+                            mtls=args.mtls)
     except FleetError as e:
         print(f"FLEET INVARIANT FAILED: {e}", file=sys.stderr)
         print(f"folders kept for diagnosis: {base}", file=sys.stderr)
@@ -67,7 +68,7 @@ def cmd_soak(args) -> int:
           f"({len(plan.events)} events)")
     try:
         with Fleet(args.nodes, base, period=args.period,
-                   seed=args.seed) as fleet:
+                   seed=args.seed, mtls=args.mtls) as fleet:
             fleet.start()
             fleet.run_dkg()
             fleet.execute(plan)
@@ -102,6 +103,10 @@ def main() -> int:
         p.add_argument("--dir", help="fleet base dir (default: tmpdir)")
         p.add_argument("--keep", action="store_true",
                        help="keep node folders after a green run")
+        p.add_argument("--mtls", action="store_true",
+                       help="provision a private CA + per-node certs "
+                            "and run every gRPC plane over mutual TLS "
+                            "(net/identity.py)")
         p.set_defaults(fn=fn)
     args = ap.parse_args()
     return args.fn(args)

@@ -109,12 +109,15 @@ class LoadReport:
 
 
 def _rest_once(base: str, path: str, report: LoadReport,
-               lock: threading.Lock, tenant_key: Optional[str] = None
-               ) -> None:
+               lock: threading.Lock, tenant_key: Optional[str] = None,
+               token: Optional[str] = None) -> None:
     t0 = time.perf_counter()
     status, retry_after = 0, None
+    req = urllib.request.Request(base + path)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
     try:
-        with urllib.request.urlopen(base + path, timeout=10) as r:
+        with urllib.request.urlopen(req, timeout=10) as r:
             status = r.status
             r.read()
     except urllib.error.HTTPError as e:
@@ -131,7 +134,7 @@ def _rest_once(base: str, path: str, report: LoadReport,
                 report.by_tenant = {}
             t = report.by_tenant.setdefault(
                 tenant_key, {"attempted": 0, "ok": 0, "shed": 0,
-                             "errors": 0})
+                             "errors": 0, "authenticated": bool(token)})
             t["attempted"] += 1
         if status in (200, 304):
             report.ok += 1
@@ -151,12 +154,12 @@ def _rest_once(base: str, path: str, report: LoadReport,
 
 
 def _grpc_once(client, peer, report: LoadReport,
-               lock: threading.Lock) -> None:
+               lock: threading.Lock, token: Optional[str] = None) -> None:
     import grpc
     t0 = time.perf_counter()
     ok = shed = err = malformed = 0
     try:
-        client.public_rand(peer, round_=0)
+        client.public_rand(peer, round_=0, token=token)
         ok = 1
     except grpc.RpcError as e:
         if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
@@ -325,7 +328,26 @@ def main() -> int:
                          "ok/shed down per chain — drive one tenant's "
                          "hash hot to watch its quota shed while the "
                          "others keep serving")
+    ap.add_argument("--token", action="append", default=[],
+                    metavar="[HASH=]TOKEN",
+                    help="bearer token (core/authz.py, mint via "
+                         "`drand auth mint`): HASH=TOKEN attaches the "
+                         "token to that --tenants lane only, a bare "
+                         "TOKEN rides on every request — lanes without "
+                         "one stay anonymous, so a mixed run measures "
+                         "authenticated and anonymous read paths side "
+                         "by side (per-lane `authenticated` in the "
+                         "report)")
     args = ap.parse_args()
+
+    # "--token HASH=TOKEN" per tenant lane; "--token TOKEN" for all
+    tokens, default_token = {}, None
+    for spec in args.token:
+        if "=" in spec:
+            h, _, tok = spec.partition("=")
+            tokens[h.strip()] = tok.strip()
+        else:
+            default_token = spec.strip()
 
     if args.selftest:
         return selftest(args.duration, max(args.clients, 16), args.json)
@@ -346,10 +368,12 @@ def main() -> int:
                     h = hashes[rr["i"] % len(hashes)]
                     rr["i"] += 1
                 _rest_once(base, f"/{h}/public/latest", rep, lock,
-                           tenant_key=h)
+                           tenant_key=h,
+                           token=tokens.get(h, default_token))
         else:
             def fire(rep, lock):
-                _rest_once(base, "/public/latest", rep, lock)
+                _rest_once(base, "/public/latest", rep, lock,
+                           token=default_token)
         report = run_load(
             fire, target=base, mode=args.mode, clients=args.clients,
             rate=args.rate, duration=args.duration)
@@ -363,7 +387,8 @@ def main() -> int:
         peer = Peer(args.grpc)
         try:
             report = run_load(
-                lambda rep, lock: _grpc_once(client, peer, rep, lock),
+                lambda rep, lock: _grpc_once(client, peer, rep, lock,
+                                             token=default_token),
                 target=args.grpc, mode=args.mode, clients=args.clients,
                 rate=args.rate, duration=args.duration)
         finally:
