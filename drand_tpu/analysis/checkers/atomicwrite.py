@@ -12,7 +12,7 @@ same discipline itself: write a sibling temp file, then `os.replace`/
 
 Scope: `key/` and `core/dkg_journal.py` (the persistent-identity plane).
 Read-mode opens are untouched.  A deliberate in-place write carries a
-`# tpu-vet: disable=atomic` suppression WITH a justification.
+`tpu-vet: disable=atomic` comment WITH a justification.
 
 Flagged (per enclosing function; module-level writes count too):
   * ``open(path, "w"/"wb"/"a"...)`` — any create/truncate/append mode —

@@ -17,7 +17,7 @@ is flood-reachable, so it must be bounded like the rest of the serving
 plane).  Internal planes (DKG broadcast buffers, the aggregator's
 partial queue) are ingress-validated and threshold-bounded upstream, so
 they keep their simpler constructs.  A deliberate unbounded construct in
-scope carries a `# tpu-vet: disable=bounds` suppression WITH a
+scope carries a `tpu-vet: disable=bounds` comment WITH a
 justification.
 
 Flagged:

@@ -37,9 +37,11 @@ BANNED = {
 # rel-path suffixes exempt from the discipline.  net/chaosproxy.py and
 # the fleet harness/CLI (fleet.py) are wall-clock by design: they shape
 # real wire traffic and supervise real subprocesses, and an injected
-# fake clock cannot reach across process boundaries.
+# fake clock cannot reach across process boundaries.  analysis/tsan.py
+# is the runtime lock sanitizer: hold/wait durations are measurements of
+# the real interpreter, not schedule logic, and must not be faked.
 ALLOWED_FILES = ("beacon/clock.py", "log.py", "net/chaosproxy.py",
-                 "fleet.py")
+                 "fleet.py", "analysis/tsan.py")
 
 
 def _allowed_rel(rel: str) -> bool:

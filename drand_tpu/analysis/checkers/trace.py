@@ -59,7 +59,7 @@ SYNC_BLOCKERS = {"jax.block_until_ready", "jax.device_get"}
 # direct hashlib constructions AND the known host hash-to-field/digest
 # helpers when called per element in a for/while/comprehension.
 # Sanctioned sites (the parity oracle and the below-threshold host
-# fallback) carry justified `# tpu-vet: disable=trace` suppressions.
+# fallback) carry justified `tpu-vet: disable=trace` suppressions.
 HASH_SCOPES = ("ops/", "crypto/batch.py", "crypto/partials.py",
                "crypto/verify_service.py")
 HOST_HASH_HELPERS = {"hash_to_field_fp", "hash_to_field_fp2",

@@ -19,7 +19,7 @@ in practice — ``str.join``/``os.path.join`` always take an argument, and
 a bounded wait always carries one.  Paths that legitimately wait forever
 (a caller whose resolution is guaranteed by a supervising watchdog, a
 shutdown join on a daemon thread) carry a
-``# tpu-vet: disable=wait`` suppression WITH a justification comment.
+``tpu-vet: disable=wait`` suppression WITH a justification comment.
 
 Test code is exempt: tests wait on work they control, and pytest's own
 timeout machinery bounds them.

@@ -68,6 +68,11 @@ class Report:
     baselined: List[Finding] = field(default_factory=list)
     files: int = 0
     errors: List[str] = field(default_factory=list)         # unparseable files
+    # suppression hygiene (--audit-suppressions): disable comments that
+    # covered nothing, and baseline budget that no current finding needs.
+    # Informational on a normal run; the audit flag turns them fatal.
+    stale_suppressions: List[str] = field(default_factory=list)
+    stale_baseline: List[str] = field(default_factory=list)
 
     @property
     def clean(self) -> bool:
@@ -90,6 +95,8 @@ class Report:
             "suppressed": len(self.suppressed),
             "baselined": len(self.baselined),
             "errors": self.errors,
+            "stale_suppressions": list(self.stale_suppressions),
+            "stale_baseline": list(self.stale_baseline),
         }
 
     def to_json(self) -> str:
@@ -156,11 +163,18 @@ class Report:
 class Suppressions:
     """`# tpu-vet: disable=<checker>` on the flagged line or the line
     above; `disable-file=<checker>` anywhere suppresses the whole file.
-    `all` matches every checker."""
+    `all` matches every checker.
+
+    Every entry also tracks whether it covered at least one finding this
+    run, so `--audit-suppressions` can flag disable comments that have
+    gone stale (the code they excused was fixed or deleted, and the
+    comment now silently masks future regressions)."""
 
     def __init__(self, lines: Sequence[str]):
         self.by_line: Dict[int, set] = {}
         self.file_level: set = set()
+        # (comment line, kind, token) -> covered a finding this run
+        self.entries: Dict[tuple, bool] = {}
         for i, text in enumerate(lines, start=1):
             m = _SUPP_RE.search(text)
             if not m:
@@ -168,17 +182,41 @@ class Suppressions:
             names = {n.strip() for n in m.group(2).split(",") if n.strip()}
             if m.group(1) == "disable-file":
                 self.file_level |= names
+                for n in names:
+                    self.entries.setdefault((i, "disable-file", n), False)
             else:
                 self.by_line.setdefault(i, set()).update(names)
+                for n in names:
+                    self.entries.setdefault((i, "disable", n), False)
 
     def covers(self, finding: Finding) -> bool:
-        if {"all", finding.checker} & self.file_level:
-            return True
+        hit = False
+        for token in ("all", finding.checker):
+            if token in self.file_level:
+                hit = True
+                for key in self.entries:
+                    if key[1] == "disable-file" and key[2] == token:
+                        self.entries[key] = True
         for line in (finding.line, finding.line - 1):
             names = self.by_line.get(line, ())
-            if "all" in names or finding.checker in names:
-                return True
-        return False
+            for token in ("all", finding.checker):
+                if token in names:
+                    hit = True
+                    self.entries[(line, "disable", token)] = True
+        return hit
+
+    def stale(self, ran_checkers: set) -> List[tuple]:
+        """Entries that covered nothing, restricted to checkers that
+        actually ran — a single-checker invocation must not condemn the
+        other checkers' comments."""
+        out = []
+        for (line, kind, token), used in sorted(self.entries.items()):
+            if used:
+                continue
+            if token != "all" and token not in ran_checkers:
+                continue
+            out.append((line, kind, token))
+        return out
 
 
 # -- baseline ----------------------------------------------------------------
@@ -275,6 +313,83 @@ def _parse_tree(paths: Sequence[str], excludes: Sequence[str],
     return modules
 
 
+# Fork-based sweep workers inherit these by copy-on-write; the indices
+# they receive are offsets into _SWEEP_STATE["modules"].  Plain threads
+# would not help here — the sweep is pure-Python AST walking and the GIL
+# serializes it — while fork shares the parsed trees and the phase-1
+# project for free and only findings (small, picklable) cross back.
+_SWEEP_STATE: dict = {}
+
+# files below this count sweep serially: fork + import costs more than
+# the sweep itself, and the fixture-sized runs in the test-suite stay
+# single-process and trivially debuggable
+_PARALLEL_MIN_FILES = 24
+
+
+def _sweep_module(idx: int):
+    """Run every checker over one module.  Returns (idx, kept, covered,
+    stale) where `kept` are findings the suppressions did not cover —
+    the caller applies the baseline budget, which is global and must be
+    consumed in deterministic module order."""
+    module = _SWEEP_STATE["modules"][idx]
+    checkers = _SWEEP_STATE["checkers"]
+    project = _SWEEP_STATE["project"]
+    supp = Suppressions(module.lines)
+    kept: List[Finding] = []
+    covered: List[Finding] = []
+    seen = set()                # nested defs are walked by both their own
+    for checker in checkers:            # pass and the enclosing one
+        if getattr(checker, "uses_project", False):
+            found = checker.check(module, project)
+        else:
+            found = checker.check(module)
+        for finding in found:
+            if finding in seen:
+                continue
+            seen.add(finding)
+            if supp.covers(finding):
+                covered.append(finding)
+            else:
+                kept.append(finding)
+    ran = {c.name for c in checkers}
+    stale = [f"{module.rel}:{line}: stale suppression "
+             f"'# tpu-vet: {kind}={token}' (covers no current finding)"
+             for line, kind, token in supp.stale(ran)]
+    return idx, kept, covered, stale
+
+
+def _sweep(modules, checkers, project) -> List[tuple]:
+    """Per-module sweep results in module order.  Parallel (bounded fork
+    pool) past _PARALLEL_MIN_FILES files on platforms with fork; output
+    is byte-identical to the serial path because workers are pure
+    functions of one module and the merge happens in submission order."""
+    _SWEEP_STATE.update(modules=modules, checkers=checkers, project=project)
+    try:
+        n = len(modules)
+        workers = min(8, os.cpu_count() or 1)
+        if os.environ.get("TPU_VET_WORKERS", ""):
+            workers = max(1, int(os.environ["TPU_VET_WORKERS"]))
+        # a single-CPU box gains nothing from fork and pays its overhead
+        use_parallel = n >= _PARALLEL_MIN_FILES and workers >= 2 and \
+            os.environ.get("TPU_VET_SERIAL", "") != "1"
+        if use_parallel:
+            import multiprocessing
+            if "fork" not in multiprocessing.get_all_start_methods():
+                use_parallel = False
+        if not use_parallel:
+            return [_sweep_module(i) for i in range(n)]
+        # warm the project's memoized global passes BEFORE forking so
+        # every worker inherits them instead of rebuilding per process
+        if project is not None and modules:
+            _sweep_module(0)
+        ctx = multiprocessing.get_context("fork")
+        with ctx.Pool(processes=workers) as pool:
+            chunk = max(1, n // (workers * 4))
+            return pool.map(_sweep_module, range(n), chunksize=chunk)
+    finally:
+        _SWEEP_STATE.clear()
+
+
 def run_vet(paths: Sequence[str], checkers: Optional[Iterable] = None,
             baseline: Optional[Dict[str, int]] = None,
             excludes: Sequence[str] = DEFAULT_EXCLUDES,
@@ -308,23 +423,15 @@ def run_vet(paths: Sequence[str], checkers: Optional[Iterable] = None,
         from .project import Project
         project = Project(modules + context)
 
-    for module in modules:
-        supp = Suppressions(module.lines)
-        seen = set()            # nested defs are walked by both their own
-        for checker in checkers:        # pass and the enclosing one
-            if getattr(checker, "uses_project", False):
-                found = checker.check(module, project)
+    for _idx, kept, covered, stale in _sweep(modules, checkers, project):
+        report.suppressed.extend(covered)
+        report.stale_suppressions.extend(stale)
+        for finding in kept:
+            if budget.get(finding.key, 0) > 0:
+                budget[finding.key] -= 1
+                report.baselined.append(finding)
             else:
-                found = checker.check(module)
-            for finding in found:
-                if finding in seen:
-                    continue
-                seen.add(finding)
-                if supp.covers(finding):
-                    report.suppressed.append(finding)
-                elif budget.get(finding.key, 0) > 0:
-                    budget[finding.key] -= 1
-                    report.baselined.append(finding)
-                else:
-                    report.findings.append(finding)
+                report.findings.append(finding)
+    report.stale_baseline = sorted(
+        k for k, v in budget.items() if v > 0)
     return report

@@ -46,10 +46,11 @@ cannot drift apart.
 
 import ast
 import re
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from .symbols import ClassInfo, ModuleInfo, dotted, walk_scope
+from .symbols import (LOCK_KINDS, ClassInfo, ModuleInfo, dotted, walk_scope)
 
 # -- shared taxonomies (checkers import these) -------------------------------
 
@@ -95,6 +96,59 @@ BLOCKING_METHODS = {
     # (the fleet harness's subprocess reaps hang CI exactly like r06)
     "wait": ("timeout", 0),
 }
+
+# in-place container mutators: the lock checker's rule-1 write set and the
+# ``mutates_params`` summary (helper-laundered writes) share this list
+MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
+            "update", "setdefault", "add", "discard", "popleft",
+            "appendleft", "popitem"}
+
+# method names that stall the calling thread regardless of receiver type
+# (the lock checker's rule-2 set; ``may_block`` summaries reuse it)
+BLOCKING_STALL_NAMES = {"wait_until", "serve_forever"}
+
+
+# -- lock-graph nodes (lock checker v3 + phase-1 lockset summaries) ----------
+
+# (module rel, owning class name or "" for a module-level lock, lock name)
+LockNode = Tuple[str, str, str]
+
+
+def lock_node_at(module: ModuleInfo, cls: Optional[ClassInfo],
+                 expr: str) -> Optional["LockNode"]:
+    """The lock-graph node a dotted with-context expression names: a
+    typed `self.<lock>` attribute of the enclosing class, or a top-level
+    module lock of the same module.  None for anything else."""
+    if expr.startswith("self.") and expr.count(".") == 1:
+        if cls is None:
+            return None
+        attr = expr.split(".", 1)[1]
+        if cls.attr_kinds.get(attr) in LOCK_KINDS:
+            return (module.rel, cls.name, attr)
+        return None
+    if "." not in expr and expr in module.module_locks:
+        return (module.rel, "", expr)
+    return None
+
+
+def held_lockset(module: ModuleInfo, cls: Optional[ClassInfo],
+                 node: ast.AST) -> Set["LockNode"]:
+    """Lock nodes provably held at `node` (enclosing `with` statements)."""
+    out: Set[LockNode] = set()
+    for d in module.withs_holding(node):
+        ln = lock_node_at(module, cls, d)
+        if ln is not None:
+            out.add(ln)
+    return out
+
+
+def lock_label(ln: "LockNode") -> str:
+    """Human name of a lock node: `Class.attr` or `pkg.module._lock`."""
+    rel, owner, name = ln
+    if owner:
+        return f"{owner}.{name}"
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    return mod.replace("/", ".") + f".{name}"
 
 
 def is_log_call(node: ast.Call) -> bool:
@@ -154,6 +208,15 @@ class FunctionSummary:
     # resolved call sites inside this function: (call node, callee key)
     calls: List[Tuple[ast.Call, Optional[Tuple[str, str]]]] = \
         field(default_factory=list)
+    # lockset summaries (lock checker v3): what this function acquires —
+    # directly and closed over resolved callees — whether it can stall
+    # the calling thread, and which parameters it mutates or invokes
+    acquires: Set["LockNode"] = field(default_factory=set)
+    acquires_trans: Set["LockNode"] = field(default_factory=set)
+    blocks_reason: Optional[str] = None
+    may_block: Optional[str] = None
+    mutates_params: Set[str] = field(default_factory=set)
+    calls_params: Set[str] = field(default_factory=set)
 
     @property
     def rel(self) -> str:
@@ -190,9 +253,23 @@ class Project:
         self._dotted: Dict[str, List[ModuleInfo]] = {}
         for m in self.modules:
             self._dotted.setdefault(m.dotted, []).append(m)
+        # project-scoped memo for checkers that derive a whole-project
+        # structure once (the lock checker's global order graph); guarded
+        # because the per-file sweep may run on a worker pool
+        self._memo: Dict[str, object] = {}
+        self._memo_lock = threading.Lock()
         self._collect()
         self._resolve_calls()
         self._summarize()
+
+    def memo(self, key: str, build):
+        """`build()` once per project under `key`; cached thereafter.
+        Thread-safe so parallel per-file checker workers share one
+        instance of an expensive project-wide derivation."""
+        with self._memo_lock:
+            if key not in self._memo:
+                self._memo[key] = build()
+            return self._memo[key]
 
     # -- construction --------------------------------------------------------
 
@@ -320,6 +397,7 @@ class Project:
     def _summarize(self) -> None:
         for s in self.functions.values():
             s.logged_params = self._logged_params(s)
+            self._lockset_direct(s)
         # return-taint + deadline fixed point: a pass can only flip flags
         # from False to True, so iteration is monotone and converges
         for _ in range(4):
@@ -329,6 +407,111 @@ class Project:
                 changed |= self._deadline_pass(s)
             if not changed:
                 break
+        # lockset fixed point: acquires_trans/may_block/mutates_params
+        # only ever grow, so this is monotone too; deep call chains need
+        # more sweeps than the 4-pass taint loop, bounded hard anyway
+        for _ in range(16):
+            changed = False
+            for s in self.functions.values():
+                changed |= self._lockset_propagate(s)
+            if not changed:
+                break
+
+    # -- lockset summaries ----------------------------------------------------
+
+    def _direct_block_reason(self, m: ModuleInfo, cls: Optional[ClassInfo],
+                             call: ast.Call) -> Optional[str]:
+        """Does this call stall the calling thread?  Mirrors the lock
+        checker's per-function rule-2 vocabulary so static and
+        interprocedural matching cannot drift.  `Condition.wait` is NOT a
+        stall for summary purposes: it releases its own condition (the cv
+        pattern); flagging helpers that park on a cv would bury the real
+        holds-a-foreign-lock-across-sleep findings in noise."""
+        qual = m.resolve(dotted(call.func) or "")
+        if qual == "time.sleep":
+            return "time.sleep"
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        meth = call.func.attr
+        if meth in BLOCKING_STALL_NAMES:
+            return f".{meth}()"
+        recv = dotted(call.func.value) or ""
+        attr = recv.split(".", 1)[1] \
+            if recv.startswith("self.") and recv.count(".") == 1 else None
+        kind = cls.attr_kinds.get(attr) if (cls and attr) else None
+        if meth == "join" and kind == "thread":
+            return f"Thread.join on self.{attr}"
+        if meth == "wait" and kind == "event":
+            return f"Event.wait on self.{attr}"
+        if meth in ("get", "put") and kind == "queue":
+            for kw in call.keywords:
+                if kw.arg == "block" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and kw.value.value is False:
+                    return None
+            if call.args and isinstance(call.args[0], ast.Constant) \
+                    and call.args[0].value is False:
+                return None
+            return f"blocking Queue.{meth} on self.{attr}"
+        return None
+
+    def _lockset_direct(self, s: FunctionSummary) -> None:
+        m, cls = s.module, s.cls
+        params = set(s.params) - {"self"}
+        for node in walk_scope(s.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    d = dotted(item.context_expr)
+                    ln = lock_node_at(m, cls, d) if d else None
+                    if ln is not None:
+                        s.acquires.add(ln)
+            elif isinstance(node, ast.Call):
+                if s.blocks_reason is None:
+                    s.blocks_reason = self._direct_block_reason(m, cls, node)
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in MUTATORS \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id in params:
+                    s.mutates_params.add(f.value.id)
+                elif isinstance(f, ast.Name) and f.id in params:
+                    s.calls_params.add(f.id)
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                   ast.Delete)):
+                targets = node.targets if isinstance(
+                    node, (ast.Assign, ast.Delete)) else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id in params:
+                        s.mutates_params.add(t.value.id)
+        s.acquires_trans = set(s.acquires)
+        s.may_block = s.blocks_reason
+
+    def _lockset_propagate(self, s: FunctionSummary) -> bool:
+        """One monotone sweep over s's resolved calls: union in callee
+        acquisitions, propagate blocking (tagging the original site), and
+        lift parameter mutation through pass-through helpers."""
+        params = set(s.params) - {"self"}
+        changed = False
+        for call, key in s.calls:
+            callee = self.functions.get(key) if key else None
+            if callee is None or callee is s:
+                continue
+            extra = callee.acquires_trans - s.acquires_trans
+            if extra:
+                s.acquires_trans |= extra
+                changed = True
+            if s.may_block is None and callee.may_block is not None:
+                s.may_block = callee.may_block if " in " in callee.may_block \
+                    else f"{callee.may_block} in {callee.display}"
+                changed = True
+            for p in callee.mutates_params:
+                bound = callee.arg_param(call, p)
+                if isinstance(bound, ast.Name) and bound.id in params \
+                        and bound.id not in s.mutates_params:
+                    s.mutates_params.add(bound.id)
+                    changed = True
+        return changed
 
     # names whose values flow into this expression (through containers,
     # f-strings, binops and non-sanitizer calls)
@@ -397,9 +580,16 @@ class Project:
                       tainted: Set[str]) -> bool:
         for sub in ast.walk(node):
             if isinstance(sub, ast.Call):
-                qual = module.resolve(dotted(sub.func) or "")
+                d = dotted(sub.func) or ""
+                qual = module.resolve(d)
                 if qual in WALLCLOCK_CALLS:
                     return True
+                # `self.clock.now()`-shaped reads go through an
+                # attribute-typed receiver — an injection point whose
+                # runtime type tests replace (FakeClock) — so the
+                # default implementation's taint must not flow through
+                if d.startswith("self.") and d.count(".") >= 2:
+                    continue
                 callee = self.resolve_call(module, sub)
                 if callee is not None and callee.returns_wallclock:
                     return True

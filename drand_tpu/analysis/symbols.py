@@ -12,7 +12,10 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Tuple
 
 # attribute kinds recognized by the class-attribute typing pass; the lock
-# and store checkers key on these
+# and store checkers key on these.  The `common.make_*` factories are the
+# sanitizer-instrumentable spellings (drand_tpu/common.py): they MUST be
+# typed here or converting a runtime module to the factory would silently
+# drop it out of the whole lock analysis.
 KIND_BY_CALL = {
     "threading.Lock": "lock",
     "threading.RLock": "rlock",
@@ -24,6 +27,15 @@ KIND_BY_CALL = {
     "queue.PriorityQueue": "queue",
     "queue.SimpleQueue": "queue",
     "sqlite3.connect": "sqlite_conn",
+    "make_lock": "lock",
+    "make_rlock": "rlock",
+    "make_condition": "condition",
+    "common.make_lock": "lock",
+    "common.make_rlock": "rlock",
+    "common.make_condition": "condition",
+    "drand_tpu.common.make_lock": "lock",
+    "drand_tpu.common.make_rlock": "rlock",
+    "drand_tpu.common.make_condition": "condition",
 }
 
 LOCK_KINDS = ("lock", "rlock", "condition")
@@ -87,6 +99,7 @@ class ModuleInfo:
         self.imports: Dict[str, str] = {}
         self.classes: List[ClassInfo] = []
         self.module_defs: set = set()      # top-level def/class/assign names
+        self.module_locks: Dict[str, str] = {}   # top-level lock name -> kind
         self._build()
 
     @property
@@ -132,6 +145,15 @@ class ModuleInfo:
                 for t in node.targets:
                     if isinstance(t, ast.Name):
                         self.module_defs.add(t.id)
+                # module-level locks (`_PACK_LOCK = threading.Lock()`) are
+                # lockset members for the interprocedural lock analysis
+                if isinstance(node.value, ast.Call):
+                    ctor = self.resolve(dotted(node.value.func) or "")
+                    kind = KIND_BY_CALL.get(ctor)
+                    if kind in LOCK_KINDS:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                self.module_locks[t.id] = kind
             elif isinstance(node, ast.AnnAssign) \
                     and isinstance(node.target, ast.Name):
                 self.module_defs.add(node.target.id)
@@ -165,7 +187,10 @@ class ModuleInfo:
             if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 info.methods[item.name] = item
         # type `self.X = <ctor>(...)` wherever it appears in the class —
-        # threads and queues are routinely created outside __init__
+        # threads and queues are routinely created outside __init__.  The
+        # ctor qualname is kept for EVERY constructor-shaped assignment
+        # (kind or not): `self._reg = Registry()` is how the project-wide
+        # resolver follows `self._reg.method()` across modules.
         for fn in info.methods.values():
             for sub in ast.walk(fn):
                 if not isinstance(sub, ast.Assign):
@@ -178,6 +203,8 @@ class ModuleInfo:
                     d = dotted(t)
                     if d and d.startswith("self.") and d.count(".") == 1:
                         attr = d.split(".", 1)[1]
+                        if ctor:
+                            info.attr_ctors.setdefault(attr, ctor)
                         if kind is not None:
                             info.attr_kinds[attr] = kind
                             info.attr_ctors[attr] = ctor

@@ -1,0 +1,443 @@
+"""tpu-tsan runtime side: an opt-in lock-order sanitizer.
+
+The static lock checker (checkers/locks.py) proves what the AST can
+prove; this module watches what actually happens.  When ``DRAND_TSAN=1``
+the ``common.make_lock/make_rlock/make_condition`` factories hand out
+instrumented wrappers instead of raw ``threading`` primitives.  Each
+wrapper records, per thread, the stack of locks currently held; every
+acquisition attempted while other locks are held adds edges to a global
+runtime lock-order graph.  At process exit (or on demand via
+``report()``) the graph is scanned for cycles.
+
+Findings (fail a ``chaos_smoke --tsan`` run):
+
+  * **lock-order cycle** — two locks acquired in both orders anywhere in
+    the process's life.  Edges carry the first acquisition stack of each
+    direction so the report names both call paths.
+  * **non-reentrant re-entry** — a thread acquiring a ``make_lock``/
+    condition lock it already holds: a guaranteed self-deadlock the
+    moment the interleaving lines up.
+
+Warnings (reported, never fatal — a cold XLA compile under a lock is
+slow, not wrong):
+
+  * **long hold** — a lock held longer than ``DRAND_TSAN_HOLD_MS``
+    (default 1000 ms).
+  * **slow acquire** — waiting longer than ``DRAND_TSAN_WAIT_MS``
+    (default 500 ms) to get a lock, i.e. measured contention.
+
+Trust model: the sanitizer observes only locks built through the
+factories — raw ``threading.Lock()`` construction stays invisible, and
+the instrumentation never changes blocking semantics (a detected
+re-entry is recorded, then the acquire proceeds and deadlocks exactly as
+it would have; the SIGUSR1 held-lock table is how an operator reads the
+wreck).  With ``DRAND_TSAN`` unset this module is never imported and the
+serving path is byte-identical.
+
+``threading.Condition`` needs no wrapper of its own: ``make_condition``
+builds a stock Condition around an instrumented lock, and the
+condition's own release/re-acquire in ``wait()`` flows through the
+wrapper, so held-sets stay correct across cv waits for free.
+"""
+
+import os
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = [
+    "instrumented_lock", "instrumented_rlock", "enabled", "report",
+    "reset", "findings", "warnings", "held_locks_by_thread",
+    "render_held_table", "render_report",
+]
+
+_STACK_LIMIT = 12        # frames kept per recorded acquisition site
+
+
+def enabled() -> bool:
+    return os.environ.get("DRAND_TSAN", "") not in ("", "0")
+
+
+def _hold_limit() -> float:
+    return float(os.environ.get("DRAND_TSAN_HOLD_MS", "1000")) / 1000.0
+
+
+def _wait_limit() -> float:
+    return float(os.environ.get("DRAND_TSAN_WAIT_MS", "500")) / 1000.0
+
+
+class _Registry:
+    """Process-global sanitizer state.  Guarded by a RAW threading.Lock
+    (never an instrumented one — the sanitizer must not sanitize
+    itself)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.seq = 0
+        # (src lock id, dst lock id) -> (src name, dst name, stack text)
+        self.edges: Dict[Tuple[int, int], Tuple[str, str, str]] = {}
+        self.findings: List[dict] = []
+        self.warnings: List[dict] = []
+        # thread ident -> live reference to that thread's held stack
+        self.thread_held: Dict[int, Tuple[str, list]] = {}
+
+    def next_name(self, base: str) -> str:
+        with self._mu:
+            self.seq += 1
+            return f"{base}#{self.seq}"
+
+    def add_edges(self, held: list, lock: "_TsanLockBase") -> None:
+        pairs = []
+        for entry in held:
+            src = entry.lock
+            if src is lock:
+                continue
+            key = (id(src), id(lock))
+            pairs.append((key, src.name))
+        if not pairs:
+            return
+        with self._mu:
+            fresh = [p for p in pairs if p[0] not in self.edges]
+            if not fresh:
+                return
+            stack = _stack_text()
+            for key, src_name in fresh:
+                self.edges[key] = (src_name, lock.name, stack)
+
+    def add_finding(self, kind: str, detail: str, stack: str = "") -> None:
+        with self._mu:
+            self.findings.append(
+                {"kind": kind, "detail": detail, "stack": stack,
+                 "thread": threading.current_thread().name})
+
+    def add_warning(self, kind: str, detail: str, stack: str = "") -> None:
+        with self._mu:
+            self.warnings.append(
+                {"kind": kind, "detail": detail, "stack": stack,
+                 "thread": threading.current_thread().name})
+
+    def register_thread(self, held: list) -> None:
+        t = threading.current_thread()
+        with self._mu:
+            self.thread_held[t.ident] = (t.name, held)
+
+
+_registry = _Registry()
+
+
+class _HeldEntry:
+    __slots__ = ("lock", "t0", "count")
+
+    def __init__(self, lock, t0):
+        self.lock = lock
+        self.t0 = t0
+        self.count = 1
+
+
+_tls = threading.local()
+
+
+def _held() -> list:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+        _registry.register_thread(held)
+    return held
+
+
+def _stack_text() -> str:
+    frames = traceback.extract_stack()
+    # drop the sanitizer's own frames off the top
+    while frames and frames[-1].filename == __file__:
+        frames.pop()
+    return "".join(traceback.format_list(frames[-_STACK_LIMIT:]))
+
+
+class _TsanLockBase:
+    """Shared acquire/release bookkeeping.  Subclasses set ``reentrant``
+    and build ``self._inner``."""
+
+    reentrant = False
+    kind = "lock"
+
+    def __init__(self, name: str = ""):
+        self.name = _registry.next_name(name or self._default_name())
+        self._inner = self._make_inner()
+
+    @staticmethod
+    def _make_inner():
+        return threading.Lock()
+
+    def _default_name(self) -> str:
+        # name by construction site: the first frame outside this module
+        for f in reversed(traceback.extract_stack()):
+            if f.filename != __file__ and "/common.py" not in \
+                    f.filename.replace(os.sep, "/"):
+                base = os.path.basename(f.filename)
+                return f"{base}:{f.lineno}"
+        return self.kind
+
+    # -- bookkeeping ----------------------------------------------------------
+
+    def _entry(self) -> Optional[_HeldEntry]:
+        for e in _held():
+            if e.lock is self:
+                return e
+        return None
+
+    def _before_acquire(self, blocking: bool = True) -> None:
+        # a try-acquire cannot deadlock, so it contributes neither re-entry
+        # findings nor order-graph edges (classic lockdep treats trylock
+        # the same way)
+        if not blocking:
+            return
+        entry = self._entry()
+        if entry is not None and not self.reentrant:
+            _registry.add_finding(
+                "reentry",
+                f"non-reentrant {self.kind} {self.name} re-acquired by a "
+                "thread that already holds it (guaranteed self-deadlock)",
+                _stack_text())
+        if entry is None:
+            _registry.add_edges(_held(), self)
+
+    def _after_acquire(self) -> None:
+        held = _held()
+        entry = self._entry()
+        if entry is not None and self.reentrant:
+            entry.count += 1
+            return
+        held.append(_HeldEntry(self, time.monotonic()))
+
+    def _after_release(self) -> None:
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].lock is self:
+                entry = held[i]
+                if self.reentrant and entry.count > 1:
+                    entry.count -= 1
+                    return
+                dur = time.monotonic() - entry.t0
+                del held[i]
+                if dur > _hold_limit():
+                    _registry.add_warning(
+                        "long-hold",
+                        f"{self.name} held for {dur * 1000:.0f} ms",
+                        _stack_text())
+                return
+
+    # -- the lock protocol ----------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._before_acquire(blocking)
+        t0 = time.monotonic()
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            wait = time.monotonic() - t0
+            if wait > _wait_limit():
+                _registry.add_warning(
+                    "slow-acquire",
+                    f"{self.name} took {wait * 1000:.0f} ms to acquire "
+                    "(contention)", _stack_text())
+            self._after_acquire()
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        self._after_release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # threading.Condition probes ownership through these when present;
+    # without them it falls back to a try-acquire probe that would
+    # pollute the order graph
+    def _is_owned(self) -> bool:
+        return self._entry() is not None
+
+    def __repr__(self):
+        return f"<tsan {self.kind} {self.name} inner={self._inner!r}>"
+
+
+class TsanLock(_TsanLockBase):
+    reentrant = False
+    kind = "lock"
+
+
+class TsanRLock(_TsanLockBase):
+    reentrant = True
+    kind = "rlock"
+
+    @staticmethod
+    def _make_inner():
+        return threading.RLock()
+
+    # Condition(wrapped-rlock) uses these to fully release around wait();
+    # mirror the bookkeeping so the held-set stays truthful across waits
+    def _release_save(self):
+        entry = self._entry()
+        count = entry.count if entry is not None else 1
+        if entry is not None:
+            entry.count = 1          # _after_release pops it entirely
+        state = self._inner._release_save()
+        self._after_release()
+        return (state, count)
+
+    def _acquire_restore(self, saved):
+        state, count = saved
+        self._inner._acquire_restore(state)
+        self._before_acquire()
+        self._after_acquire()
+        entry = self._entry()
+        if entry is not None:
+            entry.count = count
+
+
+def instrumented_lock(name: str = "") -> TsanLock:
+    return TsanLock(name)
+
+
+def instrumented_rlock(name: str = "") -> TsanRLock:
+    return TsanRLock(name)
+
+
+# -- reporting ----------------------------------------------------------------
+
+
+def _cycles() -> List[List[Tuple[int, int]]]:
+    """Cycles in the runtime order graph, as edge-key lists.  Each
+    2+-lock inversion is reported once (canonicalized on the smallest
+    node id in the cycle)."""
+    adj: Dict[int, List[int]] = {}
+    for (a, b) in _registry.edges:
+        adj.setdefault(a, []).append(b)
+    out: List[List[Tuple[int, int]]] = []
+    seen: Set[Tuple[int, ...]] = set()
+    for start in list(adj):
+        stack = [(start, [start])]
+        while stack:
+            cur, path = stack.pop()
+            for nxt in adj.get(cur, ()):
+                if nxt == start:
+                    canon = tuple(sorted(set(path)))
+                    if canon in seen:
+                        continue
+                    seen.add(canon)
+                    cyc_nodes = path + [start]
+                    out.append([(cyc_nodes[i], cyc_nodes[i + 1])
+                                for i in range(len(cyc_nodes) - 1)])
+                elif nxt not in path and len(path) < 8:
+                    stack.append((nxt, path + [nxt]))
+    return out
+
+
+def findings() -> List[dict]:
+    """Sanitizer findings so far: recorded re-entries plus lock-order
+    cycles derived from the runtime graph right now."""
+    with _registry._mu:
+        out = list(_registry.findings)
+        edges = dict(_registry.edges)
+    for cyc in _cycles():
+        names = [edges[k][0] for k in cyc if k in edges]
+        if not names:
+            continue
+        first = cyc[0]
+        stacks = "\n".join(
+            f"-- {edges[k][0]} -> {edges[k][1]} first seen at:\n{edges[k][2]}"
+            for k in cyc if k in edges)
+        out.append({
+            "kind": "lock-order-cycle",
+            "detail": ("runtime lock-order cycle (deadlock candidate): "
+                       + " -> ".join(names + [edges[first][0]])),
+            "stack": stacks,
+            "thread": "",
+        })
+    return out
+
+
+def warnings() -> List[dict]:
+    with _registry._mu:
+        return list(_registry.warnings)
+
+
+def report() -> dict:
+    """The full sanitizer report: findings fail a --tsan run, warnings
+    inform it."""
+    f = findings()
+    w = warnings()
+    with _registry._mu:
+        n_edges = len(_registry.edges)
+    return {"enabled": enabled(), "findings": f, "warnings": w,
+            "edges": n_edges}
+
+
+def render_report(rep: Optional[dict] = None) -> str:
+    rep = rep or report()
+    lines = [f"tpu-tsan: {len(rep['findings'])} finding(s), "
+             f"{len(rep['warnings'])} warning(s), "
+             f"{rep['edges']} order edge(s)"]
+    for f in rep["findings"]:
+        lines.append(f"FINDING [{f['kind']}] {f['detail']}")
+        if f.get("thread"):
+            lines.append(f"  thread: {f['thread']}")
+        if f.get("stack"):
+            lines.extend("  " + s for s in f["stack"].splitlines())
+    for w in rep["warnings"]:
+        lines.append(f"warning [{w['kind']}] {w['detail']}")
+    return "\n".join(lines)
+
+
+def reset() -> None:
+    """Drop all recorded state (test isolation)."""
+    with _registry._mu:
+        _registry.edges.clear()
+        _registry.findings.clear()
+        _registry.warnings.clear()
+
+
+def held_locks_by_thread() -> Dict[str, List[str]]:
+    """thread name -> names of locks it holds right now (best-effort
+    snapshot; read racily by design — this feeds a signal-handler
+    diagnostic, it must never block on the sanitizer mutex while a
+    wedged thread holds it)."""
+    out: Dict[str, List[str]] = {}
+    for ident, (name, held) in list(_registry.thread_held.items()):
+        names = [e.lock.name for e in list(held)]
+        if names:
+            out[name] = names
+    return out
+
+
+def render_held_table() -> str:
+    table = held_locks_by_thread()
+    if not table:
+        return "tpu-tsan: no locks held by any thread\n"
+    lines = ["tpu-tsan held-lock table:"]
+    for tname in sorted(table):
+        lines.append(f"  {tname}: " + " -> ".join(table[tname]))
+    return "\n".join(lines) + "\n"
+
+
+# With the sanitizer live, print the report at interpreter exit so a
+# chaos soak that simply finishes still surfaces what it saw.  Findings
+# go to stderr; a clean run stays quiet unless DRAND_TSAN_VERBOSE=1.
+if enabled():                                   # pragma: no cover - atexit
+    import atexit
+    import sys
+
+    def _exit_report():
+        rep = report()
+        if rep["findings"] or \
+                os.environ.get("DRAND_TSAN_VERBOSE", "") == "1":
+            sys.stderr.write(render_report(rep) + "\n")
+
+    atexit.register(_exit_report)

@@ -6,6 +6,8 @@ honest nodes'.  Each signer index may occupy at most MAX_PARTIALS_PER_NODE
 cached rounds; its oldest round is evicted beyond that (constants.go:14)."""
 
 import threading
+
+from ..common import make_lock
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
@@ -56,7 +58,7 @@ class _RoundCache:
 
 class PartialCache:
     def __init__(self, max_per_node: int = MAX_PARTIALS_PER_NODE):
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._rounds: Dict[Tuple[int, bytes], _RoundCache] = {}
         # per-signer FIFO of cache keys it occupies (eviction order)
         self._per_node: Dict[int, OrderedDict] = {}

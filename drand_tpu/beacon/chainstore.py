@@ -10,6 +10,8 @@ flushed on every store (partials for stored rounds are dead weight)."""
 
 import queue
 import threading
+
+from ..common import make_condition
 from typing import Callable, Optional
 
 from ..chain.beacon import Beacon
@@ -70,7 +72,7 @@ class ChainStore:
         self.on_sync_needed = on_sync_needed
         self._partials: queue.Queue = queue.Queue()
         self._stop = threading.Event()
-        self._new_beacon = threading.Condition()
+        self._new_beacon = make_condition()
         self._thread = threading.Thread(target=self._run_aggregator,
                                         daemon=True, name="aggregator")
         self._thread.start()

@@ -3,6 +3,8 @@ core/util_test.go:43-78): the engine never calls time.time() directly, so
 tests can step time deterministically."""
 
 import threading
+
+from ..common import make_condition
 import time
 from abc import ABC, abstractmethod
 
@@ -46,7 +48,7 @@ class FakeClock(Clock):
 
     def __init__(self, start: float = 0.0):
         self._now = start
-        self._cond = threading.Condition()
+        self._cond = make_condition()
 
     def now(self) -> float:
         with self._cond:

@@ -51,6 +51,8 @@ the flat path's).
 
 import os
 import threading
+
+from ..common import make_lock
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -179,7 +181,7 @@ class HandelSession:
         self.on_complete = on_complete
         self.on_demote = on_demote
         self.levels = num_levels(n)
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self.verified: Dict[int, bytes] = {}     # signer -> good partial
         self.checked: Dict[bytes, bool] = {}     # exact bytes -> verdict
         # latest candidate per (level, sender): equivocation costs a
@@ -518,7 +520,7 @@ class HandelCoordinator:
         self.tick_s = self.cfg.tick or max(0.05, min(1.0, period / 20.0))
         self._sessions: Dict[Tuple[int, bytes], HandelSession] = {}
         self._flushed = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._completed = 0

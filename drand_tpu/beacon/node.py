@@ -13,6 +13,8 @@ threshold time (the TPU-first redesign of node.go:150's per-packet pairing).
 """
 
 import threading
+
+from ..common import make_lock
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
@@ -100,7 +102,7 @@ class Handler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._catchup_thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._transition_group = None      # (group, share) armed by reshare
         self.running = False
 

@@ -8,6 +8,8 @@ exactly like chainstore.go:43-75.  Each decorator is itself a chain.Store.
 
 import queue
 import threading
+
+from ..common import make_lock
 from typing import Callable, Dict, Optional
 
 from ..chain.beacon import Beacon
@@ -73,7 +75,7 @@ class AppendStore(_Decorator):
 
     def __init__(self, inner: Store):
         super().__init__(inner)
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         try:
             self._last: Optional[Beacon] = inner.last()
         except ErrNoBeaconStored:
@@ -112,7 +114,7 @@ class SchemeStore(_Decorator):
     def __init__(self, inner: Store, chained: bool):
         super().__init__(inner)
         self.chained = chained
-        self._lock = threading.Lock()
+        self._lock = make_lock()
 
     def put(self, beacon: Beacon) -> None:
         with self._lock:
@@ -161,7 +163,7 @@ class CallbackStore(_Decorator):
 
     def __init__(self, inner: Store):
         super().__init__(inner)
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._subs: Dict[str, queue.Queue] = {}
         self._threads: Dict[str, threading.Thread] = {}
 

@@ -7,6 +7,8 @@ see ticks from that time on (ticker.go:42-58)."""
 
 import queue
 import threading
+
+from ..common import make_lock
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
@@ -26,7 +28,7 @@ class Ticker:
         self.period = period
         self.genesis = genesis_time
         self._subs: List[Tuple[queue.Queue, int]] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

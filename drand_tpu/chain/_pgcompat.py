@@ -14,6 +14,8 @@ import re
 import sqlite3
 import threading
 
+from ..common import make_rlock
+
 # Postgres string literal, including doubled-quote escapes ('it''s ok?' is
 # ONE literal).  Shared with tests/test_pg_dialect.py so the dialect guard
 # and the test pinning it cannot drift.
@@ -90,7 +92,7 @@ class _Connection:
         # the "dsn" is a sqlite path here; ":memory:" or a file path both work
         path = dsn or ":memory:"
         self._db = sqlite3.connect(path, check_same_thread=False)
-        self._lock = threading.RLock()
+        self._lock = make_rlock()
         self.autocommit = False
 
     def cursor(self):

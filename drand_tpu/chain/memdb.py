@@ -7,6 +7,8 @@ head from peers at startup (core/drand_beacon.go:484-529).
 
 import bisect
 import threading
+
+from ..common import make_rlock
 from typing import Optional
 
 from .beacon import Beacon
@@ -23,7 +25,7 @@ class MemDBStore(Store):
             raise ValueError(
                 f"in-memory buffer size cannot be smaller than {self.MIN_BUFFER},"
                 f" got {buffer_size} (recommended at least 2000)")
-        self._lock = threading.RLock()
+        self._lock = make_rlock()
         self._rounds: list = []     # sorted round numbers
         self._beacons: list = []    # parallel list of Beacons
         self._buffer_size = buffer_size

@@ -10,6 +10,8 @@ behavior, pgdb.go / chain/beacon.go:90-97).
 """
 
 import threading
+
+from ..common import make_rlock
 from typing import Optional
 
 from .beacon import Beacon
@@ -60,7 +62,7 @@ class PostgresStore(Store):
         # autocommit for its batch transaction, and an unguarded put()
         # from another thread (beacon engine vs. repair thread) would be
         # swallowed into — and rolled back with — that batch
-        self._write_lock = threading.RLock()
+        self._write_lock = make_rlock()
         self.require_previous = require_previous
         with self.conn, self.conn.cursor() as cur:
             cur.execute(_SCHEMA)

@@ -10,6 +10,8 @@ analogue of boltdb's single `beacons` bucket keyed by be64(round)
 
 import sqlite3
 import threading
+
+from ..common import make_rlock
 from typing import Optional
 
 from .beacon import Beacon
@@ -38,7 +40,7 @@ class SqliteStore(Store):
         may lose a tail of recent commits but never tears one)."""
         self._conn = sqlite3.connect(path, check_same_thread=False,
                                      timeout=BUSY_TIMEOUT_MS / 1000.0)
-        self._lock = threading.RLock()
+        self._lock = make_rlock()
         self.require_previous = require_previous
         with self._lock:
             # pragmas first: the table create below should already ride WAL

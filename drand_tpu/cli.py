@@ -105,6 +105,26 @@ def _leaked_service_threads() -> list:
                   if t.name.startswith(_SERVICE_THREAD_PREFIXES))
 
 
+def _install_dump_handler() -> None:
+    """SIGUSR1 -> all-thread stack dump on stderr, the operator's answer
+    to "what is this daemon doing right now".  When the lock sanitizer
+    is live (DRAND_TSAN=1) the dump is followed by the held-lock table,
+    so a wedged daemon shows not just where each thread sits but which
+    locks it sits on.  No-op on platforms without SIGUSR1."""
+    if not hasattr(signal, "SIGUSR1"):
+        return
+
+    def _dump(_s, _f):
+        import faulthandler
+        faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        if os.environ.get("DRAND_TSAN", "") not in ("", "0"):
+            from .analysis import tsan
+            sys.stderr.write(tsan.render_held_table())
+        sys.stderr.flush()
+
+    signal.signal(signal.SIGUSR1, _dump)
+
+
 def cmd_start(args) -> int:
     identity_dir = getattr(args, "identity_dir", "") or None
     cfg = Config(
@@ -154,6 +174,7 @@ def cmd_start(args) -> int:
             daemon.stop()
     signal.signal(signal.SIGTERM, _sig)
     signal.signal(signal.SIGINT, _sig)
+    _install_dump_handler()
     print(f"drand daemon up: private={daemon.gateway.listen_addr} "
           f"control={daemon.control.port}", flush=True)
     try:

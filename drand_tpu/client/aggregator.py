@@ -10,6 +10,8 @@ the round schedule.
 import queue
 import random
 import threading
+
+from ..common import make_lock
 from typing import Iterator, List, Optional
 
 from ..beacon.clock import Clock, RealClock
@@ -31,7 +33,7 @@ class WatchAggregator(Client):
         self.rng = rng or random.Random()
         self._consecutive_failures = 0
         self._subs: List[queue.Queue] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._stop = threading.Event()
         self._pump: Optional[threading.Thread] = None
         if auto_watch:

@@ -1,6 +1,8 @@
 """LRU caching client (reference: client/cache.go:13-119; LRU size 32)."""
 
 import threading
+
+from ..common import make_lock
 from collections import OrderedDict
 from typing import Iterator, Optional
 
@@ -15,7 +17,7 @@ class CachingClient(Client):
         self.inner = inner
         self.size = size
         self._cache: "OrderedDict[int, Result]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = make_lock()
 
     def get(self, round_: int = 0) -> Result:
         if round_ != 0:

@@ -11,6 +11,8 @@ latency measures the happy path, the breaker remembers the sad one.
 """
 
 import threading
+
+from ..common import make_lock
 import time
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Iterator, List, Optional
@@ -52,7 +54,7 @@ class OptimizingClient(Client):
         self.log = (log or Logger()).named("optimizing")
         self.resilience = resilience or ResiliencePolicy(scope="client")
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._interval = speed_test_interval
         self._prober: Optional[threading.Thread] = None
 

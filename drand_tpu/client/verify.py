@@ -10,6 +10,8 @@ chain-catchup case BASELINE config 1 measures.
 """
 
 import threading
+
+from ..common import make_lock
 from typing import Iterator, Optional
 
 from ..chain.beacon import Beacon
@@ -34,7 +36,7 @@ class VerifyingClient(Client):
         self._info = info
         self.strict = strict
         self.log = (log or Logger()).named("verify")
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._trusted: Optional[Beacon] = None   # last verified beacon
         self._scheme = None
         self._verifier = None

@@ -21,3 +21,53 @@ def compare_beacon_ids(id1: str, id2: str) -> bool:
     if is_default_beacon_id(id1) and is_default_beacon_id(id2):
         return True
     return id1 == id2
+
+
+# -- lock factories -----------------------------------------------------------
+#
+# Every lock in the serving plane is built through these so that
+# `DRAND_TSAN=1` can swap in the runtime lock-order sanitizer
+# (analysis/tsan.py).  With the env unset — the only configuration that
+# ever serves traffic — each factory is a two-line passthrough returning
+# the stock threading primitive: no wrapper object, no sanitizer import,
+# no overhead beyond one os.environ read at construction time (lock
+# construction is startup-path, never hot-path).  The static lock
+# checker types these spellings in analysis/symbols.py; keep the names
+# in sync.
+
+def _tsan_on() -> bool:
+    import os
+    return os.environ.get("DRAND_TSAN", "") not in ("", "0")
+
+
+def make_lock(name: str = ""):
+    """A mutex: `threading.Lock()`, or an instrumented equivalent under
+    DRAND_TSAN=1.  `name` labels the lock in sanitizer reports."""
+    import threading
+    if not _tsan_on():
+        return threading.Lock()
+    from .analysis import tsan
+    return tsan.instrumented_lock(name)
+
+
+def make_rlock(name: str = ""):
+    """A re-entrant mutex (see make_lock)."""
+    import threading
+    if not _tsan_on():
+        return threading.RLock()
+    from .analysis import tsan
+    return tsan.instrumented_rlock(name)
+
+
+def make_condition(lock=None, name: str = ""):
+    """A condition variable.  Under DRAND_TSAN=1 the underlying lock is
+    instrumented and the stock Condition's own release/re-acquire in
+    wait() flows through it, so held-sets stay correct across cv
+    waits."""
+    import threading
+    if not _tsan_on():
+        return threading.Condition(lock)
+    if lock is None:
+        from .analysis import tsan
+        lock = tsan.instrumented_rlock(name or "cv")
+    return threading.Condition(lock)

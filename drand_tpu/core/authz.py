@@ -35,6 +35,8 @@ import json
 import os
 import secrets
 import threading
+
+from ..common import make_lock
 from dataclasses import dataclass, field
 from typing import Dict, NamedTuple, Optional, Tuple
 
@@ -138,7 +140,7 @@ class TokenAuthority:
         self.clock = clock
         self.skew = skew
         self.log = log
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._root_key: Optional[bytes] = None
         self._records: Dict[str, TokenRecord] = {}
         # lock-free fast-path flag (mirrors TenantRegistry.has_tenants):

@@ -9,6 +9,8 @@ EchoBroadcast board and the setup managers.
 
 import os
 import threading
+
+from ..common import make_lock
 from typing import Iterator, List, Optional
 
 from ..beacon.node import (Handler, HandlerConfig, PartialBeaconPacket,
@@ -104,7 +106,7 @@ class BeaconProcess:
         # ScanCheckpoint): in-memory always, persisted next to the sqlite
         # db so a restart resumes instead of rescanning from genesis
         self._scan_ckpt = None
-        self._lock = threading.Lock()
+        self._lock = make_lock()
 
     # -- persistence (drand_beacon.go:110-162) ------------------------------
 
@@ -256,7 +258,7 @@ class BeaconProcess:
         # gathering mathematically cannot reach the threshold
         degrade_at = len(peers) - (self.group.threshold - 1) + 1
         state = {"failed": 0}
-        lock = threading.Lock()
+        lock = make_lock()
 
         def send(peer: Peer):
             try:
@@ -695,27 +697,35 @@ class BeaconProcess:
             repair_t, self._repair_thread = self._repair_thread, None
             if self._scan_stop is not None:
                 self._scan_stop.set()
-            if self.handel is not None:
-                self.handel.stop()
-                self.handel = None
-            if self._handel_pool is not None:
-                self._handel_pool.shutdown(wait=False, cancel_futures=True)
-                self._handel_pool = None
-            if self.syncm is not None:
-                self.syncm.stop()
-            if self.handler is not None:
-                self.handler.stop()
-            if self.monitor is not None:
-                self.monitor.stop()
-            if self._board is not None:
-                self._board.stop()
-            if self.store is not None:
-                self.store.close()
-            self.handler = None
-        # join outside the lock (the workers take self._lock on their way
-        # out).  The repair budget is minutes, so this is a bounded
-        # courtesy wait for the common fast exit, not a completion
-        # guarantee — both are daemon threads already signalled to stop
+            handel, self.handel = self.handel, None
+            pool, self._handel_pool = self._handel_pool, None
+            syncm = self.syncm
+            handler, self.handler = self.handler, None
+            monitor = self.monitor
+            board = self._board
+            store = self.store
+        # stop the components OUTSIDE the lock: each stop() joins its
+        # worker threads, and the workers take self._lock on their way
+        # out — stopping them under the lock is a join-under-lock
+        # deadlock candidate (the lock checker's transitive-blocking
+        # rule and the runtime sanitizer both flag it)
+        if handel is not None:
+            handel.stop()
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+        if syncm is not None:
+            syncm.stop()
+        if handler is not None:
+            handler.stop()
+        if monitor is not None:
+            monitor.stop()
+        if board is not None:
+            board.stop()
+        if store is not None:
+            store.close()
+        # The repair budget is minutes, so this is a bounded courtesy
+        # wait for the common fast exit, not a completion guarantee —
+        # both are daemon threads already signalled to stop
         for t in (scan_t, repair_t):
             if t is not None and t is not threading.current_thread():
                 t.join(timeout=2)
@@ -1147,7 +1157,9 @@ class BeaconProcess:
         # coordinator retires and (when the new group still qualifies) a
         # fresh one starts against the swapped verifier/group
         if new_share is not None and self.handler is not None:
-            old, self.handel = self.handel, None
+            # serialized by the handler's transition lock; see
+            # _maybe_start_handel's pool note
+            old, self.handel = self.handel, None  # tpu-vet: disable=lock
             if old is not None:
                 old.stop()
             self._maybe_start_handel()

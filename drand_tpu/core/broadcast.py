@@ -10,6 +10,8 @@ the network and go straight to the application (broadcast.go:187-197).
 
 import queue
 import threading
+
+from ..common import make_lock
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..crypto import dkg as D
@@ -46,7 +48,7 @@ class EchoBroadcast:
         self.holder_keys = {n.index: n.public for n in holders}
         self.peers = [p for p in peers if p.address != our_address]
         self._seen: set = set()
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         # local application queues, drained by the DKG driver
         self.deals: "queue.Queue[D.DealBundle]" = queue.Queue()
         self.responses: "queue.Queue[D.ResponseBundle]" = queue.Queue()

@@ -16,7 +16,7 @@ from typing import Dict, Optional
 import grpc
 
 from ..chain.errors import ErrNoBeaconSaved, ErrNoBeaconStored
-from ..common import DEFAULT_BEACON_ID, MULTI_BEACON_FOLDER
+from ..common import DEFAULT_BEACON_ID, MULTI_BEACON_FOLDER, make_lock
 from ..crypto.schemes import (get_scheme_by_id_with_default, list_schemes)
 from ..key.group import Group
 from ..key.keys import new_keypair
@@ -36,7 +36,7 @@ class DrandDaemon:
         self.log = (log or Logger()).named("daemon")
         self.processes: Dict[str, BeaconProcess] = {}
         self.chain_hashes: Dict[str, str] = {}      # hex hash -> beacon_id
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._exit = threading.Event()
         # graceful-shutdown flag (SIGTERM drain): /health flips ready to
         # false the moment the drain starts, so fleet supervisors and

@@ -13,6 +13,8 @@ import hashlib
 import hmac
 import math
 import threading
+
+from ..common import make_lock
 from typing import List, Optional
 
 from ..crypto.schemes import Scheme
@@ -42,7 +44,7 @@ class SetupManager:
         self.expected = expected
         self.secret = secret
         self._idents: List[Identity] = [leader_identity]
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self.done = threading.Event()
 
     def received_key(self, ident: Identity, proof: bytes) -> None:

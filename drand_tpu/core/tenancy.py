@@ -49,6 +49,8 @@ slow its readers, never its chain's liveness.
 import json
 import os
 import threading
+
+from ..common import make_rlock
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
@@ -202,7 +204,7 @@ class TenantRegistry:
         self.clock = clock
         self.path = path
         self.device_window = device_window or DEFAULT_DEVICE_WINDOW
-        self._lock = threading.RLock()
+        self._lock = make_rlock()
         self._tenants: Dict[str, TenantConfig] = {}
         self._by_chain: Dict[str, str] = {}     # beacon_id -> tenant
         self._by_hash: Dict[str, str] = {}      # chain-hash hex -> beacon_id

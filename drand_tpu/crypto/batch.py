@@ -26,6 +26,8 @@ two to bound recompiles.
 import os
 import secrets
 import threading
+
+from ..common import make_lock
 import time
 from functools import lru_cache
 
@@ -103,7 +105,7 @@ def h2f_device_default(width: int) -> bool:
 # dispatch_count().  Locked: a multi-group service runs one packer
 # thread per group, and a float += is not atomic.
 _PACK_SECONDS = {"t": 0.0}
-_PACK_LOCK = threading.Lock()
+_PACK_LOCK = make_lock()
 
 
 def pack_seconds() -> float:

@@ -33,6 +33,8 @@ touching an accelerator stack.
 
 import os
 import threading
+
+from ..common import make_lock
 from typing import Dict, List, Optional, Tuple
 
 DEFAULT_GROUPS = int(os.environ.get("DRAND_VERIFY_DEVICE_GROUPS", "0"))
@@ -41,7 +43,7 @@ GROUP_HEALTHY = "healthy"
 GROUP_FAULTED = "faulted"
 GROUP_PROBING = "probing"
 
-_inventory_lock = threading.Lock()
+_inventory_lock = make_lock()
 _inventory: Optional[list] = None
 
 
@@ -173,7 +175,7 @@ class DevicePool:
         # tenant label for anti-affinity + the snapshot
         self._weights: Dict[Tuple, float] = {}
         self._tenants: Dict[Tuple, str] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._pool_sharding = None
         self._pool_sharding_built = False
 

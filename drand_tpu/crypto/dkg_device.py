@@ -37,6 +37,8 @@ muls.  `dispatch_count()` is the CPU-testable counter, mirroring
 
 import os
 import threading
+
+from ..common import make_lock
 from functools import lru_cache
 from typing import Dict, List, Optional, Sequence
 
@@ -48,7 +50,7 @@ _ENABLED = os.environ.get("DRAND_DKG_DEVICE", "1") != "0"
 # so 16 ladder bits always cover x = index+1
 X_BITS = 16
 
-_lock = threading.Lock()
+_lock = make_lock()
 _dispatches = 0
 
 

@@ -39,13 +39,15 @@ This module imports no jax; the caller supplies the platform string.
 import json
 import os
 import threading
+
+from ..common import make_lock
 from typing import Optional, Tuple
 
 DEFAULT_PAD = 8192
 DEFAULT_DEPTH = 1
 TUNING_BASENAME = "TUNING.json"
 
-_lock = threading.Lock()
+_lock = make_lock()
 _cache = {}     # path -> (mtime, parsed entries)
 
 

@@ -6,6 +6,8 @@ atomically (vault.go:74-85, chain/beacon/node.go:257-281).
 """
 
 import threading
+
+from ..common import make_rlock
 from typing import Optional
 
 from .schemes import Scheme
@@ -15,7 +17,7 @@ from . import tbls
 class Vault:
     def __init__(self, scheme: Scheme, group, share):
         """`group`: key.Group; `share`: key.Share (or None until DKG ends)."""
-        self._lock = threading.RLock()
+        self._lock = make_rlock()
         self.scheme = scheme
         self._group = group
         self._share = share

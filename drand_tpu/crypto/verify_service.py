@@ -77,6 +77,8 @@ lazily on first device-handle request.
 
 import os
 import threading
+
+from ..common import make_condition, make_lock
 from collections import deque
 from concurrent.futures import Future
 from typing import Callable, Dict, List, Optional, Tuple
@@ -437,7 +439,7 @@ class VerifyService:
         # handle creation, per-tenant device-time accounting per dispatch
         self._tenancy = None
         self._tenant_rebalances = 0
-        self._cond = threading.Condition()
+        self._cond = make_condition()
         self._streams: Dict[int, _GroupStream] = {}
         self._handles: Dict[Tuple, VerifyHandle] = {}
         self._slots: Dict[Tuple, _BackendSlot] = {}
@@ -887,12 +889,12 @@ class VerifyService:
         Either may be replaced later (a wedged dispatch abandons its
         thread, see `_trip`)."""
         if stream.thread is None:
-            # tpu-vet: disable=lock  (caller holds self._cond, see docstring)
             stream.thread = threading.Thread(
                 target=self._run, args=(stream,), daemon=True,
                 name=f"verify-scheduler-g{stream.gid}")
             stream.thread.start()
         if self._watchdog_thread is None:
+            # tpu-vet: disable=lock  (caller holds self._cond, see docstring)
             self._watchdog_thread = threading.Thread(
                 target=self._watchdog_run, daemon=True,
                 name="verify-watchdog")
@@ -1040,7 +1042,6 @@ class VerifyService:
                     requests.append(r)
                 else:
                     keep.append(r)
-            # tpu-vet: disable=lock  (caller holds self._cond, see docstring)
             stream.queues[drain_lane] = keep
             verify_queue_depth.labels(drain_lane).set(self._qdepth_locked(drain_lane))
         slot = self._slots.get(head.key)
@@ -2138,7 +2139,7 @@ class VerifyService:
 # this module-level default.
 
 _global_service: Optional[VerifyService] = None
-_global_lock = threading.Lock()
+_global_lock = make_lock()
 
 
 def get_service(**kwargs) -> VerifyService:

@@ -14,6 +14,8 @@ schedule so CDNs cache correctly.
 import json
 import queue
 import threading
+
+from .common import make_lock
 import time
 from email.utils import formatdate
 from http.server import BaseHTTPRequestHandler, HTTPServer
@@ -196,7 +198,7 @@ class _BeaconHandler:
         self.bp = bp
         self.latest_round = 0
         self.pending: List[Tuple[int, threading.Event, list]] = []
-        self.lock = threading.Lock()
+        self.lock = make_lock()
         self._registered = False
         self.ensure_callback()
 
@@ -276,7 +278,7 @@ class RestServer:
                               "rest_workers", 0) or DEFAULT_REST_WORKERS
         host, _, port = listen.rpartition(":")
         self._handlers: Dict[str, _BeaconHandler] = {}
-        self._hlock = threading.Lock()
+        self._hlock = make_lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):

@@ -45,6 +45,8 @@ so it adds one lock acquisition to the serving path and nothing else.
 
 import os
 import threading
+
+from ..common import make_condition
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -201,7 +203,7 @@ class AdmissionController:
         # None (or an authority that never minted) keeps the anonymous
         # chain-name attribution path untouched (ISSUE 19)
         self.authority = authority
-        self._cond = threading.Condition()
+        self._cond = make_condition()
         self._inflight: Dict[str, int] = {c: 0 for c in CLASSES}
         self._peer_streams: Dict[str, int] = {}
         self._normal_streams = 0

@@ -17,6 +17,8 @@ peer selection.
 import json
 import os
 import threading
+
+from ..common import make_lock
 from dataclasses import dataclass
 from typing import Dict, Iterator, Optional, Sequence
 
@@ -49,7 +51,7 @@ class DialMap:
         self.path = path or os.environ.get("DRAND_DIAL_MAP", "")
         self._stamp = None
         self._map: Dict[str, str] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock()
 
     def rewrite(self, address: str) -> str:
         if not self.path:
@@ -142,7 +144,7 @@ class ProtocolClient:
         self.dial_map = dial_map or DialMap()
         self.identity = identity      # net/identity.py IdentityPlane or None
         self._conns: Dict[tuple, grpc.Channel] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock()
 
     # -- pool ----------------------------------------------------------------
 

@@ -41,6 +41,8 @@ import os
 import ssl
 import subprocess
 import threading
+
+from ..common import make_lock
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
@@ -276,7 +278,7 @@ class IdentityPlane:
         self.reload_interval = reload_interval
         self.expiry_grace = expiry_grace
         self.log = log
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._creds: Optional[_Creds] = None
         self._next_sweep = float("-inf")
         self._reloads = 0

@@ -25,6 +25,8 @@ schedules run instantly and deterministically.
 import os
 import random
 import threading
+
+from ..common import make_lock
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 
@@ -141,7 +143,7 @@ class CircuitBreaker:
         self._probe_started = 0.0
         self._score = 0.0
         self._last_transition = self.clock.now()
-        self._lock = threading.Lock()
+        self._lock = make_lock()
         self._export_state()
 
     # -- state ---------------------------------------------------------------
@@ -276,7 +278,7 @@ class BreakerRegistry:
         self.cooldown = cooldown
         self.scope = scope
         self._breakers: Dict[str, CircuitBreaker] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock()
 
     def breaker(self, key: str) -> CircuitBreaker:
         with self._lock:

@@ -19,6 +19,8 @@ Reference surface:
 import json
 import os
 import threading
+
+from .common import make_condition, make_lock
 from typing import Iterator, Optional
 
 from .beacon.clock import Clock, RealClock
@@ -96,8 +98,8 @@ class GrpcRelayNode:
         # storm; the lp2p reference keeps a seen-TTL cache independent of
         # delivery state).  Rounds <= the watermark count as already seen.
         self._evicted = 0
-        self._lock = threading.Lock()
-        self._new = threading.Condition(self._lock)
+        self._lock = make_lock()
+        self._new = make_condition(self._lock)
         self._stop = threading.Event()
         self.listener = Listener(
             listen, [(services.PUBLIC, _RelayPublic(self))]
@@ -265,7 +267,7 @@ class GossipRelayNode(GrpcRelayNode):
         self._send_pool = ThreadPoolExecutor(
             max_workers=max(4, 2 * fanout), thread_name_prefix="gossip-send")
         self._channels = {}
-        self._chan_lock = threading.Lock()
+        self._chan_lock = make_lock()
         self._chain_hash = self.info.hash()
         # mesh observability: delivered (first-seen), dup (suppressed),
         # invalid (failed validation) — tests assert dedup through these

@@ -1,0 +1,56 @@
+"""Cross-module lock-discipline half A (tests/test_vet.py fixture).
+
+Alone, this module is clean to the per-class v2 pass: `PlacerA` never
+nests its own locks, never blocks directly, and never writes `plan`
+bare.  Every seeded bug here needs the OTHER module's summaries:
+
+  * `refresh` holds `PlacerA._lock` and calls `RegistryB.snapshot`,
+    which takes `RegistryB._lock`; `RegistryB.rebalance` does the
+    reverse — a two-class lock-order cycle only the project-wide graph
+    sees.
+  * `enqueue` launders its guarded `self.plan` mutation through
+    `append_entry` in lockorder_b (lock-helper-mutation).
+  * `drain_slow` holds the lock across `slow_sync`, which sleeps one
+    frame down (lock-blocking-transitive).
+"""
+
+import threading
+import time
+
+from core.lockorder_b import RegistryB, append_entry
+
+
+def slow_sync():
+    time.sleep(0.5)
+
+
+class PlacerA:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.plan = []
+        self._reg = RegistryB()
+
+    def place(self, item):
+        with self._lock:
+            self.plan.append(item)
+
+    def refresh(self):
+        # BAD (v3 only): holds PlacerA._lock, snapshot() takes
+        # RegistryB._lock — half of the cross-module cycle
+        with self._lock:
+            return self._reg.snapshot()
+
+    def enqueue(self, item):
+        # BAD (v3 only): append_entry mutates self.plan one frame down,
+        # and no lock is held here (lock-helper-mutation)
+        append_entry(self.plan, item)
+
+    def enqueue_locked(self, item):
+        with self._lock:
+            append_entry(self.plan, item)   # fine: guarding lock held
+
+    def drain_slow(self):
+        # BAD (v3 only): slow_sync() sleeps while PlacerA._lock is held
+        # (lock-blocking-transitive)
+        with self._lock:
+            slow_sync()

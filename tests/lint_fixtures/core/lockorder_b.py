@@ -1,0 +1,64 @@
+"""Cross-module lock-discipline half B (tests/test_vet.py fixture).
+
+`RegistryB.rebalance` holds `RegistryB._lock` and calls back into
+`PlacerA.place` (which takes `PlacerA._lock`) — the closing edge of the
+two-class cycle seeded in lockorder_a.  `Notifier` is the PR 15
+listener-under-lock shape: callbacks registered via `subscribe` are
+invoked while `Notifier._lock` is held, so a registered callback that
+sleeps is a stall the callback-registration rule must catch.
+
+Fixture modules are parsed, never imported — the circular import with
+lockorder_a is deliberate and harmless.
+"""
+
+import threading
+import time
+
+from core.lockorder_a import PlacerA
+
+
+def append_entry(plan, item):
+    plan.append(item)
+
+
+class RegistryB:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows = {}
+        self._placer = PlacerA()
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self.rows)
+
+    def rebalance(self, item):
+        # BAD (v3 only): holds RegistryB._lock, place() takes
+        # PlacerA._lock — the cycle's closing edge
+        with self._lock:
+            self._placer.place(item)
+
+
+class Notifier:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs = []
+
+    def subscribe(self, cb):
+        with self._lock:
+            self._subs.append(cb)
+
+    def fire(self, value):
+        with self._lock:
+            for cb in self._subs:
+                cb(value)
+
+
+class ListenerA:
+    def __init__(self):
+        self._notifier = Notifier()
+        # BAD (v3 only): on_event sleeps, and Notifier.fire invokes it
+        # while holding Notifier._lock (lock-callback-blocking)
+        self._notifier.subscribe(self.on_event)
+
+    def on_event(self, value):
+        time.sleep(0.1)

@@ -1,0 +1,216 @@
+"""tpu-tsan runtime sanitizer: wrapper semantics + detection + the
+off-switch guarantee.
+
+The wrappers (analysis/tsan.py) are tested directly — they work whether
+or not DRAND_TSAN is set; the env var only controls what the
+common.make_* factories hand out.  The off-switch test runs in a
+subprocess so this process's own imports can't contaminate the
+"sanitizer never imported" assertion.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+pytestmark = pytest.mark.tsan
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from drand_tpu.analysis import tsan  # noqa: E402
+from drand_tpu.common import make_condition, make_lock, make_rlock  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    tsan.reset()
+    yield
+    tsan.reset()
+
+
+# -- the off switch -----------------------------------------------------------
+
+
+def test_factories_are_pure_passthrough_when_off():
+    """DRAND_TSAN unset => stock threading primitives and the sanitizer
+    module is never imported.  This is the zero-overhead contract the
+    serving plane relies on; run out of process so nothing we imported
+    here can leak into the check."""
+    env = {k: v for k, v in os.environ.items() if k != "DRAND_TSAN"}
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import sys, threading\n"
+        "import drand_tpu.common as c\n"
+        "assert type(c.make_lock()) is type(threading.Lock())\n"
+        "assert type(c.make_rlock()) is type(threading.RLock())\n"
+        "assert isinstance(c.make_condition(), threading.Condition)\n"
+        "assert 'drand_tpu.analysis.tsan' not in sys.modules\n"
+        "print('ok')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "ok"
+
+
+def test_factories_hand_out_wrappers_when_on(monkeypatch):
+    monkeypatch.setenv("DRAND_TSAN", "1")
+    assert isinstance(make_lock(), tsan.TsanLock)
+    assert isinstance(make_rlock(), tsan.TsanRLock)
+    cv = make_condition()
+    assert isinstance(cv, threading.Condition)
+    assert isinstance(cv._lock, tsan.TsanRLock)
+
+
+# -- wrapper semantics --------------------------------------------------------
+
+
+def test_lock_protocol_roundtrip():
+    lk = tsan.instrumented_lock("t.proto")
+    assert not lk.locked()
+    with lk:
+        assert lk.locked()
+        assert lk._is_owned()
+    assert not lk.locked()
+    assert lk.acquire(blocking=False)
+    lk.release()
+
+
+def test_rlock_is_reentrant_without_findings():
+    rl = tsan.instrumented_rlock("t.rl")
+    with rl:
+        with rl:
+            assert rl._is_owned()
+    assert tsan.findings() == []
+
+
+def test_condition_wait_releases_and_reacquires():
+    cv = threading.Condition(tsan.instrumented_rlock("t.cv"))
+    fired = []
+
+    def waker():
+        with cv:
+            fired.append(1)
+            cv.notify_all()
+
+    with cv:
+        t = threading.Timer(0.05, waker)
+        t.start()
+        assert cv.wait(timeout=5)  # deadlocks here if wait keeps the lock
+    t.join()
+    assert fired == [1]
+    assert tsan.findings() == []
+
+
+# -- detection ----------------------------------------------------------------
+
+
+def test_lock_order_cycle_detected():
+    a = tsan.instrumented_lock("t.A")
+    b = tsan.instrumented_lock("t.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    kinds = [f["kind"] for f in tsan.findings()]
+    assert "lock-order-cycle" in kinds
+    cyc = next(f for f in tsan.findings() if f["kind"] == "lock-order-cycle")
+    assert "t.A" in cyc["detail"] and "t.B" in cyc["detail"]
+
+
+def test_consistent_order_is_clean():
+    a = tsan.instrumented_lock("t.A2")
+    b = tsan.instrumented_lock("t.B2")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert tsan.findings() == []
+    assert tsan.report()["edges"] == 1
+
+
+def test_nonreentrant_reentry_detected():
+    lk = tsan.instrumented_lock("t.re")
+    lk.acquire()
+    # re-entry is a same-thread property; an untimed second acquire
+    # would truly deadlock, so use a timed one — the sanitizer records
+    # the finding before blocking, and blocking-with-timeout still
+    # counts (it deadlocks in production where nobody passes timeouts)
+    assert not lk.acquire(blocking=True, timeout=0.05)
+    lk.release()
+    reentries = [f for f in tsan.findings() if f["kind"] == "reentry"]
+    assert reentries and "t.re" in reentries[0]["detail"]
+
+
+def test_try_acquire_contributes_no_edges_or_findings():
+    a = tsan.instrumented_lock("t.tryA")
+    b = tsan.instrumented_lock("t.tryB")
+    with a:
+        assert b.acquire(blocking=False)
+        b.release()
+        assert not a.acquire(blocking=False)  # re-entry probe, not a bug
+    with b:
+        assert a.acquire(blocking=False)
+        a.release()
+    assert tsan.findings() == []
+    assert tsan.report()["edges"] == 0
+
+
+def test_long_hold_is_warning_not_finding(monkeypatch):
+    monkeypatch.setenv("DRAND_TSAN_HOLD_MS", "10")
+    lk = tsan.instrumented_lock("t.hold")
+    with lk:
+        time.sleep(0.05)
+    assert tsan.findings() == []
+    warns = [w for w in tsan.warnings() if w["kind"] == "long-hold"]
+    assert warns and "t.hold" in warns[0]["detail"]
+
+
+# -- operator surface ---------------------------------------------------------
+
+
+def test_held_locks_by_thread_snapshot():
+    lk = tsan.instrumented_lock("t.heldsnap")
+    inner = tsan.instrumented_lock("t.heldsnap2")
+    ready = threading.Event()
+    done = threading.Event()
+
+    def holder():
+        with lk:
+            with inner:
+                ready.set()
+                done.wait(timeout=10)
+
+    t = threading.Thread(target=holder, name="tsan-holder", daemon=True)
+    t.start()
+    assert ready.wait(timeout=10)
+    try:
+        table = tsan.held_locks_by_thread()
+        held = table.get("tsan-holder", [])
+        # names carry a #seq uniquifier; order is acquisition order
+        assert [n.split("#")[0] for n in held] == \
+            ["t.heldsnap", "t.heldsnap2"]
+        rendered = tsan.render_held_table()
+        assert "tsan-holder" in rendered and "t.heldsnap" in rendered
+    finally:
+        done.set()
+        t.join(timeout=10)
+    assert "tsan-holder" not in tsan.held_locks_by_thread()
+
+
+def test_render_report_mentions_findings():
+    a = tsan.instrumented_lock("t.rA")
+    b = tsan.instrumented_lock("t.rB")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    text = tsan.render_report()
+    assert "FINDING" in text and "t.rA" in text

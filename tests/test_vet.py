@@ -523,6 +523,31 @@ def test_cross_function_pair_v1_misses_v2_catches():
     assert ("core/clock_flow_bad.py", "clock-interproc-call") in codes
 
 
+def test_lockorder_pair_v2_misses_v3_catches():
+    """The tpu-tsan tentpole regression, asserted both ways: the
+    cross-module lock-order cycle, the helper-laundered write, the
+    transitive sleep-under-lock, and the callback invoked under the
+    registrar's lock are all invisible to the per-class v2 pass
+    (checker.check with no project) and caught by the v3 project run."""
+    from drand_tpu.analysis.checkers.locks import LockChecker
+    mod_a = _fixture_module("core/lockorder_a.py")
+    mod_b = _fixture_module("core/lockorder_b.py")
+    # v2: no project — each half looks clean to the per-class analysis
+    assert list(LockChecker().check(mod_a)) == []
+    assert list(LockChecker().check(mod_b)) == []
+    # v3: phase-1 lockset summaries expose all four seeded shapes
+    report = run_vet([FIXTURES], checkers=by_names(["lock"]))
+    codes = _codes(report)
+    assert ("core/lockorder_a.py", "lock-helper-mutation") in codes
+    assert ("core/lockorder_a.py", "lock-blocking-transitive") in codes
+    assert ("core/lockorder_b.py", "lock-callback-blocking") in codes
+    msgs = [f.message for f in report.findings]
+    cross = [m for m in msgs if "cycle" in m and "PlacerA" in m]
+    assert any("RegistryB" in m for m in cross), msgs
+    # the guarded-path call (enqueue_locked) is never flagged
+    assert not any("enqueue_locked" in m for m in msgs)
+
+
 def test_threadlife_returns_thread_orphan_needs_project():
     """The start_made_pump leak rides on the returns_thread summary:
     v1 sees `t = make_pump(fn)` as an opaque call and stays silent."""
@@ -575,6 +600,66 @@ def test_file_level_suppression(tmp_path):
     report = run_vet([str(src)], checkers=by_names(["clock"]))
     assert report.findings == []
     assert len(report.suppressed) == 1
+
+
+def test_stale_suppression_audit(tmp_path):
+    """A disable comment covering a live finding is fine; one covering
+    nothing is reported stale — but only for checkers that ran."""
+    src = tmp_path / "hygiene.py"
+    src.write_text(
+        "import time\n"
+        "def a():\n"
+        "    return time.time()  # tpu-vet: disable=clock\n"
+        "def b():  # tpu-vet: disable=clock\n"
+        "    return 2\n"
+        "def c():  # tpu-vet: disable=secret\n"
+        "    return 3\n")
+    report = run_vet([str(src)], checkers=by_names(["clock"]))
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+    # line 4's clock token is stale; line 6's secret token is out of
+    # scope for a clock-only run and must NOT be condemned
+    assert len(report.stale_suppressions) == 1
+    assert "hygiene.py:4" in report.stale_suppressions[0]
+    assert "disable=clock" in report.stale_suppressions[0]
+
+
+def test_stale_baseline_audit(tmp_path):
+    """Baseline budget no current finding consumes is reported."""
+    report = _fixture_report("clock")
+    path = tmp_path / "base.json"
+    write_baseline(str(path), report)
+    baseline = load_baseline(str(path))
+    baseline["gone.py|clock|clock-direct-call|phantom"] = 1
+    rerun = run_vet([FIXTURES], checkers=by_names(["clock"]),
+                    baseline=baseline)
+    assert rerun.findings == []          # real ones all baselined
+    assert rerun.stale_baseline == \
+        ["gone.py|clock|clock-direct-call|phantom"]
+
+
+def test_parallel_sweep_is_deterministic():
+    """The forked sweep must be byte-identical to the serial one (same
+    findings, same order) — force the pool on for the fixture corpus.
+    Runs in a fresh interpreter: the vet CLI never imports JAX so its
+    forks are safe, but THIS process has JAX loaded (multithreaded, and
+    os.fork from a threaded parent can deadlock), so don't fork here."""
+    probe = (
+        "import sys\n"
+        "sys.path.insert(0, %r)\n"
+        "from drand_tpu.analysis import run_vet\n"
+        "from drand_tpu.analysis import core as vet_core\n"
+        "serial = run_vet([%r]).to_dict()\n"
+        "vet_core._PARALLEL_MIN_FILES = 1\n"
+        "import os; os.environ['TPU_VET_WORKERS'] = '2'\n"
+        "parallel = run_vet([%r]).to_dict()\n"
+        "assert parallel == serial, 'parallel sweep diverged from serial'\n"
+        "assert serial['findings'], 'fixture corpus found nothing'\n"
+        "assert 'jax' not in sys.modules\n"
+    ) % (REPO, FIXTURES, FIXTURES)
+    proc = subprocess.run([sys.executable, "-c", probe],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
 def test_baseline_roundtrip(tmp_path):

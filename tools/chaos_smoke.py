@@ -353,6 +353,37 @@ def fleet_main(args) -> int:
     return 0
 
 
+def tsan_main(args) -> int:
+    """--tsan mode: the threaded serving plane under the runtime lock
+    sanitizer.  Sets DRAND_TSAN=1 BEFORE any drand_tpu import (the mode
+    functions import lazily, so every lock the scenarios build goes
+    through the instrumented factories), then drives the three most
+    thread-heavy scenarios — device failover, reshare lifecycle, and
+    serving-plane overload — and fails if any scenario fails OR the
+    sanitizer recorded a finding (lock-order cycle, non-reentrant
+    re-entry).  Long-hold / slow-acquire warnings are printed but never
+    fatal: a cold XLA compile under a lock is slow, not wrong."""
+    assert "drand_tpu" not in sys.modules, \
+        "--tsan must set DRAND_TSAN before the first drand_tpu import"
+    os.environ["DRAND_TSAN"] = "1"
+
+    rcs = {}
+    for name, fn in (("device", device_main), ("reshare", reshare_main),
+                     ("overload", overload_main)):
+        print(f"=== tsan scenario: {name} ===")
+        rcs[name] = fn(args)
+
+    from drand_tpu.analysis import tsan
+    rep = tsan.report()
+    print("=== tsan verdict ===")
+    print(tsan.render_report(rep))
+    print("scenario rcs    : " + ", ".join(
+        f"{k}={v}" for k, v in rcs.items()))
+    ok = all(v == 0 for v in rcs.values()) and not rep["findings"]
+    print(f"tsan clean      : {ok}")
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=42)
@@ -406,8 +437,15 @@ def main() -> int:
                          "processes over live gRPC through the per-link "
                          "chaos proxy (DKG, Handel rounds, SIGKILL + "
                          "restart, partition + heal, graceful teardown)")
+    ap.add_argument("--tsan", action="store_true",
+                    help="run the device + reshare + overload scenarios "
+                         "under the runtime lock-order sanitizer "
+                         "(DRAND_TSAN=1); exit 0 only if every scenario "
+                         "passes AND the sanitizer records no findings")
     args = ap.parse_args()
 
+    if args.tsan:
+        return tsan_main(args)
     if args.fleet:
         return fleet_main(args)
     if args.identity:

@@ -35,13 +35,16 @@ from drand_tpu.analysis import (checker_names, load_baseline,  # noqa: E402
 from drand_tpu.analysis.checkers import by_names  # noqa: E402
 
 
-def _git_changed_files(scan_paths):
+def _git_changed_files(scan_paths, base_ref=None):
     """Python files git considers touched, restricted to `scan_paths`.
 
     Union of unstaged, staged, and untracked (non-ignored) files, against
     the repository that CONTAINS the scan paths (not the one holding this
-    tool).  Raises RuntimeError when git is unavailable or the paths are
-    not inside a work tree.
+    tool).  With `base_ref`, files differing from the merge base of that
+    ref (`git diff REF...`) join the union — the CI fast lane passes the
+    PR's target branch here so a clean worktree still reports the whole
+    branch diff.  Raises RuntimeError when git is unavailable or the
+    paths are not inside a work tree.
     """
     import subprocess
 
@@ -59,10 +62,13 @@ def _git_changed_files(scan_paths):
     first = os.path.abspath(scan_paths[0])
     anchor = first if os.path.isdir(first) else os.path.dirname(first)
     repo_root = run(["git", "rev-parse", "--show-toplevel"], anchor).strip()
+    cmds = [["git", "diff", "--name-only", "HEAD"],
+            ["git", "diff", "--name-only", "--cached"],
+            ["git", "ls-files", "--others", "--exclude-standard"]]
+    if base_ref:
+        cmds.append(["git", "diff", "--name-only", f"{base_ref}..."])
     names = set()
-    for cmd in (["git", "diff", "--name-only", "HEAD"],
-                ["git", "diff", "--name-only", "--cached"],
-                ["git", "ls-files", "--others", "--exclude-standard"]):
+    for cmd in cmds:
         names.update(ln.strip() for ln in run(cmd, repo_root).splitlines()
                      if ln.strip())
     roots = [os.path.abspath(p) for p in scan_paths]
@@ -92,6 +98,16 @@ def main(argv=None) -> int:
                              "(staged + unstaged + untracked) under the "
                              "given paths; the rest are parsed for "
                              "cross-file resolution but not reported")
+    parser.add_argument("--base-ref", default=None, metavar="REF",
+                        help="with --changed, also include files that "
+                             "differ from the merge base of REF "
+                             "(git diff REF...) — the CI fast lane passes "
+                             "the PR target branch here")
+    parser.add_argument("--audit-suppressions", action="store_true",
+                        help="also fail (exit 1) on stale suppression "
+                             "comments and unused baseline budget; run "
+                             "this on full scans only — a partial scan "
+                             "cannot tell unused from out-of-scope")
     parser.add_argument("--checkers", default=None,
                         help="comma-separated subset "
                              f"(default: {','.join(checker_names())})")
@@ -137,7 +153,7 @@ def main(argv=None) -> int:
     context_paths = ()
     if args.changed:
         try:
-            changed = _git_changed_files(paths)
+            changed = _git_changed_files(paths, base_ref=args.base_ref)
         except RuntimeError as e:
             print(f"tpu-vet: --changed needs git: {e}", file=sys.stderr)
             return 2
@@ -166,7 +182,21 @@ def main(argv=None) -> int:
         print(report.to_sarif())
     else:
         print(report.render_text())
-    return 0 if report.clean else 1
+
+    rc = 0 if report.clean else 1
+    if args.audit_suppressions:
+        for line in report.stale_suppressions:
+            print(f"stale-suppression: {line}", file=sys.stderr)
+        for key in report.stale_baseline:
+            print(f"stale-baseline: {key} (budget never consumed)",
+                  file=sys.stderr)
+        if report.stale_suppressions or report.stale_baseline:
+            n = len(report.stale_suppressions) + len(report.stale_baseline)
+            print(f"tpu-vet: {n} stale suppression/baseline entr"
+                  f"{'y' if n == 1 else 'ies'} — remove them",
+                  file=sys.stderr)
+            rc = rc or 1
+    return rc
 
 
 if __name__ == "__main__":
